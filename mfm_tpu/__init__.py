@@ -1,0 +1,39 @@
+"""mfm_tpu — a TPU-native (JAX/XLA/pjit/Pallas) multi-factor equity risk-model framework.
+
+A from-scratch re-design of the capabilities of the reference repo
+``Izumighj/LLM-Driven-Multi-factor-Model`` (a serial pandas/statsmodels Barra
+CNE/USE4-style pipeline): dense masked ``(dates, stocks)`` panels, vmapped
+rolling-window and cross-sectional kernels, and the date/stock axes sharded
+across a TPU mesh.
+
+Layout
+------
+- :mod:`mfm_tpu.panel`     — the dense masked Panel abstraction (long <-> dense)
+- :mod:`mfm_tpu.ops`       — masked cross-sectional / rolling / regression kernels
+- :mod:`mfm_tpu.factors`   — the 16 Barra sub-factors + post-processing + FactorEngine
+- :mod:`mfm_tpu.models`    — the risk model (cross-sectional WLS, Newey-West,
+                             eigenfactor adjustment, vol-regime adjustment, bias stats)
+- :mod:`mfm_tpu.parallel`  — mesh construction and sharding specs
+- :mod:`mfm_tpu.data`      — host-side IO: CSV/parquet loaders, point-in-time joins,
+                             synthetic data, optional Tushare/Mongo adapters
+"""
+
+from mfm_tpu.config import (
+    FactorConfig,
+    RiskModelConfig,
+    PipelineConfig,
+)
+from mfm_tpu.panel import Panel
+from mfm_tpu.models.risk_model import RiskModel
+from mfm_tpu.factors.engine import FactorEngine
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Panel",
+    "RiskModel",
+    "FactorEngine",
+    "FactorConfig",
+    "RiskModelConfig",
+    "PipelineConfig",
+]

@@ -1,0 +1,17 @@
+"""Host-side data layer: no JAX in the hot path here.
+
+- :mod:`mfm_tpu.data.synthetic` — realistic synthetic market/financial panels
+  (the reference's CSI300 CSVs are git-lfs-filtered out of the repo, so tests
+  and benches generate data with the same shape/missingness instead).
+- :mod:`mfm_tpu.data.barra` — load/save the reference's barra-format table
+  (``result/barra_data_csi.csv`` schema) into dense risk-model arrays.
+- :mod:`mfm_tpu.data.pit` — statement dedup + point-in-time as-of joins
+  (``Barra_factor_cal/load_data.py`` contracts).
+"""
+
+from mfm_tpu.data.synthetic import synthetic_market_panel, synthetic_barra_table
+from mfm_tpu.data.barra import (
+    barra_frame_to_arrays,
+    load_barra_csv,
+    BarraArrays,
+)

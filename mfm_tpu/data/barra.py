@@ -1,0 +1,115 @@
+"""Barra-format table <-> dense risk-model arrays.
+
+The reference's risk model eats a long CSV with columns
+``date, stocknames, capital, ret, industry, <10 styles>``
+(``result/barra_data_csi.csv``, consumed at ``Barra-master/demo.py:22-38``),
+drops any row containing any NaN (``demo.py:25-27``) and one-hot encodes the
+industry column against an ``industry_info.csv`` code list (``demo.py:32-35``).
+
+Here the same table densifies into (T, N) arrays + a validity mask; the
+drop-any-NaN rule becomes the mask.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+try:
+    import pandas as pd
+except Exception:  # pragma: no cover
+    pd = None
+
+
+@dataclasses.dataclass
+class BarraArrays:
+    """Dense inputs of :class:`mfm_tpu.models.RiskModel` plus metadata."""
+
+    dates: np.ndarray       # (T,) as given (string/datetime), sorted ascending
+    stocks: np.ndarray      # (N,) sorted ascending (MFM sorts by stockname, MFM.py:59)
+    ret: np.ndarray         # (T, N)
+    cap: np.ndarray         # (T, N)
+    styles: np.ndarray      # (T, N, Q)
+    industry: np.ndarray    # (T, N) int in [0, P), -1 where missing
+    valid: np.ndarray       # (T, N) bool
+    industry_codes: np.ndarray  # (P,) the code list (one-hot column order)
+    style_names: list
+
+    @property
+    def n_industries(self) -> int:
+        return len(self.industry_codes)
+
+    def factor_names(self) -> list:
+        return ["country"] + list(map(str, self.industry_codes)) + list(self.style_names)
+
+
+def barra_frame_to_arrays(
+    df,
+    industry_codes: Sequence | None = None,
+    style_names: Sequence[str] | None = None,
+    drop_any_nan: bool = True,
+    dtype=np.float64,
+) -> BarraArrays:
+    """Densify a barra-format long DataFrame.
+
+    ``industry_codes`` fixes the one-hot column order (the reference reads it
+    from ``industry_info.csv``, ``demo.py:32-35``); default: sorted unique
+    codes present.  ``drop_any_nan`` applies the reference's row filter
+    (``demo.py:25-27``).
+    """
+    if pd is None:  # pragma: no cover
+        raise ImportError("pandas required")
+    base_cols = ["date", "stocknames", "capital", "ret", "industry"]
+    if style_names is None:
+        style_names = [c for c in df.columns if c not in base_cols]
+    if drop_any_nan:
+        df = df.dropna(how="any")
+    dates = np.sort(df["date"].unique())
+    stocks = np.sort(df["stocknames"].unique())
+    if industry_codes is None:
+        industry_codes = np.sort(df["industry"].unique())
+    industry_codes = np.asarray(industry_codes)
+
+    t_idx = {d: i for i, d in enumerate(dates)}
+    s_idx = {s: j for j, s in enumerate(stocks)}
+    code_idx = {c: p for p, c in enumerate(industry_codes)}
+    T, N, Q = len(dates), len(stocks), len(style_names)
+
+    ti = df["date"].map(t_idx).to_numpy()
+    si = df["stocknames"].map(s_idx).to_numpy()
+
+    ret = np.full((T, N), np.nan, dtype)
+    cap = np.full((T, N), np.nan, dtype)
+    styles = np.full((T, N, Q), np.nan, dtype)
+    industry = np.full((T, N), -1, np.int32)
+    valid = np.zeros((T, N), bool)
+
+    ret[ti, si] = df["ret"].to_numpy(dtype)
+    cap[ti, si] = df["capital"].to_numpy(dtype)
+    for q, name in enumerate(style_names):
+        styles[ti, si, q] = df[name].to_numpy(dtype)
+    industry[ti, si] = df["industry"].map(code_idx).fillna(-1).to_numpy(np.int32)
+    valid[ti, si] = True
+    # rows whose industry code is not in the code list are invalid (the
+    # reference's one-hot against industry_info simply yields all-zero dummies
+    # there; we exclude them outright and document the difference)
+    valid &= industry >= 0
+
+    return BarraArrays(
+        dates=dates, stocks=stocks, ret=ret, cap=cap, styles=styles,
+        industry=industry, valid=valid,
+        industry_codes=industry_codes, style_names=list(style_names),
+    )
+
+
+def load_barra_csv(path, industry_info_path=None, **kw) -> BarraArrays:
+    """Load the reference's CSV schema directly (``demo.py:22-35``)."""
+    if pd is None:  # pragma: no cover
+        raise ImportError("pandas required")
+    df = pd.read_csv(path)
+    codes = None
+    if industry_info_path is not None:
+        codes = pd.read_csv(industry_info_path)["code"].to_numpy()
+    return barra_frame_to_arrays(df, industry_codes=codes, **kw)

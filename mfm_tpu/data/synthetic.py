@@ -1,0 +1,193 @@
+"""Synthetic data with the reference workload's shape.
+
+Two generators:
+
+- :func:`synthetic_market_panel` — raw daily market + financial data in the
+  shape the factor engine consumes (close/turnover/total_mv/pb/pe_ttm/
+  financial statement fields), with per-stock listing windows and missing
+  data, mirroring the master panel of ``Barra_factor_cal/load_data.py``.
+- :func:`synthetic_barra_table` — a finished barra-format long table (the
+  ``result/barra_data_csi.csv`` schema: date, stocknames, capital, ret,
+  industry, Q styles) for exercising the risk model alone, like
+  ``Barra-master/demo.py:22-38``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+try:
+    import pandas as pd
+except Exception:  # pragma: no cover
+    pd = None
+
+
+def _dates(T: int, start: str = "2020-01-02") -> np.ndarray:
+    """T business days."""
+    if pd is not None:
+        return pd.bdate_range(start, periods=T).values.astype("datetime64[D]")
+    d0 = np.datetime64(start, "D")
+    out, d = [], d0
+    while len(out) < T:
+        if np.is_busday(d):
+            out.append(d)
+        d += 1
+    return np.array(out)
+
+
+def synthetic_market_panel(
+    T: int = 300,
+    N: int = 50,
+    n_industries: int = 8,
+    seed: int = 0,
+    missing: float = 0.02,
+    listing_gap: float = 0.3,
+) -> Dict[str, np.ndarray]:
+    """Dense (T, N) market/financial arrays + metadata.
+
+    Fields follow the tushare names the reference joins into its master panel
+    (close, turnover_rate, total_mv, circ_mv, pb, pe_ttm, n_cashflow_act,
+    q_profit_yoy, q_sales_yoy, total_ncl, total_hldr_eqy_inc_min_int,
+    debt_to_assets — see SURVEY.md §2.3 for which factor eats which).
+    ``listing_gap`` fraction of stocks list mid-sample (leading NaNs), which
+    exercises the ragged-universe masking.
+    """
+    rng = np.random.default_rng(seed)
+    dates = _dates(T)
+    stocks = np.array([f"{600000 + i}.SH" for i in range(N)])
+    industry = rng.integers(0, n_industries, size=N)
+
+    # market factor + idiosyncratic returns
+    mkt = 0.0003 + 0.01 * rng.standard_normal(T)
+    beta = 0.5 + rng.random(N)
+    idio = 0.015 * rng.standard_normal((T, N)) * (0.5 + rng.random(N))
+    ret = beta[None, :] * mkt[:, None] + idio
+    close0 = np.exp(2.0 + rng.standard_normal(N))
+    close = close0[None, :] * np.cumprod(1.0 + ret, axis=0)
+    index_close = 3000.0 * np.cumprod(1.0 + mkt)
+
+    total_mv = np.exp(rng.normal(11.0, 1.2, size=N))[None, :] * np.cumprod(
+        1.0 + ret, axis=0
+    )
+    circ_mv = total_mv * (0.4 + 0.5 * rng.random(N))[None, :]
+    turnover = np.exp(rng.normal(0.0, 0.8, size=(T, N)))  # percent units
+    pb = np.exp(rng.normal(0.8, 0.5, size=(T, N)))
+    pb[rng.random((T, N)) < 0.01] *= -1  # a few nonpositive pb -> NaN BP
+    pe = np.exp(rng.normal(3.0, 0.7, size=(T, N)))
+    pe[rng.random((T, N)) < 0.02] *= -1
+
+    # quarterly report fields, forward-filled daily like the PIT join output
+    n_q = T // 63 + 2
+    q_cash = rng.normal(1e5, 5e4, size=(n_q, N))
+    q_profit = rng.normal(10.0, 20.0, size=(n_q, N))
+    q_sales = rng.normal(8.0, 15.0, size=(n_q, N))
+    q_idx = np.minimum(np.arange(T) // 63, n_q - 1)
+    end_date_code = q_idx[:, None] * np.ones((1, N), dtype=int)
+    n_cashflow_act = q_cash[q_idx]
+    q_profit_yoy = q_profit[q_idx]
+    q_sales_yoy = q_sales[q_idx]
+
+    total_ncl = np.exp(rng.normal(10.0, 1.0, size=(T, N)))
+    book = np.exp(rng.normal(10.5, 1.0, size=(T, N)))
+    book[rng.random((T, N)) < 0.01] *= -1
+    dtoa = 100.0 * rng.random((T, N)) * 0.8
+
+    fields = {
+        "close": close,
+        "total_mv": total_mv,
+        "circ_mv": circ_mv,
+        "turnover_rate": turnover,
+        "pb": pb,
+        "pe_ttm": pe,
+        "n_cashflow_act": n_cashflow_act,
+        "q_profit_yoy": q_profit_yoy,
+        "q_sales_yoy": q_sales_yoy,
+        "total_ncl": total_ncl,
+        "total_hldr_eqy_inc_min_int": book,
+        "debt_to_assets": dtoa,
+    }
+
+    # listing gaps: leading NaNs per stock; plus sparse random missingness
+    start_idx = np.zeros(N, dtype=int)
+    late = rng.random(N) < listing_gap
+    start_idx[late] = rng.integers(1, max(2, T // 2), size=late.sum())
+    row = np.arange(T)[:, None]
+    alive = row >= start_idx[None, :]
+    holes = rng.random((T, N)) >= missing
+    obs = alive & holes
+    for k, v in fields.items():
+        v = v.astype(np.float64)
+        v[~obs] = np.nan
+        fields[k] = v
+    fields["end_date_code"] = np.where(obs, end_date_code, -1)
+
+    return {
+        "dates": dates,
+        "stocks": stocks,
+        "industry": industry,
+        "index_close": index_close,
+        "observed": obs,
+        **fields,
+    }
+
+
+def synthetic_barra_table(
+    T: int = 120,
+    N: int = 60,
+    P: int = 6,
+    Q: int = 4,
+    seed: int = 0,
+    missing: float = 0.05,
+):
+    """A long barra-format DataFrame like ``result/barra_data_csi.csv``.
+
+    Returns (df, style_names).  Industry codes are strings like the SW L1
+    codes; returns are generated from a true factor structure so the WLS
+    stage has signal to find.  ``missing`` drops whole stock-date rows
+    (ragged universes); every industry is guaranteed at least one member per
+    date so the constraint matrix stays finite (the reference divides by the
+    last industry's cap, ``CrossSection.py:70``).
+    """
+    if pd is None:  # pragma: no cover
+        raise ImportError("pandas required")
+    rng = np.random.default_rng(seed)
+    dates = _dates(T)
+    stocks = np.array([f"{600000 + i}.SH" for i in range(N)])
+    # ensure every industry has >= ceil(N/P) members; keep >= 3 per industry
+    industry = np.arange(N) % P
+    rng.shuffle(industry)
+    styles = rng.standard_normal((T, N, Q))
+    f_style = 0.002 * rng.standard_normal((T, Q))
+    f_ind = 0.003 * rng.standard_normal((T, P))
+    f_cty = 0.0005 * rng.standard_normal(T)
+    ind_oh = np.eye(P)[industry]  # (N, P)
+    ret = (
+        f_cty[:, None]
+        + (ind_oh @ f_ind.T).T
+        + np.einsum("tnq,tq->tn", styles, f_style)
+        + 0.01 * rng.standard_normal((T, N))
+    )
+    cap = np.exp(rng.normal(11.0, 1.0, size=N))[None, :] * np.ones((T, 1))
+
+    keep = rng.random((T, N)) >= missing
+    # guarantee every industry present each date: always keep the first
+    # member of each industry
+    first_member = np.array([np.argmax(industry == p) for p in range(P)])
+    keep[:, first_member] = True
+
+    ti, si = np.nonzero(keep)
+    style_names = [f"style_{q}" for q in range(Q)]
+    df = pd.DataFrame(
+        {
+            "date": np.asarray(dates)[ti].astype("datetime64[D]").astype(str),
+            "stocknames": stocks[si],
+            "capital": cap[ti, si],
+            "ret": ret[ti, si],
+            "industry": np.array([f"sw{p:02d}" for p in industry])[si],
+        }
+    )
+    for q, name in enumerate(style_names):
+        df[name] = styles[ti, si, q]
+    return df, style_names

@@ -1,0 +1,39 @@
+"""The 16 Barra sub-factors, post-processing, and the FactorEngine driver."""
+
+from mfm_tpu.factors.style import (
+    compute_size,
+    compute_beta_hsigma,
+    compute_rstr,
+    compute_dastd,
+    compute_cmra,
+    compute_nlsize,
+    compute_bp,
+    compute_liquidity,
+    compute_earnings_yield,
+    compute_growth,
+    compute_leverage,
+)
+from mfm_tpu.factors.post import (
+    winsorize_panel,
+    composite_factor,
+    orthogonalize,
+)
+from mfm_tpu.factors.engine import FactorEngine
+
+__all__ = [
+    "compute_size",
+    "compute_beta_hsigma",
+    "compute_rstr",
+    "compute_dastd",
+    "compute_cmra",
+    "compute_nlsize",
+    "compute_bp",
+    "compute_liquidity",
+    "compute_earnings_yield",
+    "compute_growth",
+    "compute_leverage",
+    "winsorize_panel",
+    "composite_factor",
+    "orthogonalize",
+    "FactorEngine",
+]

@@ -1,0 +1,178 @@
+"""FactorEngine — the batched equivalent of the reference's
+``FactorCalculator.run`` (``Barra_factor_cal/factor_calculator.py:515-577``).
+
+Row-space semantics
+-------------------
+The reference's master frame has one row per (stock, traded day): a stock's
+rolling windows span *its own* trading days, skipping suspensions entirely
+(``groupby('ts_code').rolling(...)``).  To reproduce that with dense (T, N)
+arrays, the engine packs each stock's observed days to the front of the time
+axis ("row space"), runs every rolling kernel there, and scatters results
+back to calendar positions.  Cross-sectional factors (NLSIZE) and all
+post-processing run in calendar space.  Returns are computed in row space
+(close-over-previous-traded-close, like pandas ``pct_change`` within the
+group, ``factor_calculator.py:50-51``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mfm_tpu.config import FactorConfig
+from mfm_tpu.factors import style
+from mfm_tpu.factors.post import apply_post_processing
+
+
+# ---------------------------------------------------------------------------
+# row-space packing
+# ---------------------------------------------------------------------------
+
+def rowspace_index(observed: jax.Array) -> jax.Array:
+    """(T, N) bool -> (T, N) int32: row r of stock n holds the calendar index
+    of its r-th observed day, or -1 past the end."""
+    T = observed.shape[0]
+    t = jnp.arange(T, dtype=jnp.int32)[:, None]
+    key = jnp.where(observed, t, T + t)  # observed days sort first, in order
+    order = jnp.argsort(key, axis=0).astype(jnp.int32)
+    nobs = jnp.sum(observed, axis=0)
+    return jnp.where(t < nobs[None, :], order, -1)
+
+
+def gather_rows(x: jax.Array, idx: jax.Array) -> jax.Array:
+    """Pack calendar-space (T, ...) data into row space via idx."""
+    safe = jnp.maximum(idx, 0)
+    if x.ndim == 1:  # per-date data (e.g. market return): broadcast per stock
+        g = x[safe]
+    else:
+        g = jnp.take_along_axis(x, safe, axis=0)
+    return jnp.where(idx >= 0, g, jnp.nan)
+
+
+def scatter_rows(f: jax.Array, idx: jax.Array) -> jax.Array:
+    """Unpack row-space results back to calendar positions (inverse gather)."""
+    T, N = f.shape
+    safe = jnp.where(idx >= 0, idx, T)
+    out = jnp.full((T + 1, N), jnp.nan, f.dtype)
+    out = out.at[safe, jnp.arange(N)[None, :]].set(jnp.where(idx >= 0, f, jnp.nan))
+    return out[:T]
+
+
+@dataclasses.dataclass
+class FactorEngine:
+    """Compute the 16 sub-factors + composites over a dense panel.
+
+    Required fields (dict of (T, N) float arrays, NaN = missing; names follow
+    the tushare columns the reference joins, SURVEY.md §2.3):
+      close, total_mv, circ_mv, turnover_rate, pb, pe_ttm, n_cashflow_act,
+      end_date_code (int report id, -1 = none), q_profit_yoy, q_sales_yoy,
+      total_ncl, total_hldr_eqy_inc_min_int, debt_to_assets
+    plus index_close: (T,) market index closes.
+    """
+
+    fields: Dict[str, jax.Array]
+    index_close: jax.Array
+    config: FactorConfig = dataclasses.field(default_factory=FactorConfig)
+    block: int = 64
+
+    def run(self, factors=None, post_process: bool = True) -> Dict[str, jax.Array]:
+        factors = tuple(factors or self.config.factors_to_run)
+        fn = partial(
+            _run_jit, config=self.config, block=self.block,
+            factors=factors, post_process=post_process,
+        )
+        return fn(self.fields, self.index_close)
+
+
+@partial(jax.jit, static_argnames=("config", "block", "factors", "post_process"))
+def _run_jit(fields, index_close, *, config, block, factors, post_process):
+    f = fields
+    cfg = config
+    close = f["close"]
+    observed = jnp.isfinite(close)
+    idx = rowspace_index(observed)
+
+    # returns in row space: previous traded day, like groupby pct_change
+    rs_close = gather_rows(close, idx)
+    rs_ret = rs_close / jnp.concatenate(
+        [jnp.full((1, close.shape[1]), jnp.nan, close.dtype), rs_close[:-1]], axis=0
+    ) - 1.0
+    rs_logret = jnp.log1p(rs_ret)
+    market_ret = index_close / jnp.concatenate(
+        [jnp.full((1,), jnp.nan, index_close.dtype), index_close[:-1]]
+    ) - 1.0
+    rs_market = gather_rows(market_ret, idx)
+
+    out: Dict[str, jax.Array] = {
+        "ret": scatter_rows(rs_ret, idx),
+        "log_ret": scatter_rows(rs_logret, idx),
+    }
+
+    for name in factors:
+        name = name.upper()
+        if name == "SIZE":
+            out["SIZE"] = style.compute_size(f["total_mv"])
+        elif name == "BETA":
+            beta, hsigma = style.compute_beta_hsigma(
+                rs_ret, rs_market, cfg, block=block
+            )
+            out["BETA"] = scatter_rows(beta, idx)
+            out["HSIGMA"] = scatter_rows(hsigma, idx)
+        elif name == "RSTR":
+            out["RSTR"] = scatter_rows(
+                style.compute_rstr(rs_logret, cfg, block=block), idx
+            )
+        elif name == "DASTD":
+            out["DASTD"] = scatter_rows(
+                style.compute_dastd(rs_ret, rs_market, cfg, block=block), idx
+            )
+        elif name == "CMRA":
+            out["CMRA"] = scatter_rows(
+                style.compute_cmra(rs_logret, cfg, block=block), idx
+            )
+        elif name == "NLSIZE":
+            out["NLSIZE"] = style.compute_nlsize(jnp.log(f["total_mv"]))
+        elif name == "BP":
+            out["BP"] = style.compute_bp(f["pb"])
+        elif name == "LIQUIDITY":
+            rs_turn = gather_rows(f["turnover_rate"], idx)
+            for k, v in style.compute_liquidity(rs_turn, cfg, block=block).items():
+                out[k] = scatter_rows(v, idx)
+        elif name == "EARNINGS":
+            rs_cash = gather_rows(f["n_cashflow_act"], idx)
+            rs_rid = jnp.where(
+                idx >= 0,
+                jnp.take_along_axis(f["end_date_code"], jnp.maximum(idx, 0), axis=0),
+                -1,
+            )
+            ttm = style.ttm_rolling4(rs_cash, rs_rid)
+            cetop, etop = style.compute_earnings_yield(
+                scatter_rows(ttm, idx), f["total_mv"], f["pe_ttm"]
+            )
+            out["CETOP"] = cetop
+            out["ETOP"] = etop
+        elif name == "GROWTH":
+            out["YOYProfit"], out["YOYSales"] = style.compute_growth(
+                f["q_profit_yoy"], f["q_sales_yoy"]
+            )
+        elif name == "LEVERAGE":
+            mlev, dtoa, blev = style.compute_leverage(
+                f["total_mv"], f["total_ncl"],
+                f["total_hldr_eqy_inc_min_int"], f["debt_to_assets"],
+            )
+            out["MLEV"], out["DTOA"], out["BLEV"] = mlev, dtoa, blev
+        else:
+            raise ValueError(f"unknown factor {name!r}")
+
+    if post_process:
+        sub = {k: v for k, v in out.items() if k not in ("ret", "log_ret")}
+        processed = apply_post_processing(
+            sub, cfg.composite, cfg.ortho_rules, n_std=cfg.winsorize_n_std
+        )
+        out.update(processed)
+    return out

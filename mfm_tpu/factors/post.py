@@ -1,0 +1,76 @@
+"""Factor post-processing: winsorize, composite aggregation, orthogonalization.
+
+Contracts: ``Barra_factor_cal/post_processing.py`` (see SURVEY.md §1 L4).
+All ops are per-date cross-sections batched over the (T, N) panel.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from mfm_tpu.ops.masked import masked_ols_residuals, winsorize_cs
+
+
+def winsorize_panel(x: jax.Array, n_std: float = 2.5) -> jax.Array:
+    """Per-date clip at mean +/- n_std * sample std (ddof=1), NaN passthrough
+    (``post_processing.py:7-24``). x: (T, N)."""
+    return winsorize_cs(x, n_std=n_std, axis=-1)
+
+
+def composite_factor(
+    components: Sequence[jax.Array], weights: Sequence[float]
+) -> jax.Array:
+    """Missing-aware weighted average: weights renormalize over the non-missing
+    components per cell; all-missing -> NaN (``post_processing.py:26-45``)."""
+    num = jnp.zeros_like(components[0])
+    den = jnp.zeros_like(components[0])
+    for comp, w in zip(components, weights):
+        ok = jnp.isfinite(comp)
+        num = num + jnp.where(ok, comp, 0.0) * w
+        den = den + ok * w
+    return num / den
+
+
+def orthogonalize(
+    target: jax.Array, regressors: Sequence[jax.Array]
+) -> jax.Array:
+    """Per-date OLS residual of target on [1, regressors...]; sections with
+    fewer than len(regressors)+2 valid rows are all-NaN
+    (``post_processing.py:47-69``). Arrays are (T, N)."""
+    X = jnp.stack(regressors, axis=-1)  # (T, N, R)
+
+    def one(y, Xd):
+        return masked_ols_residuals(y, Xd, min_valid=Xd.shape[-1] + 2)
+
+    return jax.vmap(one)(target, X)
+
+
+def apply_post_processing(
+    factors: dict,
+    composite_config: Sequence[tuple],
+    ortho_rules: Sequence[tuple],
+    n_std: float = 2.5,
+    winsorize_cols: Sequence[str] | None = None,
+) -> dict:
+    """The full L4 stage: winsorize every sub-factor, build composites, then
+    orthogonalize (order per ``Barra_factor_cal/main.py:72-86``).
+
+    ``composite_config``: (name, components, weights) triples;
+    ``ortho_rules``: (target, regressors) pairs — the shapes used by
+    :class:`mfm_tpu.config.FactorConfig`.
+    """
+    out = dict(factors)
+    cols = winsorize_cols if winsorize_cols is not None else list(out)
+    for name in cols:
+        out[name] = winsorize_panel(out[name], n_std=n_std)
+    for new_name, comps, weights in composite_config:
+        present = [(c, w) for c, w in zip(comps, weights) if c in out]
+        out[new_name] = composite_factor(
+            [out[c] for c, _ in present], [w for _, w in present]
+        )
+    for target, regs in ortho_rules:
+        out[target] = orthogonalize(out[target], [out[r] for r in regs])
+    return out

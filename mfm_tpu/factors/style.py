@@ -1,0 +1,169 @@
+"""The 16 Barra sub-factor kernels.
+
+Each function is a pure array op over dense panels; the FactorEngine prepares
+inputs (including *row-space* packing: the reference's long frame has rows
+only for days a stock actually traded, so its per-stock rolling windows span
+the stock's own trading days — the engine compresses each stock's observed
+days to the front of the array, runs the rolling kernels there, and scatters
+back; see :mod:`mfm_tpu.factors.engine`).
+
+Exact contracts per sub-factor: SURVEY.md §2.3, citing
+``Barra_factor_cal/factor_calculator.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from mfm_tpu.config import FactorConfig
+from mfm_tpu.ops.masked import masked_ols_residuals
+from mfm_tpu.ops.rolling import (
+    rolling_beta_hsigma,
+    rolling_cmra,
+    rolling_decay_weighted_mean,
+    rolling_sum,
+    rolling_weighted_std,
+)
+
+
+def compute_size(total_mv: jax.Array) -> jax.Array:
+    """SIZE = ln(total market value) (``factor_calculator.py:68-77``)."""
+    return jnp.log(total_mv)
+
+
+def compute_beta_hsigma(ret, market_ret, cfg: FactorConfig = FactorConfig(), *, block=64):
+    """BETA/HSIGMA: rolling WLS slope + residual std
+    (``factor_calculator.py:79-125``)."""
+    s = cfg.beta
+    return rolling_beta_hsigma(
+        ret, market_ret,
+        window=s.window, half_life=s.half_life, min_periods=s.min_periods,
+        block=block,
+    )
+
+
+def compute_rstr(log_ret, cfg: FactorConfig = FactorConfig(), *, block=64):
+    """RSTR momentum: lagged, head-aligned decay-weighted mean of log returns
+    (``factor_calculator.py:127-153``).  The L-day skip is a shift along the
+    stock's own row sequence (``x.shift(L)``)."""
+    L = cfg.rstr_lag
+    window = cfg.rstr_total - L
+    shifted = jnp.concatenate(
+        [jnp.full((L,) + log_ret.shape[1:], jnp.nan, log_ret.dtype), log_ret[:-L]],
+        axis=0,
+    )
+    return rolling_decay_weighted_mean(
+        shifted,
+        window=window, half_life=cfg.rstr_half_life,
+        min_periods=cfg.rstr_min_periods, block=block,
+    )
+
+
+def compute_dastd(ret, market_ret, cfg: FactorConfig = FactorConfig(), *, block=64):
+    """DASTD: exp-weighted std of excess returns
+    (``factor_calculator.py:155-196``)."""
+    if market_ret.ndim == 1:
+        market_ret = market_ret[:, None]
+    s = cfg.dastd
+    return rolling_weighted_std(
+        ret - market_ret,
+        window=s.window, half_life=s.half_life, min_periods=s.min_periods,
+        block=block,
+    )
+
+
+def compute_cmra(log_ret, cfg: FactorConfig = FactorConfig(), *, block=64):
+    """CMRA: cumulative-return range over a fully-observed window
+    (``factor_calculator.py:199-234``)."""
+    return rolling_cmra(log_ret, window=cfg.cmra_window, block=block)
+
+
+def compute_nlsize(size: jax.Array, valid=None) -> jax.Array:
+    """NLSIZE: minus the residual of the per-date cross-sectional OLS of
+    SIZE^3 on SIZE (``factor_calculator.py:237-293``); needs >= 2 valid."""
+    def one(s, v):
+        return -masked_ols_residuals(s**3, s[:, None], v, min_valid=2)
+
+    if valid is None:
+        valid = jnp.isfinite(size)
+    return jax.vmap(one)(size, valid)
+
+
+def compute_bp(pb: jax.Array) -> jax.Array:
+    """BP = 1/pb where pb > 0 (``factor_calculator.py:295-321``)."""
+    return jnp.where(pb > 0, 1.0 / pb, jnp.nan)
+
+
+def compute_liquidity(turnover_rate, cfg: FactorConfig = FactorConfig(), *, block=64):
+    """STOM/STOQ/STOA: log rolling sums of daily turnover (percent/100),
+    zero sums -> NaN before the log (``factor_calculator.py:324-367``)."""
+    dtv = turnover_rate / 100.0
+    out = {}
+    for name, spec in (("STOM", cfg.stom), ("STOQ", cfg.stoq), ("STOA", cfg.stoa)):
+        base = rolling_sum(
+            dtv, window=spec.window, min_periods=spec.min_periods, block=block
+        )
+        out[name] = jnp.log(jnp.where(base == 0.0, jnp.nan, base))
+    return out
+
+
+def ttm_rolling4(values: jax.Array, report_id: jax.Array):
+    """Trailing-twelve-month values: rolling 4-quarter sum over each stock's
+    sequence of *distinct* reports, mapped back to days.
+
+    Contract (``factor_calculator.py:392-412``): unique (stock, report) rows,
+    sorted by report date, ``rolling(4, min_periods=4).sum()`` (so all 4 of
+    the last 4 reports must be present and non-NaN), joined back to days by
+    report id.  ``report_id`` is any int that changes when the report changes
+    (< 0 = no report that day).
+
+    One lax.scan over time, vmapped across stocks via lane-wise ops.
+    """
+    T, N = values.shape
+    dtype = values.dtype
+
+    def step(carry, inp):
+        prev_id, ring = carry  # ring: (4, N) most recent last
+        v, rid = inp
+        push = (rid != prev_id) & (rid >= 0)
+        new_ring = jnp.concatenate([ring[1:], v[None, :]], axis=0)
+        ring = jnp.where(push[None, :], new_ring, ring)
+        ttm = jnp.sum(ring, axis=0)
+        ok = (rid >= 0) & jnp.all(jnp.isfinite(ring), axis=0)
+        out = jnp.where(ok, ttm, jnp.nan)
+        prev_id = jnp.where(rid >= 0, rid, prev_id)
+        return (prev_id, ring), out
+
+    init = (
+        jnp.full((N,), -2, report_id.dtype),
+        jnp.full((4, N), jnp.nan, dtype),
+    )
+    _, ttm = jax.lax.scan(step, init, (values, report_id))
+    return ttm
+
+
+def compute_earnings_yield(cashflow_ttm, total_mv, pe_ttm):
+    """CETOP = TTM operating cashflow / total_mv (both must be > 0);
+    ETOP = 1/pe_ttm where pe_ttm > 0 (``factor_calculator.py:371-434``)."""
+    cetop = jnp.where(
+        (total_mv > 0) & (cashflow_ttm > 0), cashflow_ttm / total_mv, jnp.nan
+    )
+    etop = jnp.where(pe_ttm > 0, 1.0 / pe_ttm, jnp.nan)
+    return cetop, etop
+
+
+def compute_growth(q_profit_yoy, q_sales_yoy):
+    """YOYProfit/YOYSales: percent -> ratio passthrough
+    (``factor_calculator.py:436-462``)."""
+    return q_profit_yoy / 100.0, q_sales_yoy / 100.0
+
+
+def compute_leverage(total_mv, total_ncl, book_value, debt_to_assets):
+    """MLEV/DTOA/BLEV (``factor_calculator.py:464-509``): MLEV maps +-inf
+    (zero market cap) to NaN; BLEV requires positive book value."""
+    mlev = (total_mv + total_ncl) / total_mv
+    mlev = jnp.where(jnp.isinf(mlev), jnp.nan, mlev)
+    dtoa = debt_to_assets
+    blev = jnp.where(book_value > 0, (book_value + total_ncl) / book_value, jnp.nan)
+    return mlev, dtoa, blev

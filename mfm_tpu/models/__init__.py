@@ -1,0 +1,21 @@
+"""The risk model: cross-sectional regression driver + covariance stack
+(Newey-West, eigenfactor risk adjustment, volatility-regime adjustment,
+bias statistics, Bayesian shrinkage)."""
+
+from mfm_tpu.models.newey_west import newey_west, newey_west_expanding
+from mfm_tpu.models.eigen import eigen_risk_adjust, eigen_risk_adjust_by_time
+from mfm_tpu.models.vol_regime import vol_regime_adjust_by_time
+from mfm_tpu.models.bias import eigenfactor_bias_stat, bayes_shrink
+from mfm_tpu.models.risk_model import RiskModel, RiskModelOutputs
+
+__all__ = [
+    "newey_west",
+    "newey_west_expanding",
+    "eigen_risk_adjust",
+    "eigen_risk_adjust_by_time",
+    "vol_regime_adjust_by_time",
+    "eigenfactor_bias_stat",
+    "bayes_shrink",
+    "RiskModel",
+    "RiskModelOutputs",
+]

@@ -1,0 +1,95 @@
+"""Model-health statistics: eigenfactor bias stat and Bayesian specific-vol
+shrinkage.
+
+- :func:`eigenfactor_bias_stat` — the USE4 acceptance test comparing predicted
+  eigen-portfolio volatility to realized returns
+  (``Barra-master/mfm/utils.py:97-117``).
+- :func:`bayes_shrink` — cap-decile Bayesian shrinkage of specific volatility
+  (``utils.py:133-168``; defined in the reference but never wired into a
+  driver — included here for completeness, SURVEY.md §7.2 step 5).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def eigenfactor_bias_stat(
+    covs: jax.Array,
+    valid: jax.Array,
+    factor_ret: jax.Array,
+    predlen: int = 1,
+) -> jax.Array:
+    """Bias statistic of the eigenfactor portfolios.
+
+    Contract (``utils.py:97-117``): for each date i, eigendecompose cov_i,
+    normalize each eigenvector to sum 1 (portfolio weights), predicted vol
+    ``sigma = sqrt(predlen * diag(U' cov U))``, realized return over the next
+    ``predlen`` dates compounded, b_i = U' r / sigma, and the statistic is the
+    per-factor std of b over dates (population std, ``np.std``).
+
+    Dates with invalid covariances are skipped (the reference's bare
+    ``except: pass``).  Returns (K,) bias statistics.
+    """
+    T, K = factor_ret.shape
+    dtype = factor_ret.dtype
+    eye = jnp.eye(K, dtype=dtype)
+    safe = jnp.where(valid[:, None, None], covs, eye)
+
+    # compounded realized returns over (i, i+predlen]: computed from cumsums of
+    # log1p so the whole family is O(T K) (factor returns are close to 0;
+    # matches (1+r).prod() - 1, utils.py:108)
+    cs = jnp.cumsum(jnp.log1p(factor_ret), axis=0)
+    cs = jnp.concatenate([jnp.zeros((1, K), dtype), cs], axis=0)  # (T+1, K)
+    retlen = jnp.expm1(cs[predlen:] - cs[:-predlen])  # (T-predlen+1, K)
+    retlen = retlen[1:]  # realized over (i, i+predlen], i = 0..T-predlen-1
+
+    def one(cov):
+        _, U = jnp.linalg.eigh(cov)
+        U = U / jnp.sum(U, axis=0, keepdims=True)
+        sigma = jnp.sqrt(predlen * jnp.einsum("ki,kl,li->i", U, cov, U))
+        return U, sigma
+
+    U_all, sig_all = jax.vmap(one)(safe[: T - predlen])
+    b = jnp.einsum("tki,tk->ti", U_all, retlen) / sig_all  # (T-predlen, K)
+    m = valid[: T - predlen]
+    n = jnp.sum(m)
+    bz = jnp.where(m[:, None], b, 0.0)
+    mu = jnp.sum(bz, axis=0) / n
+    var = jnp.sum(jnp.where(m[:, None], (b - mu) ** 2, 0.0), axis=0) / n
+    return jnp.sqrt(var)
+
+
+def bayes_shrink(
+    volatility: jax.Array,
+    capital: jax.Array,
+    ngroup: int = 10,
+    q: float = 1.0,
+) -> jax.Array:
+    """Bayesian shrinkage of specific volatility toward cap-group means.
+
+    Contract (``utils.py:133-168``): stocks are bucketed into ``ngroup``
+    cap quantile groups; each group has cap-weighted mean vol m_g and
+    equal-weight dispersion s_g = sqrt(mean((vol - m_g)^2)); the shrinkage
+    intensity is ``v = q|vol - m_g| / (q|vol - m_g| + s_g)`` and the estimate
+    ``v m_g + (1-v)|vol|``.
+
+    Group assignment uses quantile edges (matching ``pd.qcut`` for distinct
+    caps); ties across edges may bucket differently than pandas.
+    """
+    dtype = volatility.dtype
+    n = capital.shape[0]
+    qs = jnp.quantile(capital, jnp.linspace(0.0, 1.0, ngroup + 1)[1:-1])
+    group = jnp.searchsorted(qs, capital, side="left")  # (N,) in [0, ngroup)
+    oh = (group[:, None] == jnp.arange(ngroup)[None, :]).astype(dtype)  # (N, G)
+    cap_g = oh.T @ capital
+    m_g = (oh.T @ (volatility * capital)) / cap_g  # cap-weighted group mean
+    cnt_g = jnp.sum(oh, axis=0)
+    dev2 = (volatility[:, None] - m_g[None, :]) ** 2 * oh
+    s_g = jnp.sqrt(jnp.sum(dev2, axis=0) / cnt_g)
+    m_s = oh @ m_g
+    s_s = oh @ s_g
+    a = q * jnp.abs(volatility - m_s)
+    v = a / (a + s_s)
+    return v * m_s + (1.0 - v) * jnp.abs(volatility)

@@ -1,0 +1,95 @@
+"""Eigenfactor risk adjustment (USE4), batched over dates.
+
+Contract (``Barra-master/mfm/utils.py:55-92``): eigendecompose the factor
+covariance F0 = U0 D0 U0'; simulate M sets of factor returns with the
+eigen-variances, re-estimate and re-decompose each simulated covariance,
+measure the per-eigenvalue bias v, scale ``v <- scale_coef*(v-1)+1``, and
+rebuild ``F0_hat = U0 diag(v^2 * D0) U0'``.
+
+TPU re-design (two structural wins over the reference's loop):
+
+1. ``np.linalg.eig`` on a symmetric PSD matrix becomes ``jnp.linalg.eigh``
+   (TPU has no general nonsymmetric eig; eigh is the correct reformulation).
+2. The reference re-seeds ``np.random.seed(m+1)`` *identically for every
+   date* (``utils.py:71-74``), so the M standard-normal draw matrices — and
+   therefore their sample covariances C_m — are the same for all dates.  We
+   precompute C_m = cov(N_m) once (M tiny KxK matrices) and per date form the
+   simulated covariance as ``F_m = U0 diag(s) C_m diag(s) U0'`` with
+   s = sqrt(D0), which equals ``np.cov(U0 @ (s * N_m))`` exactly.  The
+   T-dates x M-sims Monte-Carlo loop (139k simulations of a (K, T) normal
+   panel in the reference) collapses to M precomputed covariances plus
+   batched KxK matmuls/eighs, vmapped over (dates, sims) and sharded over the
+   date mesh axis.
+
+Bitwise replication of the reference's draws is impossible by construction
+(np.random's MT19937 + SVD-based multivariate_normal); golden tests inject
+the draws, production uses ``jax.random`` (SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def simulated_eigen_covs(
+    key: jax.Array, n_factors: int, sim_length: int, n_sims: int, dtype=jnp.float32
+) -> jax.Array:
+    """Sample covariances C_m of M standard-normal (K, T_sim) draws.
+
+    Matches ``np.cov`` semantics: demean each row over the T_sim samples,
+    normalize by (T_sim - 1).  Shape (M, K, K).
+    """
+    draws = jax.random.normal(key, (n_sims, n_factors, sim_length), dtype=dtype)
+    d = draws - jnp.mean(draws, axis=-1, keepdims=True)
+    return jnp.einsum("mkt,mlt->mkl", d, d) / (sim_length - 1)
+
+
+def eigen_risk_adjust(
+    cov: jax.Array,
+    sim_covs: jax.Array,
+    scale_coef: float = 1.4,
+) -> jax.Array:
+    """Adjust one KxK covariance given precomputed simulation covariances.
+
+    ``sim_covs``: (M, K, K) sample covariances of standard-normal draws (unit
+    variance per factor) — the eigen-variance scaling is applied here.
+    """
+    D0, U0 = jnp.linalg.eigh(cov)
+    s = jnp.sqrt(jnp.maximum(D0, 0.0))
+    B = U0 * s[None, :]  # (K, K): maps unit draws to simulated factor returns
+
+    def one_sim(Cm):
+        Fm = B @ Cm @ B.T  # == np.cov of simulated factor returns
+        Dm, Um = jnp.linalg.eigh(Fm)
+        Dm_hat = jnp.einsum("ki,kl,li->i", Um, cov, Um)  # diag(Um' F0 Um)
+        return Dm_hat / Dm
+
+    v2 = jnp.mean(jax.vmap(one_sim)(sim_covs), axis=0)  # (K,)
+    v = jnp.sqrt(v2)
+    v = scale_coef * (v - 1.0) + 1.0
+    return (U0 * (v**2 * D0)[None, :]) @ U0.T
+
+
+def eigen_risk_adjust_by_time(
+    covs: jax.Array,
+    valid: jax.Array,
+    sim_covs: jax.Array,
+    scale_coef: float = 1.4,
+):
+    """vmap of :func:`eigen_risk_adjust` over the date axis.
+
+    ``covs``: (T, K, K); ``valid``: (T,) — dates whose Newey-West estimate was
+    invalid stay invalid, and dates with a negative eigenvalue are marked
+    invalid (the reference raises and stores an empty DataFrame,
+    ``utils.py:67-68``, ``MFM.py:118-121``).
+    Returns (adjusted covs (T, K, K) with NaN at invalid dates, valid (T,)).
+    """
+    dtype = covs.dtype
+    eye = jnp.eye(covs.shape[-1], dtype=dtype)
+    safe = jnp.where(valid[:, None, None], covs, eye)
+    psd = jax.vmap(lambda c: jnp.linalg.eigvalsh(c)[0] >= 0)(safe)
+    out = jax.vmap(lambda c: eigen_risk_adjust(c, sim_covs, scale_coef))(safe)
+    ok = valid & psd
+    out = jnp.where(ok[:, None, None], out, jnp.nan)
+    return out, ok

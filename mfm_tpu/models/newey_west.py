@@ -1,0 +1,120 @@
+"""Newey-West factor-return covariance, single-shot and expanding.
+
+Contract (``Barra-master/mfm/utils.py:16-50``): for a window of factor returns
+x_0..x_{t-1} with exp-decay weights ``w_i ∝ 0.5**((t-1-i)/tau)`` normalized to
+sum 1, demeaned by the weighted mean:
+
+    Gamma_0  = sum_i w_i d_i d_i'
+    Gamma_l  = sum_{i} w_{i+l} d_i d_{i+l}'          (weight of the later obs)
+    V        = Gamma_0 + sum_{l=1..q} (1 - l/(1+q)) (Gamma_l + Gamma_l')
+
+and the estimate is *invalid* when t <= q or t <= K (the reference raises and
+stores an empty DataFrame, ``mfm/MFM.py:92-96``).
+
+The reference recomputes the full window per date — O(T^2 K^2) Python list
+comprehensions.  Every sum above is an exponentially-weighted cumulative sum,
+so the whole expanding family is one ``lax.scan`` with EWMA recursions:
+O(T K^2 q), no window rematerialization, numerically stable (no growing
+weights), and the per-date output V_t is bitwise the same math.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def newey_west(ret: jax.Array, q: int = 2, half_life: float = 252.0) -> jax.Array:
+    """Single-window Newey-West covariance of (T, K) factor returns.
+
+    Direct (non-scan) evaluation used for testing and one-off calls.
+    """
+    T, K = ret.shape
+    dtype = ret.dtype
+    w = 0.5 ** (jnp.arange(T - 1, -1, -1, dtype=dtype) / half_life)
+    w = w / jnp.sum(w)
+    mu = w @ ret
+    d = ret - mu
+    V = jnp.einsum("t,ti,tj->ij", w, d, d)
+    for lag in range(1, q + 1):
+        G = jnp.einsum("t,ti,tj->ij", w[lag:], d[: T - lag], d[lag:])
+        V = V + (1.0 - lag / (1.0 + q)) * (G + G.T)
+    return V
+
+
+def newey_west_expanding(
+    ret: jax.Array, q: int = 2, half_life: float = 252.0, min_valid: int | None = None
+):
+    """All expanding-window Newey-West covariances in one scan.
+
+    Returns ``(covs, valid)`` where ``covs[t]`` equals
+    ``newey_west(ret[:t+1], q, half_life)`` and ``valid[t]`` is False when
+    t+1 <= q or t+1 <= K (the reference's exception path).
+
+    Derivation: with lam = 0.5**(1/tau) and unnormalized sums
+        S_t   = sum_{i<t} lam^(t-1-i) x_i
+        A_t   = sum_{i<t} lam^(t-1-i) x_i x_i'
+        P^l_t = sum_{j=l}^{t-1} lam^(t-1-j) x_{j-l} x_j'
+        Z_t   = sum_{i<t} lam^(t-1-i)
+    the normalized, demeaned pieces are
+        mu    = S/Z
+        Gamma_0 = A/Z - mu mu'
+        Gamma_l = (P^l - b^l mu' - mu a^l' + z^l mu mu') / Z
+    where a^l = S - (head l terms), b^l = S_{t-l} (the lag-shifted first
+    moment), z^l = Z - (head l terms); heads follow their own EWMA recursions.
+    """
+    T, K = ret.shape
+    dtype = ret.dtype
+    lam = jnp.asarray(0.5, dtype) ** (1.0 / half_life)
+    kmin = K if min_valid is None else min_valid
+
+    def step(carry, xt):
+        (t, S, A, Z, Ps, hs, gs, Slags, xlags) = carry
+        t = t + 1  # window length after including xt
+        Snew = lam * S + xt
+        Anew = lam * A + jnp.outer(xt, xt)
+        Znew = lam * Z + 1.0
+        Ps_new, hs_new, gs_new = [], [], []
+        for li, lag in enumerate(range(1, q + 1)):
+            xlag = xlags[lag - 1]  # x_{t-1-lag} (zero until it exists)
+            Ps_new.append(lam * Ps[li] + jnp.outer(xlag, xt))
+            hs_new.append(lam * hs[li] + jnp.where(t <= lag, 1.0, 0.0) * xt)
+            gs_new.append(lam * gs[li] + jnp.where(t <= lag, 1.0, 0.0))
+
+        mu = Snew / Znew
+        V = Anew / Znew - jnp.outer(mu, mu)
+        for li, lag in enumerate(range(1, q + 1)):
+            a_l = Snew - hs_new[li]
+            b_l = Slags[lag - 1]
+            z_l = Znew - gs_new[li]
+            G = (
+                Ps_new[li]
+                - jnp.outer(b_l, mu)
+                - jnp.outer(mu, a_l)
+                + z_l * jnp.outer(mu, mu)
+            ) / Znew
+            V = V + (1.0 - lag / (1.0 + q)) * (G + G.T)
+
+        valid = (t > q) & (t > kmin)
+        # shift lag registers: Slags[i] must hold S_{t-i-1+1}=S_{t-i} next step
+        Slags_new = (Snew,) + Slags[:-1] if q > 0 else Slags
+        xlags_new = (xt,) + xlags[:-1] if q > 0 else xlags
+        new_carry = (t, Snew, Anew, Znew, tuple(Ps_new), tuple(hs_new),
+                     tuple(gs_new), Slags_new, xlags_new)
+        return new_carry, (V, valid)
+
+    zK = jnp.zeros((K,), dtype)
+    zKK = jnp.zeros((K, K), dtype)
+    init = (
+        jnp.asarray(0, jnp.int32),
+        zK,
+        zKK,
+        jnp.asarray(0.0, dtype),
+        tuple(zKK for _ in range(q)),
+        tuple(zK for _ in range(q)),
+        tuple(jnp.asarray(0.0, dtype) for _ in range(q)),
+        tuple(zK for _ in range(q)),
+        tuple(zK for _ in range(q)),
+    )
+    _, (covs, valid) = jax.lax.scan(step, init, ret)
+    return covs, valid

@@ -1,0 +1,57 @@
+"""Volatility regime adjustment (USE4), as a single masked scan.
+
+Contract (``Barra-master/mfm/MFM.py:130-167``):
+- per-date cross-sectional bias statistic
+  ``B_t = sqrt(mean_k(f_{t,k}^2 / sigma^2_{t,k}))`` with sigma^2 the diagonal
+  of the (eigen-adjusted) covariance at the same date (``MFM.py:149``);
+- exp-decay weights with half-life tau over dates, restricted to dates whose
+  variance row has no NaN, renormalized (``MFM.py:158-159``);
+- factor-volatility multiplier ``lambda_t = sqrt(sum_i w_i B_i^2)`` over
+  i <= t (``MFM.py:160``), and the adjusted covariance is
+  ``cov_t * lambda_t^2`` (``MFM.py:163``).
+
+The reference recomputes the weighted sum per date (O(T^2)); the restricted
+renormalized EWMA is two scalar EWMA recursions — one scan, O(T).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def vol_regime_adjust_by_time(
+    factor_ret: jax.Array,
+    covs: jax.Array,
+    valid: jax.Array,
+    half_life: float = 42.0,
+):
+    """Args:
+      factor_ret: (T, K) raw factor returns from the cross-sectional stage.
+      covs: (T, K, K) eigen-adjusted covariances (NaN at invalid dates).
+      valid: (T,) validity of each covariance.
+
+    Returns (adjusted_covs (T,K,K), lamb (T,)).
+    """
+    dtype = factor_ret.dtype
+    lam = jnp.asarray(0.5, dtype) ** (1.0 / half_life)
+    var = jnp.diagonal(covs, axis1=-2, axis2=-1)  # (T, K)
+    ok = valid & jnp.all(jnp.isfinite(var), axis=-1)
+    B2 = jnp.mean(factor_ret**2 / var, axis=-1)  # (T,) B_t^2
+    B2z = jnp.where(ok, B2, 0.0)
+    okf = ok.astype(dtype)
+
+    def step(carry, inp):
+        num, den = carry
+        b2, okv = inp
+        num = lam * num + okv * b2
+        den = lam * den + okv
+        # before any valid date numpy sums over empty arrays yield 0.0
+        # (MFM.py:159-160), not NaN
+        return (num, den), jnp.where(den > 0, num / den, 0.0)
+
+    _, fvm2 = jax.lax.scan(
+        step, (jnp.asarray(0.0, dtype), jnp.asarray(0.0, dtype)), (B2z, okf)
+    )
+    lamb = jnp.sqrt(fvm2)
+    return covs * fvm2[:, None, None], lamb

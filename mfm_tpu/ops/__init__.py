@@ -1,0 +1,39 @@
+"""Masked array kernels: cross-sectional ops, EWMA weights, rolling windows,
+and the batched constrained WLS regression that is the heart of the risk model."""
+
+from mfm_tpu.ops.masked import (
+    masked_mean,
+    masked_std,
+    masked_var,
+    masked_weighted_mean,
+    winsorize_cs,
+    zscore_cap_weighted,
+    masked_ols_residuals,
+)
+from mfm_tpu.ops.xreg import cross_section_regress, CrossSectionResult
+from mfm_tpu.ops.rolling import (
+    ewma_tail_weights_from_mask,
+    rolling_beta_hsigma,
+    rolling_weighted_std,
+    rolling_decay_weighted_mean,
+    rolling_sum,
+    rolling_cmra,
+)
+
+__all__ = [
+    "masked_mean",
+    "masked_std",
+    "masked_var",
+    "masked_weighted_mean",
+    "winsorize_cs",
+    "zscore_cap_weighted",
+    "masked_ols_residuals",
+    "cross_section_regress",
+    "CrossSectionResult",
+    "ewma_tail_weights_from_mask",
+    "rolling_beta_hsigma",
+    "rolling_weighted_std",
+    "rolling_decay_weighted_mean",
+    "rolling_sum",
+    "rolling_cmra",
+]

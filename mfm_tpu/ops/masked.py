@@ -1,0 +1,118 @@
+"""Masked cross-sectional primitives.
+
+Everything here operates on dense arrays where invalid entries are excluded
+via a boolean mask (or NaN), reproducing the reference's drop-row semantics
+(``demo.py:25-27``, per-date ``dropna`` in ``post_processing.py``) with static
+shapes, so XLA can fuse and the date axis can shard over the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def _as_mask(x: jax.Array, mask: jax.Array | None) -> jax.Array:
+    if mask is None:
+        return jnp.isfinite(x)
+    return mask & jnp.isfinite(x)
+
+
+def masked_mean(x, mask=None, axis=-1, keepdims: bool = False):
+    """Mean over valid entries. Empty slice -> NaN (like pandas mean of none)."""
+    m = _as_mask(x, mask)
+    xz = jnp.where(m, x, 0.0)
+    n = jnp.sum(m, axis=axis, keepdims=keepdims)
+    s = jnp.sum(xz, axis=axis, keepdims=keepdims)
+    return s / n
+
+
+def masked_var(x, mask=None, axis=-1, ddof: int = 0, keepdims: bool = False):
+    """Variance over valid entries (ddof=0 matches ``np.var``; ddof=1 matches
+    pandas ``.std()**2`` as used in winsorization, ``post_processing.py:13-14``)."""
+    m = _as_mask(x, mask)
+    n = jnp.sum(m, axis=axis, keepdims=True)
+    mu = jnp.sum(jnp.where(m, x, 0.0), axis=axis, keepdims=True) / n
+    d2 = jnp.where(m, (x - mu) ** 2, 0.0)
+    v = jnp.sum(d2, axis=axis, keepdims=True) / (n - ddof)
+    if not keepdims:
+        v = jnp.squeeze(v, axis=axis)
+    return v
+
+
+def masked_std(x, mask=None, axis=-1, ddof: int = 0, keepdims: bool = False):
+    return jnp.sqrt(masked_var(x, mask, axis=axis, ddof=ddof, keepdims=keepdims))
+
+
+def masked_weighted_mean(x, w, mask=None, axis=-1, keepdims: bool = False):
+    """Weighted mean over valid entries; weights renormalized over the valid set
+    (the reference's recurring pattern, e.g. ``factor_calculator.py:140-142``)."""
+    m = _as_mask(x, mask)
+    wz = jnp.where(m, w, 0.0)
+    return jnp.sum(wz * jnp.where(m, x, 0.0), axis=axis, keepdims=keepdims) / jnp.sum(
+        wz, axis=axis, keepdims=keepdims
+    )
+
+
+def winsorize_cs(x, n_std: float = 2.5, axis=-1):
+    """Per-cross-section clip at mean +/- n_std * sample std (ddof=1).
+
+    Contract: ``post_processing.py:12-15`` — pandas ``x.mean()/x.std()`` skip
+    NaN and use ddof=1; ``clip`` leaves NaN in place.
+    """
+    m = jnp.isfinite(x)
+    mu = masked_mean(x, m, axis=axis, keepdims=True)
+    sd = masked_std(x, m, axis=axis, ddof=1, keepdims=True)
+    lo, hi = mu - n_std * sd, mu + n_std * sd
+    return jnp.where(m, jnp.clip(x, lo, hi), x)
+
+
+def zscore_cap_weighted(x, cap, mask=None, axis=-1):
+    """Barra style standardization: cap-weighted mean, equal-weight std (ddof=0).
+
+    Contract: ``mfm/CrossSection.py:12-20`` (DescrStatsW weighted mean;
+    ``np.std`` population std).
+    """
+    m = _as_mask(x, mask)
+    capm = jnp.where(m, cap, 0.0)
+    wmu = jnp.sum(capm * jnp.where(m, x, 0.0), axis=axis, keepdims=True) / jnp.sum(
+        capm, axis=axis, keepdims=True
+    )
+    sd = masked_std(x, m, axis=axis, ddof=0, keepdims=True)
+    return jnp.where(m, (x - wmu) / sd, jnp.nan)
+
+
+def masked_ols_residuals(y, X, mask=None, *, min_valid: int | None = None):
+    """Residuals of OLS y ~ [1, X] over the valid rows of one cross-section.
+
+    y: (N,), X: (N, R).  Rows invalid in y or any column of X are excluded and
+    get NaN residuals (contract: ``post_processing.py:52-61`` and the NLSIZE
+    regression ``factor_calculator.py:252-275``).  If fewer than ``min_valid``
+    valid rows (reference uses R+2 for ortho, 2 for NLSIZE), the whole section
+    is NaN.  Solves via normal equations on the (R+1)x(R+1) system — tiny K,
+    vmapped over dates.
+    """
+    y = jnp.asarray(y)
+    X = jnp.asarray(X)
+    if X.ndim == 1:
+        X = X[:, None]
+    N, R = X.shape
+    m = jnp.isfinite(y) & jnp.all(jnp.isfinite(X), axis=-1)
+    if mask is not None:
+        m = m & mask
+    n = jnp.sum(m)
+    mf = m.astype(y.dtype)
+    ones = jnp.ones((N, 1), dtype=y.dtype)
+    A = jnp.concatenate([ones, jnp.where(m[:, None], X, 0.0)], axis=1)  # (N, R+1)
+    A = A * mf[:, None]
+    yz = jnp.where(m, y, 0.0)
+    G = A.T @ A
+    b = A.T @ yz
+    # pinv-solve for rank-deficient safety on degenerate cross-sections
+    coef = jnp.linalg.pinv(G) @ b
+    resid = yz - A @ coef
+    thresh = (R + 2) if min_valid is None else min_valid
+    ok = n >= thresh
+    return jnp.where(m & ok, resid, jnp.nan)

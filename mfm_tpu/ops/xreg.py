@@ -1,0 +1,150 @@
+"""Batched constrained cross-sectional WLS — the risk-model kernel.
+
+Re-design of the reference's per-date ``CrossSection.reg()``
+(``Barra-master/mfm/CrossSection.py:57-108``) as a single masked, vmappable
+function over static ``(N,)`` cross-sections:
+
+- style standardization: cap-weighted mean, equal-weight population std
+  (``CrossSection.py:12-20,46``)
+- design X = [country=1 | industry one-hot | standardized styles]
+  (``CrossSection.py:48,74``)
+- WLS weights W = sqrt(cap)/sum(sqrt(cap))  (``CrossSection.py:50``)
+- industry-neutrality constraint matrix R eliminating the LAST industry with
+  cap-weight ratios (``CrossSection.py:66-71``)
+- pure-factor-portfolio weights Omega = R pinv(Xr' W Xr) Xr' W
+  (``CrossSection.py:74-76``)
+- factor returns, specific returns, exposure check, R^2 = 1 - var(spec)/var(ret)
+  (``CrossSection.py:101-106``)
+
+Instead of one NumPy solve per date inside a Python loop (``mfm/MFM.py:57-68``),
+the whole (T, N) panel is vmapped and the date axis shards over the device
+mesh; with the stock axis sharded too, the normal-equation matmuls reduce over
+stocks and XLA inserts psums over the 'stock' mesh axis.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from mfm_tpu.ops.masked import masked_var, zscore_cap_weighted
+
+
+class CrossSectionResult(NamedTuple):
+    factor_ret: jax.Array  # (..., K) pure factor returns [country, P industries, Q styles]
+    specific_ret: jax.Array  # (..., N) NaN outside the valid universe
+    r2: jax.Array  # (...,)
+    exposure: jax.Array | None = None  # (..., K, K) pure-factor portfolio exposures
+
+
+def _constraint_matrix(ind_cap: jax.Array, Q: int) -> jax.Array:
+    """Industry-neutrality constraint R of shape (K, K-1), K = 1 + P + Q.
+
+    In the reduced basis the last industry's exposure is expressed through the
+    other industries' cap weights: row ``P`` (the last industry) becomes
+    ``-ind_cap_i / ind_cap_P`` over industry columns, and the last industry's
+    own column is removed (``CrossSection.py:69-71``).
+    """
+    P = ind_cap.shape[0]
+    K = 1 + P + Q
+    R = jnp.eye(K, dtype=ind_cap.dtype)
+    row = jnp.zeros((K,), ind_cap.dtype).at[1 : 1 + P].set(-ind_cap / ind_cap[-1])
+    R = R.at[P].set(row)
+    keep = jnp.concatenate([jnp.arange(P), jnp.arange(P + 1, K)])
+    return R[:, keep]  # static-shape column delete
+
+
+def cross_section_regress(
+    ret: jax.Array,
+    cap: jax.Array,
+    styles: jax.Array,
+    industry: jax.Array,
+    valid: jax.Array,
+    *,
+    n_industries: int,
+    standardize_styles: bool = True,
+    return_exposure: bool = False,
+) -> CrossSectionResult:
+    """One date's constrained WLS pure-factor regression, masked.
+
+    Args:
+      ret:      (N,) next-period stock returns.
+      cap:      (N,) market caps (the WLS/standardization weights).
+      styles:   (N, Q) style exposures.
+      industry: (N,) int industry codes in [0, P); anything outside is invalid.
+      valid:    (N,) bool — the date's universe (rows the reference would keep
+                after its drop-any-NaN filter, ``demo.py:25-27``).
+      n_industries: P (static).  P=0 runs the no-industry branch
+                (``CrossSection.py:95-98``).
+    """
+    dtype = styles.dtype
+    P = n_industries
+    Q = styles.shape[-1]
+    valid = valid & jnp.isfinite(ret) & jnp.isfinite(cap)
+    if P:
+        valid = valid & (industry >= 0) & (industry < P)
+    vf = valid.astype(dtype)
+
+    if standardize_styles:
+        s = zscore_cap_weighted(styles, cap[:, None], valid[:, None], axis=0)
+    else:
+        s = styles
+    s = jnp.where(valid[:, None], s, 0.0)
+
+    capz = jnp.where(valid, cap, 0.0)
+    w = jnp.sqrt(capz)
+    w = w / jnp.sum(w)
+
+    country = vf[:, None]
+    if P:
+        ind_oh = (industry[:, None] == jnp.arange(P)[None, :]).astype(dtype) * vf[:, None]
+        X = jnp.concatenate([country, ind_oh, s], axis=1)  # (N, K)
+        ind_cap = ind_oh.T @ capz  # (P,) per-industry total cap (CrossSection.py:66)
+        R = _constraint_matrix(ind_cap, Q)  # (K, K-1)
+        Xr = X @ R  # (N, K-1)
+        XtW = Xr.T * w[None, :]
+        G = XtW @ Xr  # (K-1, K-1)
+        omega = R @ (jnp.linalg.pinv(G) @ XtW)  # (K, N)
+    else:
+        X = jnp.concatenate([country, s], axis=1)
+        XtW = X.T * w[None, :]
+        G = XtW @ X
+        omega = jnp.linalg.pinv(G) @ XtW
+
+    retz = jnp.where(valid, ret, 0.0)
+    factor_ret = omega @ retz  # (K,)
+    spec = retz - X @ factor_ret
+    # equal-weight population variance over the date's universe (CrossSection.py:106)
+    r2 = 1.0 - masked_var(spec, valid, axis=0, ddof=0) / masked_var(
+        retz, valid, axis=0, ddof=0
+    )
+    spec = jnp.where(valid, spec, jnp.nan)
+    exposure = (omega @ X) if return_exposure else None
+    return CrossSectionResult(factor_ret, spec, r2, exposure)
+
+
+def regress_panel(
+    ret: jax.Array,
+    cap: jax.Array,
+    styles: jax.Array,
+    industry: jax.Array,
+    valid: jax.Array,
+    *,
+    n_industries: int,
+    standardize_styles: bool = True,
+    return_exposure: bool = False,
+) -> CrossSectionResult:
+    """vmap of :func:`cross_section_regress` over the leading date axis.
+
+    ret/cap: (T, N); styles: (T, N, Q); industry: (T, N) int; valid: (T, N).
+    This replaces the reference's serial date loop (``mfm/MFM.py:57-68``).
+    """
+    fn = lambda r, c, s, i, v: cross_section_regress(
+        r, c, s, i, v,
+        n_industries=n_industries,
+        standardize_styles=standardize_styles,
+        return_exposure=return_exposure,
+    )
+    return jax.vmap(fn)(ret, cap, styles, industry, valid)

@@ -1,0 +1,159 @@
+"""The dense masked Panel — the core data abstraction of the framework.
+
+The reference keeps everything in long-form DataFrames (rows = stock-date,
+e.g. the master panel built at ``Barra_factor_cal/load_data.py:329-378``) and
+loops over ``groupby`` groups.  That shape cannot feed XLA.  Here a panel is a
+dict of dense ``(T, N)`` arrays (dates x stocks) where ``NaN`` marks a missing
+observation — ragged per-date universes (stocks entering/leaving, cf.
+``mfm/MFM.py:65-66``) become masking, never dynamic shapes.
+
+Host-side metadata (date ints, stock ids) stays in NumPy; field arrays are
+whatever array type the caller put in (NumPy on host, jax.Array on device).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, Mapping
+
+import numpy as np
+
+try:  # pandas is host-side optional sugar; the core never needs it
+    import pandas as pd
+except Exception:  # pragma: no cover
+    pd = None
+
+
+@dataclasses.dataclass
+class Panel:
+    """A dense (T, N) panel of named fields with NaN-as-missing semantics.
+
+    Attributes:
+      dates:  (T,) np.ndarray of np.datetime64[D] (or int-like), ascending.
+      stocks: (N,) np.ndarray of stock identifiers (strings), sorted.
+      fields: name -> (T, N) float array; NaN = missing.
+      static: name -> (N,) array of per-stock static data (e.g. industry code).
+    """
+
+    dates: np.ndarray
+    stocks: np.ndarray
+    fields: Dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+    static: Dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+
+    @property
+    def T(self) -> int:
+        return len(self.dates)
+
+    @property
+    def N(self) -> int:
+        return len(self.stocks)
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        return self.fields[name]
+
+    def __setitem__(self, name: str, value) -> None:
+        value = np.asarray(value) if not hasattr(value, "shape") else value
+        if value.shape != (self.T, self.N):
+            raise ValueError(
+                f"field {name!r} has shape {value.shape}, want {(self.T, self.N)}"
+            )
+        self.fields[name] = value
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.fields
+
+    def mask(self, *names: str) -> np.ndarray:
+        """Joint validity mask across the given fields (all finite)."""
+        if not names:
+            names = tuple(self.fields)
+        m = np.ones((self.T, self.N), dtype=bool)
+        for n in names:
+            m &= np.isfinite(np.asarray(self.fields[n], dtype=np.float64))
+        return m
+
+    # ------------------------------------------------------------------
+    # long <-> dense conversion
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def from_long(
+        cls,
+        df,
+        *,
+        date_col: str = "trade_date",
+        stock_col: str = "ts_code",
+        value_cols: Iterable[str] | None = None,
+        dtype=np.float64,
+    ) -> "Panel":
+        """Pivot a long (stock-date rows) DataFrame into a dense Panel.
+
+        Duplicated (date, stock) pairs keep the last occurrence, matching the
+        reference's dedup-keep-latest convention (``load_data.py:269-296``).
+        """
+        if pd is None:  # pragma: no cover
+            raise ImportError("pandas required for from_long")
+        dates = np.sort(df[date_col].unique())
+        stocks = np.sort(df[stock_col].unique())
+        t_idx = {d: i for i, d in enumerate(dates)}
+        s_idx = {s: j for j, s in enumerate(stocks)}
+        ti = df[date_col].map(t_idx).to_numpy()
+        si = df[stock_col].map(s_idx).to_numpy()
+        if value_cols is None:
+            value_cols = [c for c in df.columns if c not in (date_col, stock_col)]
+        fields: Dict[str, np.ndarray] = {}
+        for c in value_cols:
+            arr = np.full((len(dates), len(stocks)), np.nan, dtype=dtype)
+            vals = pd.to_numeric(df[c], errors="coerce").to_numpy(dtype=dtype)
+            arr[ti, si] = vals  # later rows overwrite earlier ones
+            fields[c] = arr
+        return cls(dates=np.asarray(dates), stocks=np.asarray(stocks), fields=fields)
+
+    def to_long(self, *names: str, dropna: bool = True):
+        """Flatten back to a long DataFrame with one row per valid stock-date."""
+        if pd is None:  # pragma: no cover
+            raise ImportError("pandas required for to_long")
+        names = names or tuple(self.fields)
+        T, N = self.T, self.N
+        out = {
+            "trade_date": np.repeat(self.dates, N),
+            "ts_code": np.tile(self.stocks, T),
+        }
+        for n in names:
+            out[n] = np.asarray(self.fields[n]).reshape(-1)
+        df = pd.DataFrame(out)
+        if dropna:
+            df = df.dropna(how="all", subset=list(names)).reset_index(drop=True)
+        return df
+
+    def select(self, names: Iterable[str]) -> "Panel":
+        return Panel(
+            dates=self.dates,
+            stocks=self.stocks,
+            fields={n: self.fields[n] for n in names},
+            static=dict(self.static),
+        )
+
+
+def pct_change(close: np.ndarray) -> np.ndarray:
+    """Per-stock simple returns along the date axis of a (T, N) close panel.
+
+    Matches ``groupby('ts_code')['close'].pct_change()``
+    (``factor_calculator.py:50``): NaN closes propagate — pandas pct_change
+    computes close[t]/close[t-1] - 1 against the *previous row* (not the
+    previous valid observation) with default fill_method=None semantics of
+    recent pandas.
+    """
+    close = np.asarray(close, dtype=np.float64)
+    out = np.full_like(close, np.nan)
+    out[1:] = close[1:] / close[:-1] - 1.0
+    return out
+
+
+def log_return(close: np.ndarray) -> np.ndarray:
+    """log(close_t) - log(close_{t-1}) per stock (``factor_calculator.py:51``)."""
+    close = np.asarray(close, dtype=np.float64)
+    out = np.full_like(close, np.nan)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        lc = np.log(close)
+    out[1:] = lc[1:] - lc[:-1]
+    return out

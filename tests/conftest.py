@@ -1,0 +1,22 @@
+"""Test env: CPU backend with 8 virtual devices (the multi-chip stand-in,
+SURVEY.md §4) and float64 enabled for 1e-8-level parity with the NumPy/pandas
+golden implementations."""
+
+import os
+
+# force CPU: the session env points JAX_PLATFORMS at the real TPU (axon),
+# but parity tests need float64 and 8 virtual devices
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "true")
+
+import jax  # noqa: E402  (import after env setup)
+
+# the session env pins JAX_PLATFORMS=axon before pytest starts, and that
+# wins over os.environ changes made here — override through the config API
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,287 @@
+"""Golden NumPy/pandas implementations of the reference's numerical contracts.
+
+Written independently from the contract descriptions in SURVEY.md §2.3/§3 —
+deliberately plain, loopy, per-window/per-date NumPy so that agreement with
+the batched JAX kernels is meaningful.  statsmodels is not available in this
+image, so its exact math is reproduced inline where the reference calls it:
+``sm.WLS(y, X, weights=w).fit()`` solves the whitened least squares
+``lstsq(sqrt(w) X, sqrt(w) y)`` with ``model.scale = sum(w e^2)/(n - p)``,
+and ``sm.OLS`` is the w=1 special case (statsmodels regression docs; the
+reference call sites are ``factor_calculator.py:99-102`` and
+``post_processing.py:60``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+
+def wls_fit(y, X, w=None):
+    """(params, scale): exact statsmodels WLS semantics, pure NumPy."""
+    y = np.asarray(y, float)
+    X = np.asarray(X, float)
+    n, p = X.shape
+    w = np.ones(n) if w is None else np.asarray(w, float)
+    sw = np.sqrt(w)
+    params, *_ = np.linalg.lstsq(X * sw[:, None], y * sw, rcond=None)
+    e = y - X @ params
+    scale = np.sum(w * e * e) / (n - p)
+    return params, scale
+
+
+def add_constant(X):
+    X = np.asarray(X, float)
+    if X.ndim == 1:
+        X = X[:, None]
+    return np.hstack([np.ones((X.shape[0], 1)), X])
+
+
+# ---------------------------------------------------------------------------
+# cross-sectional WLS (contract: Barra-master/mfm/CrossSection.py)
+# ---------------------------------------------------------------------------
+
+def golden_cross_section(ret, cap, styles, ind_onehot):
+    """One date, valid rows only. ret (n,), cap (n,), styles (n, Q),
+    ind_onehot (n, P). Returns (factor_ret (K,), specific (n,), r2)."""
+    n, Q = styles.shape
+    P = ind_onehot.shape[1]
+    wmu = np.sum(styles * cap[:, None], axis=0) / np.sum(cap)
+    sd = np.std(styles, axis=0)  # equal-weight population std
+    sty = (styles - wmu) / sd
+    X = np.hstack([np.ones((n, 1)), ind_onehot, sty])
+    w = np.sqrt(cap) / np.sum(np.sqrt(cap))
+    W = np.diag(w)
+    K = 1 + P + Q
+    if P > 0:
+        ind_cap = ind_onehot.T @ cap
+        R = np.eye(K)
+        R[P, 1 : 1 + P] = -ind_cap / ind_cap[-1]
+        R = np.delete(R, P, axis=1)
+        Xr = X @ R
+        omega = R @ np.linalg.pinv(Xr.T @ W @ Xr) @ Xr.T @ W
+    else:
+        omega = np.linalg.pinv(X.T @ W @ X) @ X.T @ W
+    f = omega @ ret
+    spec = ret - X @ f
+    r2 = 1.0 - np.var(spec) / np.var(ret)
+    return f, spec, r2
+
+
+def golden_reg_by_time(df, style_names, industry_codes):
+    """Serial per-date loop over a barra-format long frame (drop-any-NaN rows
+    already applied). Returns dict keyed by date."""
+    out = {}
+    for date, g in df.groupby("date"):
+        g = g.sort_values("stocknames")
+        ind_oh = np.stack(
+            [(g["industry"] == c).to_numpy(float) for c in industry_codes], axis=1
+        )
+        f, spec, r2 = golden_cross_section(
+            g["ret"].to_numpy(),
+            g["capital"].to_numpy(),
+            g[style_names].to_numpy(),
+            ind_oh,
+        )
+        out[date] = dict(f=f, spec=spec, r2=r2, stocks=g["stocknames"].to_numpy())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Newey-West (contract: Barra-master/mfm/utils.py:16-50)
+# ---------------------------------------------------------------------------
+
+def golden_newey_west(ret: np.ndarray, q=2, tao=252.0):
+    T, K = ret.shape
+    if T <= q or T <= K:
+        raise ValueError("T <= q or T <= K")
+    w = 0.5 ** (np.arange(T - 1, -1, -1) / tao)
+    w = w / w.sum()
+    d = ret - (w[:, None] * ret).sum(axis=0)
+    V = np.zeros((K, K))
+    for t in range(T):
+        V += w[t] * np.outer(d[t], d[t])
+    for lag in range(1, q + 1):
+        G = np.zeros((K, K))
+        for t in range(T - lag):
+            G += w[lag + t] * np.outer(d[t], d[t + lag])
+        V += (1 - lag / (1 + q)) * (G + G.T)
+    return V
+
+
+# ---------------------------------------------------------------------------
+# eigenfactor risk adjustment (contract: utils.py:55-92), draws injected
+# ---------------------------------------------------------------------------
+
+def golden_eigen_adj(cov, draws, scale_coef=1.4):
+    """draws: (M, K, T_sim) standard normal. Scaling convention
+    b_m = sqrt(D0) * N_m (distribution identical to the reference's
+    multivariate_normal(0, diag(D0)))."""
+    D0, U0 = np.linalg.eigh(cov)
+    v = []
+    for Nm in draws:
+        bm = np.sqrt(np.maximum(D0, 0))[:, None] * Nm
+        fm = U0 @ bm
+        Fm = np.cov(fm)
+        Dm, Um = np.linalg.eigh(Fm)
+        Dm_hat = np.diagonal(Um.T @ cov @ Um)
+        v.append(Dm_hat / Dm)
+    v = np.sqrt(np.mean(np.array(v), axis=0))
+    v = scale_coef * (v - 1) + 1
+    return (U0 * (v**2 * D0)[None, :]) @ U0.T
+
+
+# ---------------------------------------------------------------------------
+# vol regime adjustment (contract: MFM.py:130-167)
+# ---------------------------------------------------------------------------
+
+def golden_vol_regime(factor_ret, factor_var, tao=42.0):
+    """factor_ret (T, K); factor_var (T, K) with NaN rows for invalid dates.
+    Returns lamb (T,)."""
+    T = factor_ret.shape[0]
+    B = np.sqrt(np.mean(factor_ret**2 / factor_var, axis=1))
+    weights = 0.5 ** (np.arange(T - 1, -1, -1) / tao)
+    lamb = []
+    for t in range(1, T + 1):
+        okidx = np.isnan(factor_var[:t]).sum(axis=1) == 0
+        wsel = weights[:t][okidx]
+        if wsel.sum() == 0:
+            lamb.append(0.0)
+            continue
+        okw = wsel / wsel.sum()
+        lamb.append(np.sqrt(np.sum(okw * B[:t][okidx] ** 2)))
+    return np.array(lamb)
+
+
+# ---------------------------------------------------------------------------
+# rolling factors (contracts: Barra_factor_cal/factor_calculator.py)
+# ---------------------------------------------------------------------------
+
+def golden_beta_hsigma(ret: pd.Series, market: pd.Series, T=252, hl=63, minp=42):
+    """Per-stock rolling WLS via statsmodels, exactly the reference's recipe
+    (factor_calculator.py:86-122)."""
+    decay = 0.5 ** (1 / hl)
+    weights = decay ** np.arange(T - 1, -1, -1)
+    frame = pd.DataFrame({"ret": ret.values, "market_ret": market.values})
+    betas, hsigmas = [], []
+    for w in frame.rolling(window=T, min_periods=1):
+        d = w.dropna()
+        if d.shape[0] < minp:
+            betas.append(np.nan)
+            hsigmas.append(np.nan)
+            continue
+        params, scale = wls_fit(
+            d["ret"].to_numpy(),
+            add_constant(d["market_ret"].to_numpy()),
+            weights[-d.shape[0]:],
+        )
+        betas.append(params[1])
+        hsigmas.append(np.sqrt(scale))
+    return np.array(betas), np.array(hsigmas)
+
+
+def golden_rstr(log_ret: pd.Series, T=504, L=21, hl=126, minp=42):
+    W = T - L
+    decay = 0.5 ** (1 / hl)
+    weights = decay ** np.arange(0, W)
+
+    def calc(window_s):
+        ws = pd.Series(weights[: len(window_s)], index=window_s.index)
+        valid = window_s.dropna()
+        if len(valid) < minp:
+            return np.nan
+        vw = ws.loc[valid.index]
+        return float(np.sum(valid * (vw / vw.sum())))
+
+    return (
+        log_ret.shift(L)
+        .rolling(window=W, min_periods=minp)
+        .apply(calc, raw=False)
+        .to_numpy()
+    )
+
+
+def golden_dastd(excess: pd.Series, T=252, hl=42, minp=42):
+    decay = 0.5 ** (1 / hl)
+    weights = decay ** np.arange(T - 1, -1, -1)
+
+    def calc(window_s):
+        valid = window_s.dropna()
+        if len(valid) < minp:
+            return np.nan
+        ws = pd.Series(weights[-len(valid):], index=valid.index)
+        nw = ws / ws.sum()
+        mu = float(np.sum(valid * nw))
+        return float(np.sqrt(np.sum(nw * (valid - mu) ** 2)))
+
+    return excess.rolling(window=T, min_periods=minp).apply(calc, raw=False).to_numpy()
+
+
+def golden_cmra(log_ret: pd.Series, T=252):
+    def calc(window_s):
+        if window_s.shape[0] < T:
+            return np.nan
+        z = np.exp(window_s.cumsum()) - 1
+        return float(np.log(1 + z.max()) - np.log(1 + z.min()))
+
+    return log_ret.rolling(window=T).apply(calc, raw=False).to_numpy()
+
+
+def golden_liquidity(turnover_pct: pd.Series):
+    dtv = turnover_pct / 100.0
+    out = {}
+    for name, (w, mp) in {
+        "STOM": (21, 15), "STOQ": (63, 42), "STOA": (252, 126),
+    }.items():
+        base = dtv.rolling(window=w, min_periods=mp).sum()
+        out[name] = np.log(base.replace(0, np.nan)).to_numpy()
+    return out
+
+
+def golden_winsorize(df, cols, n_std=2.5):
+    out = df.copy()
+    f = lambda x: x.clip(lower=x.mean() - n_std * x.std(), upper=x.mean() + n_std * x.std())
+    for c in cols:
+        out[c] = out.groupby("trade_date")[c].transform(f)
+    return out
+
+
+def golden_composite(df, components, weights):
+    num = pd.Series(0.0, index=df.index)
+    den = pd.Series(0.0, index=df.index)
+    for comp, w in zip(components, weights):
+        num += df[comp].fillna(0) * w
+        den += df[comp].notna() * w
+    return (num / den).to_numpy()
+
+
+def golden_ortho(df, target, regressors):
+    def reg(g):
+        y = g[target]
+        X = g[list(regressors)]
+        valid = pd.concat([y, X], axis=1).dropna().index
+        if len(valid) < len(regressors) + 2:
+            return pd.Series(np.nan, index=g.index)
+        params, _ = wls_fit(
+            y.loc[valid].to_numpy(), add_constant(X.loc[valid].to_numpy())
+        )
+        resid = y.loc[valid].to_numpy() - add_constant(X.loc[valid].to_numpy()) @ params
+        return pd.Series(resid, index=valid).reindex(g.index)
+
+    res = df.groupby("trade_date", group_keys=False).apply(reg, include_groups=False)
+    return res.to_numpy()
+
+
+def golden_nlsize(df):
+    """Per-date OLS of SIZE^3 on SIZE; NLSIZE = -resid
+    (factor_calculator.py:252-275)."""
+    def reg(g):
+        v = g[["SIZE"]].dropna()
+        if v.shape[0] < 2:
+            return pd.Series(np.nan, index=g.index)
+        X = add_constant(v["SIZE"].to_numpy())
+        y = v["SIZE"].to_numpy() ** 3
+        params, _ = wls_fit(y, X)
+        return pd.Series(-(y - X @ params), index=v.index).reindex(g.index)
+
+    return df.groupby("trade_date", group_keys=False).apply(reg, include_groups=False).to_numpy()

@@ -1,0 +1,126 @@
+"""Parity: Newey-West scan, eigenfactor adjustment, vol-regime scan, bias
+stats vs loopy NumPy goldens."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mfm_tpu.models.newey_west import newey_west, newey_west_expanding
+from mfm_tpu.models.eigen import (
+    eigen_risk_adjust,
+    eigen_risk_adjust_by_time,
+    simulated_eigen_covs,
+)
+from mfm_tpu.models.vol_regime import vol_regime_adjust_by_time
+from mfm_tpu.models.bias import eigenfactor_bias_stat, bayes_shrink
+
+import golden
+
+
+@pytest.fixture(scope="module")
+def fret():
+    rng = np.random.default_rng(7)
+    T, K = 90, 5
+    # AR-ish factor returns so NW lag terms matter
+    e = 0.01 * rng.standard_normal((T, K))
+    f = np.copy(e)
+    for t in range(1, T):
+        f[t] += 0.4 * f[t - 1]
+    return f
+
+
+def test_newey_west_single_matches_golden(fret):
+    V = np.asarray(newey_west(jnp.asarray(fret), q=2, half_life=252.0))
+    G = golden.golden_newey_west(fret, q=2, tao=252.0)
+    np.testing.assert_allclose(V, G, rtol=1e-10, atol=1e-16)
+
+
+def test_newey_west_expanding_matches_per_window(fret):
+    T, K = fret.shape
+    covs, valid = newey_west_expanding(jnp.asarray(fret), q=2, half_life=252.0)
+    covs, valid = np.asarray(covs), np.asarray(valid)
+    for t in range(1, T + 1):
+        if t <= 2 or t <= K:
+            assert not valid[t - 1]
+            continue
+        assert valid[t - 1]
+        G = golden.golden_newey_west(fret[:t], q=2, tao=252.0)
+        np.testing.assert_allclose(covs[t - 1], G, rtol=1e-8, atol=1e-14)
+
+
+def test_newey_west_expanding_jits_and_scales(fret):
+    f = jnp.asarray(np.tile(fret, (1, 8)))  # K=40, close to the real K=39
+    covs, valid = jax.jit(lambda r: newey_west_expanding(r, 2, 252.0))(f)
+    assert covs.shape == (fret.shape[0], 40, 40)
+
+
+def test_eigen_adjust_matches_golden_with_injected_draws(fret):
+    K = fret.shape[1]
+    cov = golden.golden_newey_west(fret, 2, 252.0)
+    rng = np.random.default_rng(3)
+    draws = rng.standard_normal((16, K, 200))
+    G = golden.golden_eigen_adj(cov, draws, scale_coef=1.4)
+    d = draws - draws.mean(axis=-1, keepdims=True)
+    sim_covs = np.einsum("mkt,mlt->mkl", d, d) / (200 - 1)
+    A = np.asarray(eigen_risk_adjust(jnp.asarray(cov), jnp.asarray(sim_covs), 1.4))
+    np.testing.assert_allclose(A, G, rtol=1e-8, atol=1e-14)
+
+
+def test_eigen_adjust_by_time_masks_invalid(fret):
+    covs, valid = newey_west_expanding(jnp.asarray(fret), q=2, half_life=252.0)
+    sim = simulated_eigen_covs(jax.random.key(0), fret.shape[1], 100, 8,
+                               dtype=jnp.float64)
+    out, ok = eigen_risk_adjust_by_time(covs, valid, sim, 1.4)
+    out, ok = np.asarray(out), np.asarray(ok)
+    assert np.all(np.isnan(out[~ok]))
+    assert np.all(np.isfinite(out[ok]))
+    # adjustment preserves symmetry and total variance direction
+    for t in np.nonzero(ok)[0][:5]:
+        np.testing.assert_allclose(out[t], out[t].T, rtol=1e-10)
+
+
+def test_vol_regime_matches_golden(fret):
+    T, K = fret.shape
+    rng = np.random.default_rng(5)
+    var = 1e-4 * (1 + rng.random((T, K)))
+    var[:10] = np.nan  # invalid early dates
+    covs = np.stack([np.diag(v) for v in np.where(np.isnan(var), np.nan, var)])
+    valid = ~np.isnan(var).any(axis=1)
+    adj, lamb = vol_regime_adjust_by_time(
+        jnp.asarray(fret), jnp.asarray(covs), jnp.asarray(valid), half_life=42.0
+    )
+    G = golden.golden_vol_regime(fret, var, tao=42.0)
+    np.testing.assert_allclose(np.asarray(lamb), G, rtol=1e-9, atol=1e-12)
+    t = T - 1
+    np.testing.assert_allclose(
+        np.asarray(adj[t]), covs[t] * G[t] ** 2, rtol=1e-9
+    )
+
+
+def test_bias_stat_runs_and_is_finite(fret):
+    covs, valid = newey_west_expanding(jnp.asarray(fret), q=2, half_life=252.0)
+    b = eigenfactor_bias_stat(covs, valid, jnp.asarray(fret), predlen=5)
+    b = np.asarray(b)
+    assert b.shape == (fret.shape[1],)
+    assert np.all(np.isfinite(b))
+
+
+def test_bayes_shrink_matches_loopy_numpy():
+    rng = np.random.default_rng(11)
+    N = 400
+    vol = np.abs(rng.normal(0.02, 0.01, N))
+    cap = np.exp(rng.normal(11, 1, N))
+    got = np.asarray(bayes_shrink(jnp.asarray(vol), jnp.asarray(cap), 10, 1.0))
+    # loopy golden (contract utils.py:133-168) with the same quantile edges
+    qs = np.quantile(cap, np.linspace(0, 1, 11)[1:-1])
+    group = np.searchsorted(qs, cap, side="left")
+    expect = np.empty(N)
+    for g in range(10):
+        sel = group == g
+        m = np.sum(vol[sel] * cap[sel]) / np.sum(cap[sel])
+        s = np.sqrt(np.mean((vol[sel] - m) ** 2))
+        a = 1.0 * np.abs(vol[sel] - m)
+        v = a / (a + s)
+        expect[sel] = v * m + (1 - v) * np.abs(vol[sel])
+    np.testing.assert_allclose(got, expect, rtol=1e-10)
