@@ -1,0 +1,164 @@
+"""End-to-end FactorEngine parity vs a long-frame pandas golden pipeline.
+
+The golden path rebuilds the reference's master-frame semantics: one row per
+(stock, traded day), per-stock rolling over the stock's own rows, per-date
+cross-sections — then results are compared at observed (date, stock) cells.
+"""
+
+import numpy as np
+import pandas as pd
+import jax.numpy as jnp
+import pytest
+
+from mfm_tpu.config import FactorConfig, RollingSpec
+from mfm_tpu.data.synthetic import synthetic_market_panel
+from mfm_tpu.factors.engine import FactorEngine
+
+import golden
+
+CFG = FactorConfig(
+    beta=RollingSpec(window=40, half_life=10, min_periods=8),
+    rstr_total=60, rstr_lag=5, rstr_half_life=15, rstr_min_periods=8,
+    dastd=RollingSpec(window=40, half_life=8, min_periods=8),
+    cmra_window=30,
+    stom=RollingSpec(window=10, min_periods=7),
+    stoq=RollingSpec(window=21, min_periods=14),
+    stoa=RollingSpec(window=42, min_periods=21),
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    data = synthetic_market_panel(T=130, N=25, n_industries=5, seed=3,
+                                  missing=0.03, listing_gap=0.3)
+    fields = {
+        k: jnp.asarray(v)
+        for k, v in data.items()
+        if k not in ("dates", "stocks", "industry", "index_close", "observed",
+                     "end_date_code")
+    }
+    fields["end_date_code"] = jnp.asarray(data["end_date_code"])
+    eng = FactorEngine(fields, jnp.asarray(data["index_close"]), config=CFG)
+    out = {k: np.asarray(v) for k, v in eng.run(post_process=False).items()}
+    return data, out
+
+
+def _stock_frames(data):
+    """Per-stock long series over that stock's observed days only."""
+    obs = data["observed"]
+    mkt = pd.Series(data["index_close"]).pct_change().to_numpy()
+    frames = {}
+    for n in range(obs.shape[1]):
+        sel = obs[:, n]
+        close = pd.Series(data["close"][sel, n])
+        frames[n] = dict(
+            t_index=np.nonzero(sel)[0],
+            ret=close.pct_change(),
+            log_ret=np.log(close) - np.log(close.shift(1)),
+            market=pd.Series(mkt[sel]),
+            turnover=pd.Series(data["turnover_rate"][sel, n]),
+        )
+    return frames
+
+
+def test_returns_match_per_stock_pct_change(setup):
+    data, out = setup
+    for n, f in _stock_frames(data).items():
+        got = out["ret"][f["t_index"], n]
+        np.testing.assert_allclose(got, f["ret"].to_numpy(), rtol=1e-10,
+                                   atol=1e-14, equal_nan=True)
+
+
+def test_beta_hsigma_end_to_end(setup):
+    data, out = setup
+    for n, f in _stock_frames(data).items():
+        gb, gh = golden.golden_beta_hsigma(
+            f["ret"], f["market"],
+            T=CFG.beta.window, hl=CFG.beta.half_life, minp=CFG.beta.min_periods,
+        )
+        np.testing.assert_allclose(out["BETA"][f["t_index"], n], gb,
+                                   rtol=1e-6, atol=1e-9, equal_nan=True)
+        np.testing.assert_allclose(out["HSIGMA"][f["t_index"], n], gh,
+                                   rtol=1e-6, atol=1e-9, equal_nan=True)
+
+
+def test_rstr_dastd_cmra_end_to_end(setup):
+    data, out = setup
+    for n, f in _stock_frames(data).items():
+        g_rstr = golden.golden_rstr(f["log_ret"], T=CFG.rstr_total, L=CFG.rstr_lag,
+                                    hl=CFG.rstr_half_life, minp=CFG.rstr_min_periods)
+        np.testing.assert_allclose(out["RSTR"][f["t_index"], n], g_rstr,
+                                   rtol=1e-7, atol=1e-11, equal_nan=True)
+        g_dastd = golden.golden_dastd(f["ret"] - f["market"], T=CFG.dastd.window,
+                                      hl=CFG.dastd.half_life,
+                                      minp=CFG.dastd.min_periods)
+        np.testing.assert_allclose(out["DASTD"][f["t_index"], n], g_dastd,
+                                   rtol=1e-7, atol=1e-11, equal_nan=True)
+        g_cmra = golden.golden_cmra(f["log_ret"], T=CFG.cmra_window)
+        np.testing.assert_allclose(out["CMRA"][f["t_index"], n], g_cmra,
+                                   rtol=1e-7, atol=1e-11, equal_nan=True)
+
+
+def test_liquidity_end_to_end(setup):
+    data, out = setup
+    for n, f in _stock_frames(data).items():
+        dtv = f["turnover"] / 100.0
+        for name, (w, mp) in {
+            "STOM": (CFG.stom.window, CFG.stom.min_periods),
+            "STOQ": (CFG.stoq.window, CFG.stoq.min_periods),
+            "STOA": (CFG.stoa.window, CFG.stoa.min_periods),
+        }.items():
+            base = dtv.rolling(w, min_periods=mp).sum()
+            g = np.log(base.replace(0, np.nan)).to_numpy()
+            np.testing.assert_allclose(out[name][f["t_index"], n], g,
+                                       rtol=1e-9, atol=1e-12, equal_nan=True)
+
+
+def test_elementwise_factors(setup):
+    data, out = setup
+    obs = data["observed"]
+    np.testing.assert_allclose(
+        out["SIZE"][obs], np.log(data["total_mv"][obs]), rtol=1e-12
+    )
+    pb = data["pb"][obs]
+    bp = out["BP"][obs]
+    np.testing.assert_allclose(bp[pb > 0], 1 / pb[pb > 0], rtol=1e-12)
+    assert np.all(np.isnan(bp[~(pb > 0)]))
+    np.testing.assert_allclose(
+        out["YOYProfit"][obs], data["q_profit_yoy"][obs] / 100, rtol=1e-12
+    )
+    book = data["total_hldr_eqy_inc_min_int"][obs]
+    blev = out["BLEV"][obs]
+    expect = (book + data["total_ncl"][obs]) / book
+    np.testing.assert_allclose(blev[book > 0], expect[book > 0], rtol=1e-12)
+    assert np.all(np.isnan(blev[~(book > 0)]))
+
+
+def test_nlsize_matches_per_date_regression(setup):
+    data, out = setup
+    obs = data["observed"]
+    size = np.where(obs, np.log(data["total_mv"]), np.nan)
+    ti, si = np.nonzero(obs)
+    df = pd.DataFrame({"trade_date": ti, "SIZE": size[ti, si]})
+    g = golden.golden_nlsize(df)
+    np.testing.assert_allclose(out["NLSIZE"][ti, si], g, rtol=1e-7, atol=1e-10,
+                               equal_nan=True)
+
+
+def test_cetop_ttm_semantics(setup):
+    data, out = setup
+    obs = data["observed"]
+    # golden TTM: unique (stock, report) pairs in order, rolling-4 sum
+    T, N = obs.shape
+    for n in range(N):
+        sel = obs[:, n]
+        rid = data["end_date_code"][sel, n]
+        cash = data["n_cashflow_act"][sel, n]
+        rep = pd.DataFrame({"rid": rid, "v": cash}).drop_duplicates("rid")
+        rep["ttm"] = rep["v"].rolling(4, min_periods=4).sum()
+        ttm_by_rid = dict(zip(rep["rid"], rep["ttm"]))
+        mv = data["total_mv"][sel, n]
+        expect_ttm = np.array([ttm_by_rid.get(r, np.nan) for r in rid])
+        expect = np.where((mv > 0) & (expect_ttm > 0), expect_ttm / mv, np.nan)
+        np.testing.assert_allclose(out["CETOP"][np.nonzero(sel)[0], n], expect,
+                                   rtol=1e-9, atol=1e-12, equal_nan=True)
