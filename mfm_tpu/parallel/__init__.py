@@ -1,0 +1,22 @@
+"""Device-mesh construction and sharding specs for the pipeline.
+
+The reference has no parallelism at all (SURVEY.md §2.4) — its serial axes
+(dates for cross-sections/eigen-MC, stocks for rolling windows) are exactly
+the axes this package shards over the TPU mesh.
+"""
+
+from mfm_tpu.parallel.mesh import (
+    make_mesh,
+    panel_sharding,
+    replicated,
+    shard_panel,
+    PIPELINE_SPECS,
+)
+
+__all__ = [
+    "make_mesh",
+    "panel_sharding",
+    "replicated",
+    "shard_panel",
+    "PIPELINE_SPECS",
+]

@@ -1,0 +1,77 @@
+"""Mesh + sharding layout for the risk pipeline.
+
+Layout doctrine (SURVEY.md §2.4 / §7):
+
+- mesh axes ``('date', 'stock')``;
+- (T, N) panels shard as ``P('date', 'stock')``;
+- the cross-sectional regression vmaps over dates (embarrassingly parallel
+  along 'date') while its stock-axis reductions (normal equations
+  ``X' W X``, per-industry cap sums, masked means/stds) contract the 'stock'
+  axis — XLA inserts psums over ICI automatically;
+- factor-return series and KxK covariances are tiny: replicated;
+- rolling kernels are parallel along 'stock' and windowed along time, so
+  their natural layout is ``P(None, ('date', 'stock'))`` — the whole mesh
+  shards the stock axis and the time axis stays local (windows never cross
+  devices).  ``shard_panel(..., rolling=True)`` gives that layout.
+
+Everything here is classic auto-sharding (jit + NamedSharding constraints);
+no manual collectives are needed anywhere in the pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    n_date: int | None = None,
+    n_stock: int = 1,
+    devices: Sequence | None = None,
+) -> Mesh:
+    """Build a ('date', 'stock') mesh over the available devices.
+
+    Default: all devices on the 'date' axis (the cross-sectional stage is the
+    dominant cost and is embarrassingly parallel over dates).
+    """
+    devs = np.array(devices if devices is not None else jax.devices())
+    if n_date is None:
+        n_date = devs.size // n_stock
+    return Mesh(devs.reshape(n_date, n_stock), ("date", "stock"))
+
+
+def panel_sharding(mesh: Mesh, *, rolling: bool = False) -> NamedSharding:
+    """Sharding for a (T, N, ...) panel.
+
+    cross-sectional layout: date axis over 'date', stock axis over 'stock'.
+    rolling layout: time axis local, stock axis over the *whole* mesh.
+    """
+    if rolling:
+        return NamedSharding(mesh, P(None, ("date", "stock")))
+    return NamedSharding(mesh, P("date", "stock"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_panel(x, mesh: Mesh, *, rolling: bool = False):
+    """device_put a (T, N, ...) array (or pytree of them) onto the mesh."""
+    s = panel_sharding(mesh, rolling=rolling)
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a, s), x)
+
+
+# canonical in_shardings for the risk-model stages, keyed by argument name
+PIPELINE_SPECS = {
+    "ret": P("date", "stock"),
+    "cap": P("date", "stock"),
+    "styles": P("date", "stock", None),
+    "industry": P("date", "stock"),
+    "valid": P("date", "stock"),
+    "factor_ret": P("date", None),
+    "covs": P("date", None, None),
+    "sim_covs": P(),
+}
