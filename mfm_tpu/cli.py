@@ -1,0 +1,145 @@
+"""Command-line drivers (the reference has none — plain scripts only,
+SURVEY.md §1 L7).
+
+  python -m mfm_tpu.cli risk --barra barra_data.csv --out results/
+  python -m mfm_tpu.cli factors --panel panel.parquet --industry ind.csv --out results/
+  python -m mfm_tpu.cli demo --out results/          # synthetic end-to-end
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def _risk(args):
+    import numpy as np
+    from mfm_tpu.config import PipelineConfig, RiskModelConfig
+    from mfm_tpu.data.barra import load_barra_csv
+    from mfm_tpu.pipeline import run_risk_pipeline
+
+    cfg = PipelineConfig(
+        risk=RiskModelConfig(
+            nw_lags=args.nw_lags, nw_half_life=args.nw_half_life,
+            eigen_n_sims=args.eigen_sims, eigen_scale_coef=args.eigen_scale,
+            vol_regime_half_life=args.vr_half_life, seed=args.seed,
+        ),
+        dtype=args.dtype,
+    )
+    arrays = load_barra_csv(args.barra, args.industry_info)
+    t0 = time.perf_counter()
+    res = run_risk_pipeline(arrays=arrays, config=cfg)
+    os.makedirs(args.out, exist_ok=True)
+    res.factor_returns().to_csv(os.path.join(args.out, "factor_returns.csv"))
+    res.r_squared().to_csv(os.path.join(args.out, "r_squared.csv"))
+    res.specific_returns().to_csv(os.path.join(args.out, "specific_returns.csv"))
+    res.final_covariance().to_csv(os.path.join(args.out, "final_covariance.csv"))
+    res.lambda_series().to_csv(os.path.join(args.out, "lambda.csv"))
+    wall = time.perf_counter() - t0
+    print(json.dumps({
+        "dates": int(arrays.ret.shape[0]), "stocks": int(arrays.ret.shape[1]),
+        "factors": len(arrays.factor_names()), "wall_s": round(wall, 3),
+        "mean_r2": float(np.nanmean(np.asarray(res.outputs.r2))),
+    }))
+
+
+def _factors(args):
+    import numpy as np
+    import pandas as pd
+    from mfm_tpu.config import PipelineConfig
+    from mfm_tpu.panel import Panel
+    from mfm_tpu.pipeline import run_factor_pipeline
+
+    panel_df = (pd.read_parquet(args.panel) if args.panel.endswith(".parquet")
+                else pd.read_csv(args.panel, parse_dates=["trade_date"]))
+    index_df = (pd.read_parquet(args.index) if args.index.endswith(".parquet")
+                else pd.read_csv(args.index, parse_dates=["trade_date"]))
+    ind_df = pd.read_csv(args.industry)
+
+    p = Panel.from_long(panel_df)
+    idx_close = (
+        index_df.set_index("trade_date")["close"].reindex(pd.Index(p.dates)).to_numpy()
+    )
+    l1 = (
+        ind_df.drop_duplicates("ts_code").set_index("ts_code")["l1_code"]
+        .reindex(p.stocks).to_numpy()
+    )
+    # report id for TTM: rank-encode end_date per cell if provided
+    if "end_date" in p.fields:
+        ed = np.asarray(p.fields["end_date"])
+        ok = np.isfinite(ed)
+        codes = np.unique(ed[ok])
+        rid = np.full(ed.shape, -1, np.int32)
+        rid[ok] = np.searchsorted(codes, ed[ok]).astype(np.int32)
+        p.fields["end_date_code"] = rid
+        del p.fields["end_date"]
+    barra, _ = run_factor_pipeline(
+        p.fields, idx_close, l1, p.dates, p.stocks, PipelineConfig(dtype=args.dtype)
+    )
+    os.makedirs(args.out, exist_ok=True)
+    out_path = os.path.join(args.out, "barra_data.csv")
+    barra.to_csv(out_path, index=False)
+    print(json.dumps({"rows": len(barra), "out": out_path}))
+
+
+def _demo(args):
+    from mfm_tpu.config import PipelineConfig, RiskModelConfig
+    from mfm_tpu.data.synthetic import synthetic_barra_table
+    from mfm_tpu.pipeline import run_risk_pipeline
+
+    df, _ = synthetic_barra_table(T=args.dates, N=args.stocks, P=args.industries,
+                                  Q=args.styles, seed=0)
+    cfg = PipelineConfig(risk=RiskModelConfig(eigen_n_sims=args.eigen_sims),
+                         dtype=args.dtype)
+    t0 = time.perf_counter()
+    res = run_risk_pipeline(barra_df=df, config=cfg)
+    os.makedirs(args.out, exist_ok=True)
+    res.factor_returns().to_csv(os.path.join(args.out, "factor_returns.csv"))
+    res.final_covariance().to_csv(os.path.join(args.out, "final_covariance.csv"))
+    print(json.dumps({"wall_s": round(time.perf_counter() - t0, 3),
+                      "out": args.out}))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="mfm_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    r = sub.add_parser("risk", help="risk model over a barra-format CSV (demo.py path)")
+    r.add_argument("--barra", required=True)
+    r.add_argument("--industry-info", default=None)
+    r.add_argument("--out", default="results")
+    r.add_argument("--nw-lags", type=int, default=2)
+    r.add_argument("--nw-half-life", type=float, default=252.0)
+    r.add_argument("--eigen-sims", type=int, default=100)
+    r.add_argument("--eigen-scale", type=float, default=1.4)
+    r.add_argument("--vr-half-life", type=float, default=42.0)
+    r.add_argument("--seed", type=int, default=0)
+    r.add_argument("--dtype", default="float32")
+    r.set_defaults(fn=_risk)
+
+    f = sub.add_parser("factors", help="style-factor production (main.py path)")
+    f.add_argument("--panel", required=True, help="long csv/parquet of raw fields")
+    f.add_argument("--index", required=True, help="index daily prices csv/parquet")
+    f.add_argument("--industry", required=True, help="ts_code -> l1_code csv")
+    f.add_argument("--out", default="results")
+    f.add_argument("--dtype", default="float32")
+    f.set_defaults(fn=_factors)
+
+    d = sub.add_parser("demo", help="synthetic end-to-end risk model")
+    d.add_argument("--dates", type=int, default=120)
+    d.add_argument("--stocks", type=int, default=60)
+    d.add_argument("--industries", type=int, default=6)
+    d.add_argument("--styles", type=int, default=4)
+    d.add_argument("--eigen-sims", type=int, default=16)
+    d.add_argument("--out", default="results")
+    d.add_argument("--dtype", default="float32")
+    d.set_defaults(fn=_demo)
+
+    args = ap.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""Point-in-time alignment: statement dedup, as-of joins, fill policy.
+
+Contracts from ``Barra_factor_cal/load_data.py``:
+
+- :func:`dedup_statements` — two-pass dedup (``load_data.py:264-310``): keep
+  the latest announcement per (stock, report period), then the latest report
+  period per (stock, announcement).
+- :func:`asof_join` — for each (stock, trade day), the row of the statement
+  table with the newest announcement date <= trade day
+  (``load_data.py:324-378``).  The reference loops Python over stocks and
+  calls ``pd.merge_asof`` per chunk (``load_data.py:41-62``); here one
+  vectorized ``searchsorted`` over the whole sorted table does all stocks at
+  once.
+- :func:`fill_missing` — per-stock ffill then fill 0 (``load_data.py:390-408``).
+  NOTE (reference quirk, SURVEY.md §7.3): the reference *also* has a per-date
+  cross-sectional median fill (``load_data.py:409-418``) but runs it after
+  ``fillna(0)`` has already removed every NaN — it is dead code.  We default
+  to the effective behavior (ffill -> 0) and expose the evidently intended
+  order (ffill -> daily median -> 0) behind ``median_fill=True``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+try:
+    import pandas as pd
+except Exception:  # pragma: no cover
+    pd = None
+
+
+def dedup_statements(df, by: str = "ts_code", ann_col: str = "f_ann_date",
+                     end_col: str = "end_date"):
+    """Two-pass statement dedup (``load_data.py:268-278``)."""
+    df = df.sort_values([by, end_col, ann_col], ascending=[True, True, False])
+    df = df.drop_duplicates(subset=[by, end_col], keep="first")
+    df = df.sort_values([by, ann_col, end_col], ascending=[True, True, False])
+    df = df.drop_duplicates(subset=[by, ann_col], keep="first")
+    return df
+
+
+def asof_join(left, right, *, left_on: str, right_on: str, by: str = "ts_code",
+              suffix: str = "_stmt"):
+    """Backward as-of join of ``right`` onto ``left`` per ``by`` group.
+
+    Equivalent to the reference's per-stock ``pd.merge_asof(...,
+    direction='backward')`` loop, implemented as one searchsorted over a
+    (group, time) composite key — O((L+R) log R) total, no Python loop.
+    """
+    if pd is None:  # pragma: no cover
+        raise ImportError("pandas required")
+    left = left.sort_values([by, left_on], kind="mergesort").reset_index(drop=True)
+    right = right.sort_values([by, right_on], kind="mergesort").reset_index(drop=True)
+
+    # composite integer keys: group id * big + time rank
+    keys = pd.unique(pd.concat([left[by], right[by]], ignore_index=True))
+    gid = {k: i for i, k in enumerate(keys)}
+    lg = left[by].map(gid).to_numpy(np.int64)
+    rg = right[by].map(gid).to_numpy(np.int64)
+    lt = left[left_on].to_numpy().astype("datetime64[ns]").astype(np.int64)
+    rt = right[right_on].to_numpy().astype("datetime64[ns]").astype(np.int64)
+
+    # rank-compress times so (group, time) packs into one int64 key
+    uniq = np.unique(np.concatenate([lt, rt]))
+    ltr = np.searchsorted(uniq, lt)
+    rtr = np.searchsorted(uniq, rt)
+    stride = np.int64(len(uniq) + 1)
+    lkey = lg * stride + ltr
+    rkey = rg * stride + rtr
+    pos = np.searchsorted(rkey, lkey, side="right") - 1
+    ok = pos >= 0
+    ok &= np.where(ok, rg[np.maximum(pos, 0)] == lg, False)
+
+    out = left.copy()
+    rcols = [c for c in right.columns if c != by]
+    for c in rcols:
+        vals = right[c].to_numpy()
+        take = np.where(ok, np.maximum(pos, 0), 0)
+        col = vals[take]
+        col = pd.Series(col).where(ok, other=pd.NA)
+        name = c if c not in out.columns else c + suffix
+        out[name] = col.to_numpy()
+    return out
+
+
+def fill_missing(df, cols: Sequence[str], by: str = "ts_code",
+                 date_col: str = "trade_date", median_fill: bool = False):
+    """Missing-value policy over the merged master frame
+    (``load_data.py:390-418``)."""
+    if pd is None:  # pragma: no cover
+        raise ImportError("pandas required")
+    df = df.sort_values([by, date_col]).reset_index(drop=True)
+    df[list(cols)] = df.groupby(by, observed=True)[list(cols)].ffill()
+    if median_fill:
+        for c in cols:
+            med = df.groupby(date_col)[c].transform("median")
+            df[c] = df[c].fillna(med)
+    df[list(cols)] = df[list(cols)].fillna(0)
+    return df

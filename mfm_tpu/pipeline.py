@@ -1,0 +1,172 @@
+"""End-to-end pipelines: raw panel -> factor table -> barra assembly -> risk model.
+
+The TPU-native equivalents of the reference's two drivers:
+
+- :func:`assemble_barra_table` + :func:`run_factor_pipeline` ≈
+  ``Barra_factor_cal/main.py`` (factor production: compute, post-process,
+  merge industry, shift returns to t+1, rename to barra schema,
+  ``main.py:42-159``)
+- :func:`run_risk_pipeline` ≈ ``Barra-master/demo.py`` (risk model over a
+  barra table, saving factor returns / specific returns / R2 / covariances /
+  lambda, ``demo.py:22-94``)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Mapping
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from mfm_tpu.config import PipelineConfig
+from mfm_tpu.data.barra import BarraArrays, barra_frame_to_arrays
+from mfm_tpu.factors.engine import FactorEngine, rowspace_index, gather_rows, scatter_rows
+from mfm_tpu.models.risk_model import RiskModel, RiskModelOutputs
+
+try:
+    import pandas as pd
+except Exception:  # pragma: no cover
+    pd = None
+
+
+#: composite -> barra output name (Barra_factor_cal/config.py:53-72)
+BARRA_OUTPUT_STYLES = (
+    ("SIZE", "size"),
+    ("BETA", "beta"),
+    ("RSTR", "momentum"),
+    ("volatility", "residual_volatility"),
+    ("NLSIZE", "non_linear_size"),
+    ("BP", "book_to_price_ratio"),
+    ("liquidity", "liquidity"),
+    ("earnings", "earnings_yield"),
+    ("growth", "growth"),
+    ("leverage", "leverage"),
+)
+
+
+def shift_ret_next_period(ret, observed):
+    """t+1 return label: each (stock, day) row gets the stock's *next traded
+    day* return (``main.py:99``: groupby shift(-1) on the long frame)."""
+    idx = rowspace_index(jnp.asarray(observed))
+    rs = gather_rows(jnp.asarray(ret), idx)
+    shifted = jnp.concatenate(
+        [rs[1:], jnp.full((1, rs.shape[1]), jnp.nan, rs.dtype)], axis=0
+    )
+    return np.asarray(scatter_rows(shifted, idx))
+
+
+def assemble_barra_table(
+    factors: Mapping[str, np.ndarray],
+    dates,
+    stocks,
+    industry_l1,
+    circ_mv,
+    observed,
+):
+    """Long barra-format DataFrame in the reference's output schema.
+
+    factors: dict of (T, N) arrays containing at least the composite names in
+    BARRA_OUTPUT_STYLES plus 'ret'.  industry_l1: (N,) per-stock SW L1 codes.
+    Rows = observed (stock, day) cells; 'ret' is shifted to the next traded
+    day.  Column names/order: ``config.BARRA_OUTPUT_COLUMNS``.
+    """
+    if pd is None:  # pragma: no cover
+        raise ImportError("pandas required")
+    observed = np.asarray(observed, bool)
+    ti, si = np.nonzero(observed)
+    next_ret = shift_ret_next_period(np.asarray(factors["ret"]), observed)
+    data = {
+        "date": np.asarray(dates)[ti],
+        "stocknames": np.asarray(stocks)[si],
+        "capital": np.asarray(circ_mv)[ti, si],
+        "ret": next_ret[ti, si],
+        "industry": np.asarray(industry_l1)[si],
+    }
+    for src, dst in BARRA_OUTPUT_STYLES:
+        data[dst] = np.asarray(factors[src])[ti, si]
+    return pd.DataFrame(data)
+
+
+def run_factor_pipeline(
+    fields: Dict,
+    index_close,
+    industry_l1,
+    dates,
+    stocks,
+    config: PipelineConfig | None = None,
+):
+    """Raw dense panel -> (barra long table, factor dict).
+
+    ``fields`` must include everything :class:`FactorEngine` needs, plus
+    ``circ_mv``.  This is the whole ``Barra_factor_cal/main.py`` path.
+    """
+    config = config or PipelineConfig()
+    dtype = jnp.float64 if config.dtype == "float64" else jnp.float32
+    jfields = {
+        k: (jnp.asarray(v, dtype) if k != "end_date_code" else jnp.asarray(v))
+        for k, v in fields.items()
+    }
+    eng = FactorEngine(jfields, jnp.asarray(index_close, dtype),
+                       config=config.factors)
+    factors = {k: np.asarray(v) for k, v in eng.run().items()}
+    observed = np.isfinite(np.asarray(fields["close"], np.float64))
+    barra = assemble_barra_table(
+        factors, dates, stocks, industry_l1, fields["circ_mv"], observed
+    )
+    return barra, factors
+
+
+@dataclasses.dataclass
+class RiskPipelineResult:
+    outputs: RiskModelOutputs
+    arrays: BarraArrays
+    model: RiskModel
+
+    # -- demo.py:60-94 result tables --------------------------------------
+    def factor_returns(self):
+        return pd.DataFrame(np.asarray(self.outputs.factor_ret),
+                            index=self.arrays.dates,
+                            columns=self.arrays.factor_names())
+
+    def r_squared(self):
+        return pd.DataFrame(np.asarray(self.outputs.r2),
+                            index=self.arrays.dates, columns=["R2"])
+
+    def specific_returns(self):
+        return pd.DataFrame(np.asarray(self.outputs.specific_ret),
+                            index=self.arrays.dates, columns=self.arrays.stocks)
+
+    def final_covariance(self):
+        """Last date's fully-adjusted covariance (annualizable), like
+        ``demo.py:84-88``."""
+        return pd.DataFrame(np.asarray(self.outputs.vr_cov[-1]),
+                            index=self.arrays.factor_names(),
+                            columns=self.arrays.factor_names())
+
+    def lambda_series(self):
+        return pd.DataFrame(np.asarray(self.outputs.lamb),
+                            index=self.arrays.dates, columns=["lambda"])
+
+
+def run_risk_pipeline(
+    barra_df=None,
+    arrays: BarraArrays | None = None,
+    config: PipelineConfig | None = None,
+    industry_codes=None,
+    sim_covs=None,
+) -> RiskPipelineResult:
+    """Barra table -> full risk model (the ``demo.py`` path)."""
+    config = config or PipelineConfig()
+    if arrays is None:
+        arrays = barra_frame_to_arrays(barra_df, industry_codes=industry_codes)
+    dtype = jnp.float64 if config.dtype == "float64" else jnp.float32
+    rm = RiskModel(
+        jnp.asarray(arrays.ret, dtype), jnp.asarray(arrays.cap, dtype),
+        jnp.asarray(arrays.styles, dtype), jnp.asarray(arrays.industry),
+        jnp.asarray(arrays.valid), n_industries=arrays.n_industries,
+        config=config.risk, factor_names=arrays.factor_names(),
+    )
+    out = rm.run(sim_covs=sim_covs)
+    return RiskPipelineResult(outputs=out, arrays=arrays, model=rm)

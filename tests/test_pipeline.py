@@ -1,0 +1,114 @@
+"""Integration: raw panel -> factor table -> barra assembly -> risk model."""
+
+import numpy as np
+import pandas as pd
+import jax.numpy as jnp
+import pytest
+
+from mfm_tpu.config import FactorConfig, PipelineConfig, RiskModelConfig, RollingSpec
+from mfm_tpu.data.synthetic import synthetic_market_panel
+from mfm_tpu.pipeline import (
+    assemble_barra_table,
+    run_factor_pipeline,
+    run_risk_pipeline,
+    shift_ret_next_period,
+)
+
+
+def test_shift_ret_is_next_traded_day():
+    ret = np.array([
+        [0.1, 0.01],
+        [0.2, np.nan],
+        [0.3, 0.03],
+        [np.nan, 0.04],
+    ])
+    obs = np.isfinite(ret)
+    out = shift_ret_next_period(ret, obs)
+    # stock 0: next traded day's ret; last observed -> NaN
+    np.testing.assert_allclose(out[:, 0], [0.2, 0.3, np.nan, np.nan], equal_nan=True)
+    # stock 1 skips its suspension: day0 -> day2's ret
+    np.testing.assert_allclose(out[:, 1], [0.03, np.nan, 0.04, np.nan], equal_nan=True)
+
+
+@pytest.fixture(scope="module")
+def full_run():
+    data = synthetic_market_panel(T=140, N=30, n_industries=5, seed=11,
+                                  missing=0.02, listing_gap=0.2)
+    cfg = PipelineConfig(
+        factors=FactorConfig(
+            beta=RollingSpec(window=40, half_life=10, min_periods=8),
+            rstr_total=60, rstr_lag=5, rstr_half_life=15, rstr_min_periods=8,
+            dastd=RollingSpec(window=40, half_life=8, min_periods=8),
+            cmra_window=30,
+            stom=RollingSpec(window=10, min_periods=7),
+            stoq=RollingSpec(window=21, min_periods=14),
+            stoa=RollingSpec(window=42, min_periods=21),
+        ),
+        risk=RiskModelConfig(eigen_n_sims=8, eigen_sim_length=80),
+        dtype="float64",
+    )
+    l1 = np.array([f"sw{c:02d}" for c in data["industry"]])
+    fields = {k: data[k] for k in (
+        "close", "total_mv", "circ_mv", "turnover_rate", "pb", "pe_ttm",
+        "n_cashflow_act", "end_date_code", "q_profit_yoy", "q_sales_yoy",
+        "total_ncl", "total_hldr_eqy_inc_min_int", "debt_to_assets",
+    )}
+    barra, factors = run_factor_pipeline(
+        fields, data["index_close"], l1, data["dates"], data["stocks"], cfg
+    )
+    return data, cfg, barra, factors
+
+
+def test_barra_table_schema(full_run):
+    _, _, barra, _ = full_run
+    assert list(barra.columns) == [
+        "date", "stocknames", "capital", "ret", "industry",
+        "size", "beta", "momentum", "residual_volatility", "non_linear_size",
+        "book_to_price_ratio", "liquidity", "earnings_yield", "growth",
+        "leverage",
+    ]
+    # one row per observed (stock, day)
+    assert not barra.duplicated(["date", "stocknames"]).any()
+
+
+def test_composites_respect_weights(full_run):
+    _, _, _, f = full_run
+    # leverage composite with all three present: exact weighted mean of the
+    # *winsorized* components — recompute from raws via the posted pipeline
+    lev = np.asarray(f["leverage"])
+    comp = [np.asarray(f[c]) for c in ("MLEV", "DTOA", "BLEV")]
+    # cells where all components are missing must be NaN
+    all_missing = np.isnan(comp[0]) & np.isnan(comp[1]) & np.isnan(comp[2])
+    assert np.all(np.isnan(lev[all_missing]))
+
+
+def test_risk_pipeline_end_to_end(full_run):
+    data, cfg, barra, _ = full_run
+    res = run_risk_pipeline(barra_df=barra, config=cfg)
+    T = res.arrays.ret.shape[0]
+    K = len(res.arrays.factor_names())
+    fr = res.factor_returns()
+    assert fr.shape == (T, K)
+    r2 = res.r_squared()["R2"].to_numpy()
+    assert np.nanmean(r2) > 0.05  # synthetic returns have factor structure
+    cov = res.final_covariance().to_numpy()
+    np.testing.assert_allclose(cov, cov.T, rtol=1e-8)
+    lam = res.lambda_series()["lambda"].to_numpy()
+    assert np.isfinite(lam[-1]) and lam[-1] > 0
+
+
+def test_ortho_makes_volatility_orthogonal(full_run):
+    """After per-date orthogonalization, residual_volatility must be
+    uncorrelated with BETA and SIZE on every date (the point of
+    post_processing.py:47-69)."""
+    _, _, _, f = full_run
+    vol = np.asarray(f["volatility"])
+    beta = np.asarray(f["BETA"])
+    size = np.asarray(f["SIZE"])
+    for t in range(90, 100):
+        m = np.isfinite(vol[t]) & np.isfinite(beta[t]) & np.isfinite(size[t])
+        if m.sum() < 5:
+            continue
+        # residuals of OLS on [1, beta, size] are orthogonal to regressors
+        assert abs(np.corrcoef(vol[t][m], beta[t][m])[0, 1]) < 1e-6
+        assert abs(np.corrcoef(vol[t][m], size[t][m])[0, 1]) < 1e-6

@@ -1,0 +1,79 @@
+"""Parity: statement dedup / as-of join / fill policy vs pandas merge_asof."""
+
+import numpy as np
+import pandas as pd
+
+from mfm_tpu.data.pit import asof_join, dedup_statements, fill_missing
+
+
+def _statements(rng, stocks, n_per=14):
+    rows = []
+    for s in stocks:
+        ends = pd.date_range("2019-03-31", periods=n_per, freq="QE")
+        for e in ends:
+            # announcement 30-120 days after period end; occasional revisions
+            for _ in range(1 + (rng.random() < 0.2)):
+                ann = e + pd.Timedelta(days=int(rng.integers(30, 120)))
+                rows.append((s, ann, e, rng.normal()))
+    df = pd.DataFrame(rows, columns=["ts_code", "f_ann_date", "end_date", "val"])
+    return df.sample(frac=1, random_state=0)  # shuffle
+
+
+def test_dedup_keeps_latest_ann_then_latest_end():
+    rng = np.random.default_rng(0)
+    df = _statements(rng, ["A", "B"])
+    out = dedup_statements(df)
+    # one row per (stock, end_date): the one with max f_ann_date
+    grp = df.sort_values("f_ann_date").groupby(["ts_code", "end_date"]).tail(1)
+    assert not out.duplicated(["ts_code", "end_date"]).any()
+    assert not out.duplicated(["ts_code", "f_ann_date"]).any()
+    # every kept (stock, end) row carries the latest announcement for it
+    m = out.merge(grp, on=["ts_code", "end_date"], suffixes=("", "_want"))
+    assert (m["f_ann_date"] == m["f_ann_date_want"]).all()
+
+
+def test_asof_join_matches_pandas_merge_asof():
+    rng = np.random.default_rng(1)
+    stocks = [f"S{i}" for i in range(17)]
+    stmts = dedup_statements(_statements(rng, stocks))
+    days = pd.bdate_range("2020-01-01", periods=260)
+    daily = pd.DataFrame({
+        "ts_code": np.repeat(stocks, len(days)),
+        "trade_date": np.tile(days, len(stocks)),
+        "close": rng.random(len(stocks) * len(days)),
+    })
+    # drop random rows to make universes ragged
+    daily = daily.sample(frac=0.9, random_state=2)
+
+    got = asof_join(daily, stmts[["ts_code", "f_ann_date", "val"]],
+                    left_on="trade_date", right_on="f_ann_date")
+
+    want_chunks = []
+    for s in stocks:  # the reference's per-stock loop (load_data.py:53-60)
+        lc = daily[daily.ts_code == s].sort_values("trade_date")
+        rc = stmts[stmts.ts_code == s].sort_values("f_ann_date")
+        want_chunks.append(pd.merge_asof(
+            lc, rc[["ts_code", "f_ann_date", "val"]],
+            left_on="trade_date", right_on="f_ann_date", by="ts_code",
+            direction="backward",
+        ))
+    want = pd.concat(want_chunks, ignore_index=True)
+
+    got = got.sort_values(["ts_code", "trade_date"]).reset_index(drop=True)
+    want = want.sort_values(["ts_code", "trade_date"]).reset_index(drop=True)
+    np.testing.assert_allclose(
+        got["val"].to_numpy(float), want["val"].to_numpy(float), equal_nan=True
+    )
+    assert (got["f_ann_date"].isna() == want["f_ann_date"].isna()).all()
+
+
+def test_fill_missing_ffill_then_zero():
+    df = pd.DataFrame({
+        "ts_code": ["A"] * 4 + ["B"] * 4,
+        "trade_date": list(pd.bdate_range("2020-01-01", periods=4)) * 2,
+        "x": [np.nan, 1.0, np.nan, 2.0, np.nan, np.nan, 3.0, np.nan],
+    })
+    out = fill_missing(df, ["x"])
+    np.testing.assert_array_equal(
+        out["x"].to_numpy(), [0.0, 1.0, 1.0, 2.0, 0.0, 0.0, 3.0, 3.0]
+    )
