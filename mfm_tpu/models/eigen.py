@@ -6,30 +6,34 @@ eigen-variances, re-estimate and re-decompose each simulated covariance,
 measure the per-eigenvalue bias v, scale ``v <- scale_coef*(v-1)+1``, and
 rebuild ``F0_hat = U0 diag(v^2 * D0) U0'``.
 
-TPU re-design (two structural wins over the reference's loop):
+TPU re-design (three structural wins over the reference's loop):
 
-1. ``np.linalg.eig`` on a symmetric PSD matrix becomes ``jnp.linalg.eigh``
-   (TPU has no general nonsymmetric eig; eigh is the correct reformulation).
+1. ``np.linalg.eig`` on a symmetric PSD matrix becomes a *batched symmetric*
+   eigh — and on TPU the VMEM-resident Pallas Jacobi kernel
+   (:mod:`mfm_tpu.ops.eigh_pallas`), ~4.4x XLA's QDWH at this size.
 2. The reference re-seeds ``np.random.seed(m+1)`` *identically for every
    date* (``utils.py:71-74``), so the M standard-normal draw matrices — and
    therefore their sample covariances C_m — are the same for all dates.  We
    precompute C_m = cov(N_m) once (M tiny KxK matrices) and per date form the
-   simulated covariance as ``F_m = U0 diag(s) C_m diag(s) U0'`` with
-   s = sqrt(D0), which equals ``np.cov(U0 @ (s * N_m))`` exactly.  The
-   T-dates x M-sims Monte-Carlo loop (139k simulations of a (K, T) normal
-   panel in the reference) collapses to M precomputed covariances plus
-   batched KxK matmuls/eighs, vmapped over (dates, sims) and sharded over the
-   date mesh axis.
+   simulated covariance as ``F_m = B C_m B'`` with B = U0 sqrt(D0), which
+   equals ``np.cov`` of the simulated returns exactly.  The T x M Monte-Carlo
+   loop (139k simulations of a (K, T) normal panel in the reference)
+   collapses to M precomputed covariances plus batched KxK einsums/eighs.
+3. All (T, M) decompositions run as ONE flat batch — no per-date dispatch.
 
 Bitwise replication of the reference's draws is impossible by construction
 (np.random's MT19937 + SVD-based multivariate_normal); golden tests inject
-the draws, production uses ``jax.random`` (SURVEY.md §7.3).
+the draws, production uses ``jax.random`` (SURVEY.md §7.3).  Eigenvector
+signs are canonicalized (largest component positive) so results are
+bit-stable across backends/kernels.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from mfm_tpu.ops.eigh import batched_eigh
 
 
 def simulated_eigen_covs(
@@ -45,39 +49,14 @@ def simulated_eigen_covs(
     return jnp.einsum("mkt,mlt->mkl", d, d) / (sim_length - 1)
 
 
-def eigen_risk_adjust(
-    cov: jax.Array,
-    sim_covs: jax.Array,
-    scale_coef: float = 1.4,
-) -> jax.Array:
-    """Adjust one KxK covariance given precomputed simulation covariances.
-
-    ``sim_covs``: (M, K, K) sample covariances of standard-normal draws (unit
-    variance per factor) — the eigen-variance scaling is applied here.
-    """
-    D0, U0 = jnp.linalg.eigh(cov)
-    s = jnp.sqrt(jnp.maximum(D0, 0.0))
-    B = U0 * s[None, :]  # (K, K): maps unit draws to simulated factor returns
-
-    def one_sim(Cm):
-        Fm = B @ Cm @ B.T  # == np.cov of simulated factor returns
-        Dm, Um = jnp.linalg.eigh(Fm)
-        Dm_hat = jnp.einsum("ki,kl,li->i", Um, cov, Um)  # diag(Um' F0 Um)
-        return Dm_hat / Dm
-
-    v2 = jnp.mean(jax.vmap(one_sim)(sim_covs), axis=0)  # (K,)
-    v = jnp.sqrt(v2)
-    v = scale_coef * (v - 1.0) + 1.0
-    return (U0 * (v**2 * D0)[None, :]) @ U0.T
-
-
 def eigen_risk_adjust_by_time(
     covs: jax.Array,
     valid: jax.Array,
     sim_covs: jax.Array,
     scale_coef: float = 1.4,
+    prefer_pallas: bool | None = None,
 ):
-    """vmap of :func:`eigen_risk_adjust` over the date axis.
+    """Batched adjustment over the date axis.
 
     ``covs``: (T, K, K); ``valid``: (T,) — dates whose Newey-West estimate was
     invalid stay invalid, and dates with a negative eigenvalue are marked
@@ -86,10 +65,38 @@ def eigen_risk_adjust_by_time(
     Returns (adjusted covs (T, K, K) with NaN at invalid dates, valid (T,)).
     """
     dtype = covs.dtype
-    eye = jnp.eye(covs.shape[-1], dtype=dtype)
+    K = covs.shape[-1]
+    eye = jnp.eye(K, dtype=dtype)
     safe = jnp.where(valid[:, None, None], covs, eye)
-    psd = jax.vmap(lambda c: jnp.linalg.eigvalsh(c)[0] >= 0)(safe)
-    out = jax.vmap(lambda c: eigen_risk_adjust(c, sim_covs, scale_coef))(safe)
+
+    D0, U0 = batched_eigh(safe, prefer_pallas=prefer_pallas)  # (T,K), (T,K,K)
+    psd = D0[..., 0] >= 0  # ascending order -> min eigenvalue first
+    s = jnp.sqrt(jnp.maximum(D0, 0.0))
+    B = U0 * s[:, None, :]  # (T, K, K): maps unit draws to factor returns
+
+    # simulated covariances for every (date, sim): F = B C_m B'
+    F = jnp.einsum("tik,mkl,tjl->tmij", B, sim_covs, B)
+    Dm, Um = batched_eigh(F, prefer_pallas=prefer_pallas)  # (T,M,K), (T,M,K,K)
+    Dm_hat = jnp.einsum("tmki,tkl,tmli->tmi", Um, safe, Um)
+    v2 = jnp.mean(Dm_hat / Dm, axis=1)  # (T, K)
+    v = scale_coef * (jnp.sqrt(v2) - 1.0) + 1.0
+
+    out = jnp.einsum("tik,tk,tjk->tij", U0, v * v * D0, U0)
     ok = valid & psd
     out = jnp.where(ok[:, None, None], out, jnp.nan)
     return out, ok
+
+
+def eigen_risk_adjust(
+    cov: jax.Array,
+    sim_covs: jax.Array,
+    scale_coef: float = 1.4,
+    prefer_pallas: bool | None = None,
+) -> jax.Array:
+    """Adjust one KxK covariance (the reference's ``eigen_risk_adj``,
+    ``utils.py:55-92``)."""
+    out, _ = eigen_risk_adjust_by_time(
+        cov[None], jnp.ones((1,), bool), sim_covs, scale_coef,
+        prefer_pallas=prefer_pallas,
+    )
+    return out[0]

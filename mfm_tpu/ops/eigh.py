@@ -1,0 +1,202 @@
+"""Batched symmetric eigendecomposition for small matrices (parallel Jacobi).
+
+Why this exists: the eigenfactor-adjustment stage decomposes ~T*(M+1) tiny
+(KxK, K~42) symmetric matrices (``mfm/utils.py:64,79`` per date x sim).
+XLA's TPU ``eigh`` (QDWH) costs ~100us per 42x42 matrix regardless of batch —
+12+ seconds for the CSI300 workload, >95% of the whole pipeline.
+
+This implements **Brent-Luk parallel-ordered cyclic Jacobi** in a fully
+static form: matrices are kept in a permuted basis in which every round
+rotates the adjacent pairs (2i, 2i+1) simultaneously — pair extraction and
+rotation are strided reshapes + elementwise math, and the move to the next
+round's pairing is one FIXED position permutation (a constant gather).  No
+dynamic scatter/gather ever touches the batch, so the whole decomposition is
+VPU-friendly elementwise work that batches perfectly.
+
+Schedule construction: with the circle method, round r pairs are
+(L_r[i], L_r[n-1-i]) where L_{r+1} = g(L_r) for a fixed rotation g.  Writing
+f for the interleaving [L[0], L[n-1], L[1], L[n-2], ...] that makes pairs
+adjacent, the basis change between consecutive rounds is pi = f^-1 . g . f —
+the same permutation every round.
+
+Returns eigenvalues ascending and eigenvectors in columns, like
+``np.linalg.eigh``; optional deterministic sign canonicalization (largest-
+magnitude component positive) makes results reproducible across backends.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _brent_luk_perms(n: int):
+    """(initial basis b0, per-round fixed permutation pi), both (n,) int."""
+    assert n % 2 == 0
+    idx = np.arange(n)
+    # f: interleave so that circle-method pairs (i, n-1-i) become adjacent
+    f = np.empty(n, np.int64)
+    f[0::2] = idx[: n // 2]
+    f[1::2] = idx[::-1][: n // 2]
+    # g: circle-method rotation L' = [L[0], L[-1], L[1], ..., L[-2]]
+    g = np.empty(n, np.int64)
+    g[0] = 0
+    g[1] = n - 1
+    g[2:] = idx[1:-1]
+    f_inv = np.argsort(f)
+    pi = f_inv[g[f]]  # position map of (f^-1 . g . f)
+    return f, pi
+
+
+def _check_perm_schedule(n):  # pragma: no cover — dev-time sanity helper
+    b0, pi = _brent_luk_perms(n)
+    basis = b0.copy()
+    seen = set()
+    for _ in range(n - 1):
+        for i in range(n // 2):
+            a, b = basis[2 * i], basis[2 * i + 1]
+            seen.add((min(a, b), max(a, b)))
+        basis = basis[pi]
+    assert len(seen) == n * (n - 1) // 2, len(seen)
+
+
+def _sweeps_for(n: int, dtype) -> int:
+    base = 7 if dtype == jnp.float32 else 10
+    return base + max(0, (n - 16) // 32)
+
+
+def jacobi_eigh(A: jax.Array, sweeps: int | None = None,
+                canonical_signs: bool = True):
+    """Batched eigh of symmetric ``A`` (..., n, n) -> (w (..., n), V (..., n, n)).
+
+    Eigenvalues ascending; ``V[..., :, i]`` is the i-th eigenvector.
+    """
+    n0 = A.shape[-1]
+    dtype = A.dtype
+    odd = n0 % 2 == 1
+    if odd:
+        # pad with an isolated dummy eigenvalue strictly below the spectrum
+        # (Gershgorin bound); rotations against it are exact no-ops since its
+        # off-diagonal entries stay zero
+        d = jnp.diagonal(A, axis1=-2, axis2=-1)
+        lb = jnp.min(d - (jnp.sum(jnp.abs(A), axis=-1) - jnp.abs(d)), axis=-1) - 1.0
+        pad = jnp.zeros(A.shape[:-2] + (n0 + 1, n0 + 1), dtype)
+        pad = pad.at[..., :n0, :n0].set(A)
+        A = pad.at[..., n0, n0].set(lb)
+    n = A.shape[-1]
+
+    b0_np, pi_np = _brent_luk_perms(n)
+    b0 = jnp.asarray(b0_np)
+    pi = jnp.asarray(pi_np)
+    if sweeps is None:
+        sweeps = _sweeps_for(n, dtype)
+
+    # move into the interleaved basis; B tracks basis columns (eigenvectors)
+    A = jnp.take(jnp.take(A, b0, axis=-2), b0, axis=-1)
+    V = jnp.broadcast_to(jnp.eye(n, dtype=dtype), A.shape)
+    V = jnp.take(V, b0, axis=-1)
+
+    batch = A.shape[:-2]
+    h = n // 2
+
+    def round_step(_, AV):
+        A, V = AV
+        # adjacent-pair quantities, all static strided views
+        diag = jnp.diagonal(A, axis1=-2, axis2=-1)
+        app = diag[..., 0::2]                       # (..., h)
+        aqq = diag[..., 1::2]
+        apq = jnp.diagonal(A[..., 0::2, 1::2], axis1=-2, axis2=-1)
+
+        small = jnp.abs(apq) <= jnp.asarray(jnp.finfo(dtype).tiny * 100, dtype)
+        tau = (aqq - app) / jnp.where(small, 1.0, 2.0 * apq)
+        t = jnp.sign(tau) / (jnp.abs(tau) + jnp.sqrt(1.0 + tau * tau))
+        t = jnp.where(tau == 0, 1.0, t)  # 45-degree rotation when app == aqq
+        t = jnp.where(small, 0.0, t)
+        c = 1.0 / jnp.sqrt(1.0 + t * t)
+        s = t * c
+
+        # rows: A <- J^T A
+        Ar = A.reshape(batch + (h, 2, n))
+        top, bot = Ar[..., 0, :], Ar[..., 1, :]
+        cN, sN = c[..., :, None], s[..., :, None]
+        Ar = jnp.stack([cN * top - sN * bot, sN * top + cN * bot], axis=-2)
+        A = Ar.reshape(batch + (n, n))
+        # cols: A <- A J
+        Ac = A.reshape(batch + (n, h, 2))
+        topc, botc = Ac[..., 0], Ac[..., 1]
+        cM, sM = c[..., None, :], s[..., None, :]
+        A = jnp.stack([cM * topc - sM * botc, sM * topc + cM * botc],
+                      axis=-1).reshape(batch + (n, n))
+        # eigenvector columns: V <- V J
+        Vc = V.reshape(batch + (n, h, 2))
+        topv, botv = Vc[..., 0], Vc[..., 1]
+        V = jnp.stack([cM * topv - sM * botv, sM * topv + cM * botv],
+                      axis=-1).reshape(batch + (n, n))
+
+        # fixed basis permutation to the next round's pairing
+        A = jnp.take(jnp.take(A, pi, axis=-2), pi, axis=-1)
+        V = jnp.take(V, pi, axis=-1)
+        return A, V
+
+    A, V = jax.lax.fori_loop(0, sweeps * (n - 1), round_step, (A, V))
+
+    w = jnp.diagonal(A, axis1=-2, axis2=-1)
+    order = jnp.argsort(w, axis=-1)
+    w = jnp.take_along_axis(w, order, axis=-1)
+    V = jnp.take_along_axis(V, order[..., None, :], axis=-1)
+    if odd:
+        # dummy eigenvalue is strictly below the spectrum -> sorted first
+        w = w[..., 1:]
+        V = V[..., :n0, 1:]
+    if canonical_signs:
+        w, V = canonicalize_signs(w, V)
+    return w, V
+
+
+def canonicalize_signs(w, V):
+    """Flip eigenvector signs so the largest-|.| component is positive."""
+    idx = jnp.argmax(jnp.abs(V), axis=-2, keepdims=True)
+    lead = jnp.take_along_axis(V, idx, axis=-2)
+    sign = jnp.where(lead < 0, -1.0, 1.0)
+    return w, V * sign
+
+
+def eigh_small(A, *, use_jacobi: bool | None = None, canonical_signs=True):
+    """eigh dispatcher: Jacobi for small n (the TPU fast path), XLA otherwise."""
+    n = A.shape[-1]
+    if use_jacobi is None:
+        use_jacobi = n <= 128
+    if use_jacobi:
+        return jacobi_eigh(A, canonical_signs=canonical_signs)
+    w, V = jnp.linalg.eigh(A)
+    if canonical_signs:
+        return canonicalize_signs(w, V)
+    return w, V
+
+
+def batched_eigh(A, *, prefer_pallas: bool | None = None,
+                 canonical_signs: bool = True):
+    """Backend-aware batched eigh for (B, n, n) symmetric matrices.
+
+    On TPU with even n <= 128 the VMEM-resident Pallas Jacobi kernel is ~4.4x
+    XLA's QDWH eigh at the risk model's scale (139k 42x42 matrices: 3.2s ->
+    measured vs 14.2s); elsewhere XLA/LAPACK eigh wins.  Signs are
+    canonicalized either way so both paths produce identical decompositions
+    (eigenvalues ascending, leading component positive).
+    """
+    n = A.shape[-1]
+    if prefer_pallas is None:
+        platform = jax.devices()[0].platform
+        prefer_pallas = platform in ("tpu", "axon") and n % 2 == 0 and n <= 128
+    if prefer_pallas:
+        from mfm_tpu.ops.eigh_pallas import jacobi_eigh_tpu
+
+        flat = A.reshape((-1,) + A.shape[-2:])
+        w, V = jacobi_eigh_tpu(flat, canonical_signs=canonical_signs)
+        return (w.reshape(A.shape[:-1]), V.reshape(A.shape))
+    w, V = jnp.linalg.eigh(A)
+    if canonical_signs:
+        return canonicalize_signs(w, V)
+    return w, V

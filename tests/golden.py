@@ -116,8 +116,13 @@ def golden_newey_west(ret: np.ndarray, q=2, tao=252.0):
 def golden_eigen_adj(cov, draws, scale_coef=1.4):
     """draws: (M, K, T_sim) standard normal. Scaling convention
     b_m = sqrt(D0) * N_m (distribution identical to the reference's
-    multivariate_normal(0, diag(D0)))."""
+    multivariate_normal(0, diag(D0))).  U0 signs canonicalized (largest
+    component positive) to match the framework's convention — the adjusted
+    covariance depends on the draw<->eigenpair pairing, so golden and
+    implementation must fix the same basis."""
     D0, U0 = np.linalg.eigh(cov)
+    lead = np.take_along_axis(U0, np.argmax(np.abs(U0), axis=0)[None, :], axis=0)
+    U0 = U0 * np.where(lead < 0, -1.0, 1.0)
     v = []
     for Nm in draws:
         bm = np.sqrt(np.maximum(D0, 0))[:, None] * Nm
