@@ -1,0 +1,20 @@
+"""Batch alpha-expression evaluation over dense panels.
+
+The reference's title promises LLM-driven factors but contains none
+(SURVEY.md preamble); ``BASELINE.json`` config 5 makes batch evaluation of
+LLM-generated alpha expressions an explicit workload: parse candidate
+expressions into panel ops, evaluate them fused under one jit over the
+(T, N) panel, and score them (IC / rank-IC) against forward returns.
+"""
+
+from mfm_tpu.alpha.dsl import AlphaExpr, compile_alpha, evaluate_alphas
+from mfm_tpu.alpha.metrics import information_coefficient, rank_ic, alpha_summary
+
+__all__ = [
+    "AlphaExpr",
+    "compile_alpha",
+    "evaluate_alphas",
+    "information_coefficient",
+    "rank_ic",
+    "alpha_summary",
+]

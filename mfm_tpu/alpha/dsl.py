@@ -1,0 +1,290 @@
+"""A small, safe alpha-expression DSL compiled to masked panel ops.
+
+Grammar: Python expression syntax (parsed with ``ast``, no eval) over panel
+field names, numeric literals, arithmetic/comparison operators, and a fixed
+op vocabulary in the WorldQuant-alpha style:
+
+  elementwise: abs, log, sign, sqrt, where(cond, a, b), min, max, power
+  cross-sectional (per date over valid stocks):
+      cs_rank, cs_zscore, cs_demean, cs_scale (unit L1 norm)
+  time-series (per stock, trailing window):
+      delay(x, d), delta(x, d), ts_mean(x, w), ts_std(x, w), ts_sum(x, w),
+      ts_min(x, w), ts_max(x, w), ts_rank(x, w), ts_corr(x, y, w),
+      decay_linear(x, w)
+
+All ops are NaN-masked (missing stays missing; windows require full validity
+for corr/rank, count>=1 elsewhere), static-shaped, and jit/vmap-friendly —
+an arbitrary batch of expressions evaluates as one fused XLA program.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, Dict, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# masked panel primitives
+# ---------------------------------------------------------------------------
+
+def _nan(dtype):
+    return jnp.asarray(jnp.nan, dtype)
+
+
+def cs_rank(x):
+    """Per-date fractional rank in (0, 1] over valid stocks (ties broken by
+    position, pandas method='first'); works for any leading batch dims."""
+    m = jnp.isfinite(x)
+    big = jnp.where(m, x, jnp.inf)
+    order = jnp.argsort(big, axis=-1)
+    rank0 = jnp.argsort(order, axis=-1).astype(x.dtype)  # 0-based sort position
+    n = jnp.sum(m, axis=-1, keepdims=True)
+    return jnp.where(m, (rank0 + 1.0) / n, _nan(x.dtype))
+
+
+def cs_zscore(x):
+    m = jnp.isfinite(x)
+    n = jnp.sum(m, axis=-1, keepdims=True)
+    mu = jnp.sum(jnp.where(m, x, 0.0), axis=-1, keepdims=True) / n
+    sd = jnp.sqrt(jnp.sum(jnp.where(m, (x - mu) ** 2, 0.0), axis=-1, keepdims=True) / n)
+    return jnp.where(m, (x - mu) / sd, _nan(x.dtype))
+
+
+def cs_demean(x):
+    m = jnp.isfinite(x)
+    n = jnp.sum(m, axis=-1, keepdims=True)
+    mu = jnp.sum(jnp.where(m, x, 0.0), axis=-1, keepdims=True) / n
+    return jnp.where(m, x - mu, _nan(x.dtype))
+
+
+def cs_scale(x):
+    m = jnp.isfinite(x)
+    denom = jnp.sum(jnp.where(m, jnp.abs(x), 0.0), axis=-1, keepdims=True)
+    return jnp.where(m, x / denom, _nan(x.dtype))
+
+
+def delay(x, d: int):
+    d = int(d)
+    pad = jnp.full((d,) + x.shape[1:], jnp.nan, x.dtype)
+    return jnp.concatenate([pad, x[:-d]], axis=0) if d else x
+
+
+def delta(x, d: int):
+    return x - delay(x, d)
+
+
+def _windows(x, w: int):
+    """(T, W, N) trailing windows, NaN-padded before the series start."""
+    w = int(w)
+    T = x.shape[0]
+    pad = jnp.full((w - 1,) + x.shape[1:], jnp.nan, x.dtype)
+    xp = jnp.concatenate([pad, x], axis=0)
+    idx = jnp.arange(T)[:, None] + jnp.arange(w)[None, :]
+    return jnp.take(xp, idx, axis=0)
+
+
+def _ts_reduce(x, w, reducer, min_count=1):
+    win = _windows(x, w)
+    m = jnp.isfinite(win)
+    n = jnp.sum(m, axis=1)
+    out = reducer(win, m)
+    return jnp.where(n >= min_count, out, _nan(x.dtype))
+
+
+def ts_sum(x, w):
+    return _ts_reduce(x, w, lambda win, m: jnp.sum(jnp.where(m, win, 0.0), axis=1))
+
+
+def ts_mean(x, w):
+    return _ts_reduce(
+        x, w,
+        lambda win, m: jnp.sum(jnp.where(m, win, 0.0), axis=1) / jnp.sum(m, axis=1),
+    )
+
+
+def ts_std(x, w):
+    def red(win, m):
+        n = jnp.sum(m, axis=1)
+        mu = jnp.sum(jnp.where(m, win, 0.0), axis=1) / n
+        var = jnp.sum(jnp.where(m, (win - mu[:, None]) ** 2, 0.0), axis=1) / (n - 1)
+        return jnp.sqrt(var)
+
+    return _ts_reduce(x, w, red, min_count=2)
+
+
+def ts_min(x, w):
+    return _ts_reduce(x, w, lambda win, m: jnp.min(jnp.where(m, win, jnp.inf), axis=1))
+
+
+def ts_max(x, w):
+    return _ts_reduce(x, w, lambda win, m: jnp.max(jnp.where(m, win, -jnp.inf), axis=1))
+
+
+def ts_rank(x, w):
+    """Fractional rank of today's value within its trailing window."""
+    def red(win, m):
+        cur = win[:, -1]
+        less = jnp.sum(jnp.where(m, (win <= cur[:, None]), False), axis=1)
+        n = jnp.sum(m, axis=1)
+        return less.astype(x.dtype) / n
+
+    return _ts_reduce(x, w, red)
+
+
+def ts_corr(x, y, w):
+    winx, winy = _windows(x, w), _windows(y, w)
+    m = jnp.isfinite(winx) & jnp.isfinite(winy)
+    n = jnp.sum(m, axis=1)
+    xz = jnp.where(m, winx, 0.0)
+    yz = jnp.where(m, winy, 0.0)
+    mx = jnp.sum(xz, axis=1) / n
+    my = jnp.sum(yz, axis=1) / n
+    cov = jnp.sum(jnp.where(m, (winx - mx[:, None]) * (winy - my[:, None]), 0.0), axis=1)
+    vx = jnp.sum(jnp.where(m, (winx - mx[:, None]) ** 2, 0.0), axis=1)
+    vy = jnp.sum(jnp.where(m, (winy - my[:, None]) ** 2, 0.0), axis=1)
+    out = cov / jnp.sqrt(vx * vy)
+    return jnp.where(n >= 2, out, _nan(x.dtype))
+
+
+def decay_linear(x, w):
+    wts = jnp.arange(1, int(w) + 1, dtype=x.dtype)
+
+    def red(win, m):
+        ww = jnp.where(m, wts[None, :, None], 0.0)
+        return jnp.sum(ww * jnp.where(m, win, 0.0), axis=1) / jnp.sum(ww, axis=1)
+
+    return _ts_reduce(x, w, red)
+
+
+_ELEMENTWISE = {
+    "abs": jnp.abs,
+    "log": lambda x: jnp.log(jnp.where(x > 0, x, jnp.nan)),
+    "sign": jnp.sign,
+    "sqrt": lambda x: jnp.sqrt(jnp.where(x >= 0, x, jnp.nan)),
+    "power": jnp.power,
+    "min": jnp.minimum,
+    "max": jnp.maximum,
+    "where": jnp.where,
+}
+
+_OPS: Dict[str, Callable] = {
+    **_ELEMENTWISE,
+    "cs_rank": cs_rank,
+    "cs_zscore": cs_zscore,
+    "cs_demean": cs_demean,
+    "cs_scale": cs_scale,
+    "delay": delay,
+    "delta": delta,
+    "ts_mean": ts_mean,
+    "ts_std": ts_std,
+    "ts_sum": ts_sum,
+    "ts_min": ts_min,
+    "ts_max": ts_max,
+    "ts_rank": ts_rank,
+    "ts_corr": ts_corr,
+    "decay_linear": decay_linear,
+}
+
+_BINOPS = {
+    ast.Add: jnp.add,
+    ast.Sub: jnp.subtract,
+    ast.Mult: jnp.multiply,
+    ast.Div: jnp.divide,
+    ast.Pow: jnp.power,
+    ast.Mod: jnp.mod,
+}
+_CMPOPS = {
+    ast.Gt: jnp.greater,
+    ast.GtE: jnp.greater_equal,
+    ast.Lt: jnp.less,
+    ast.LtE: jnp.less_equal,
+    ast.Eq: jnp.equal,
+    ast.NotEq: jnp.not_equal,
+}
+
+
+@dataclasses.dataclass
+class AlphaExpr:
+    """A parsed, validated alpha expression."""
+
+    source: str
+    tree: ast.expression
+    fields: tuple
+
+    def __call__(self, panel: Mapping[str, jax.Array]) -> jax.Array:
+        return _eval_node(self.tree.body, panel)
+
+
+def _collect_fields(node, fields):
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id not in _OPS:
+            fields.add(n.id)
+
+
+def compile_alpha(source: str) -> AlphaExpr:
+    """Parse an expression string into a callable panel op.
+
+    Raises ValueError on any syntax outside the DSL (attribute access,
+    subscripts, lambdas, comprehensions, ... are all rejected).
+    """
+    tree = ast.parse(source, mode="eval")
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Attribute, ast.Subscript, ast.Lambda, ast.ListComp,
+                             ast.DictComp, ast.SetComp, ast.GeneratorExp, ast.Await,
+                             ast.Starred, ast.keyword)):
+            raise ValueError(f"disallowed syntax in alpha: {ast.dump(node)[:60]}")
+        if isinstance(node, ast.Call):
+            if not isinstance(node.func, ast.Name) or node.func.id not in _OPS:
+                raise ValueError(f"unknown function in alpha: {ast.dump(node.func)[:60]}")
+    fields: set = set()
+    _collect_fields(tree, fields)
+    return AlphaExpr(source=source, tree=tree, fields=tuple(sorted(fields)))
+
+
+def _eval_node(node, panel):
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Name):
+        return panel[node.id]
+    if isinstance(node, ast.BinOp):
+        return _BINOPS[type(node.op)](_eval_node(node.left, panel),
+                                      _eval_node(node.right, panel))
+    if isinstance(node, ast.UnaryOp):
+        v = _eval_node(node.operand, panel)
+        if isinstance(node.op, ast.USub):
+            return -v
+        if isinstance(node.op, ast.UAdd):
+            return v
+        raise ValueError("unsupported unary op")
+    if isinstance(node, ast.Compare):
+        if len(node.ops) != 1:
+            raise ValueError("chained comparisons unsupported")
+        return _CMPOPS[type(node.ops[0])](_eval_node(node.left, panel),
+                                          _eval_node(node.comparators[0], panel))
+    if isinstance(node, ast.Call):
+        args = [_eval_node(a, panel) for a in node.args]
+        return _OPS[node.func.id](*args)
+    raise ValueError(f"unsupported node {type(node).__name__}")
+
+
+def evaluate_alphas(
+    sources: Sequence[str],
+    panel: Mapping[str, jax.Array],
+    jit: bool = True,
+) -> jax.Array:
+    """Evaluate a batch of expressions -> (E, T, N), one fused XLA program.
+
+    This is the BASELINE.json config-5 entry point: candidate expressions
+    (e.g. LLM-generated) over a shared panel; XLA CSEs shared subexpressions
+    across the batch.
+    """
+    exprs = [compile_alpha(s) for s in sources]
+
+    def run(p):
+        return jnp.stack([e(p) for e in exprs], axis=0)
+
+    return jax.jit(run)(dict(panel)) if jit else run(dict(panel))

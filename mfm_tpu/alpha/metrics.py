@@ -1,0 +1,56 @@
+"""Alpha scoring: per-date information coefficients and summaries."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from mfm_tpu.alpha.dsl import cs_rank
+
+
+def information_coefficient(alpha: jax.Array, fwd_ret: jax.Array) -> jax.Array:
+    """Per-date Pearson correlation of alpha vs forward returns.
+
+    alpha: (..., T, N); fwd_ret: (T, N).  Returns (..., T).
+    """
+    m = jnp.isfinite(alpha) & jnp.isfinite(fwd_ret)
+    n = jnp.sum(m, axis=-1)
+    az = jnp.where(m, alpha, 0.0)
+    rz = jnp.where(m, fwd_ret, 0.0)
+    ma = jnp.sum(az, axis=-1) / n
+    mr = jnp.sum(rz, axis=-1) / n
+    da = jnp.where(m, alpha - ma[..., None], 0.0)
+    dr = jnp.where(m, fwd_ret - mr[..., None], 0.0)
+    cov = jnp.sum(da * dr, axis=-1)
+    ic = cov / jnp.sqrt(jnp.sum(da * da, axis=-1) * jnp.sum(dr * dr, axis=-1))
+    return jnp.where(n >= 3, ic, jnp.nan)
+
+
+def rank_ic(alpha: jax.Array, fwd_ret: jax.Array) -> jax.Array:
+    """Spearman: Pearson IC of the cross-sectional ranks."""
+    ra = cs_rank(alpha)
+    rr = cs_rank(jnp.broadcast_to(fwd_ret, alpha.shape))
+    return information_coefficient(ra, rr)
+
+
+def alpha_summary(alphas: jax.Array, fwd_ret: jax.Array) -> dict:
+    """Batch scorecard for (E, T, N) alpha values.
+
+    Returns per-expression arrays: mean IC, IC information ratio
+    (mean/std over dates), mean rank-IC, coverage (mean valid fraction).
+    """
+    ic = information_coefficient(alphas, fwd_ret)  # (E, T)
+    ric = rank_ic(alphas, fwd_ret)
+    m = jnp.isfinite(ic)
+    n = jnp.sum(m, axis=-1)
+    mean_ic = jnp.sum(jnp.where(m, ic, 0.0), axis=-1) / n
+    var_ic = jnp.sum(jnp.where(m, (ic - mean_ic[:, None]) ** 2, 0.0), axis=-1) / n
+    mr = jnp.isfinite(ric)
+    mean_ric = jnp.sum(jnp.where(mr, ric, 0.0), axis=-1) / jnp.sum(mr, axis=-1)
+    coverage = jnp.mean(jnp.isfinite(alphas), axis=(-2, -1))
+    return {
+        "mean_ic": mean_ic,
+        "ic_ir": mean_ic / jnp.sqrt(var_ic),
+        "mean_rank_ic": mean_ric,
+        "coverage": coverage,
+    }
