@@ -1,0 +1,19 @@
+"""Observability and misc utilities."""
+
+from mfm_tpu.utils.obs import (
+    StageTimer,
+    log,
+    set_log_level,
+    determinism_check,
+    trace_annotation,
+    force,
+)
+
+__all__ = [
+    "StageTimer",
+    "log",
+    "set_log_level",
+    "determinism_check",
+    "trace_annotation",
+    "force",
+]

@@ -1,0 +1,108 @@
+"""Observability: structured logging, stage timing, determinism checks.
+
+The reference's observability is bare prints and tqdm bars scattered through
+every file (SURVEY.md §5); its "race detector" is nonexistence (single
+thread).  Here:
+
+- :func:`log` — structured JSON-lines logging with levels.
+- :class:`StageTimer` — wall-clock per pipeline stage, with the host-transfer
+  forcing required on async dispatch backends (on this TPU tunnel
+  ``block_until_ready`` returns before execution finishes, so timing must
+  force a scalar transfer).
+- :func:`determinism_check` — runs a function twice and compares results
+  bitwise; the batch-job replacement for a race detector (SURVEY.md §5:
+  same-seed => bitwise-equal outputs).
+- :func:`trace_annotation` — named ``jax.profiler`` trace spans.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import sys
+import time
+from typing import Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_LEVELS = {"debug": 10, "info": 20, "warn": 30, "error": 40}
+_state = {"level": 20}
+
+
+def set_log_level(level: str) -> None:
+    _state["level"] = _LEVELS[level]
+
+
+def log(level: str, event: str, **fields) -> None:
+    if _LEVELS[level] < _state["level"]:
+        return
+    rec = {"ts": round(time.time(), 3), "level": level, "event": event, **fields}
+    print(json.dumps(rec), file=sys.stderr, flush=True)
+
+
+def force(tree):
+    """Force execution + tiny host transfer of a pytree of arrays.
+
+    Returns the summed checksum (useful for timing and smoke assertions).
+    """
+    leaves = [x for x in jax.tree_util.tree_leaves(tree)
+              if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)]
+    if not leaves:
+        jax.block_until_ready(tree)
+        return 0.0
+    total = sum(jnp.sum(jnp.where(jnp.isfinite(x), x, 0.0)) for x in leaves)
+    return float(np.asarray(total))
+
+
+class StageTimer:
+    """Accumulates wall-clock per named stage; emits a structured summary."""
+
+    def __init__(self, name: str = "pipeline"):
+        self.name = name
+        self.stages: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def stage(self, name: str, result_holder=None):
+        t0 = time.perf_counter()
+        yield
+        if result_holder is not None:
+            force(result_holder)
+        self.stages[name] = self.stages.get(name, 0.0) + time.perf_counter() - t0
+
+    def summary(self) -> dict:
+        total = sum(self.stages.values())
+        return {"name": self.name, "total_s": round(total, 4),
+                **{k: round(v, 4) for k, v in self.stages.items()}}
+
+    def emit(self) -> None:
+        log("info", "stage_timing", **self.summary())
+
+
+def determinism_check(fn: Callable, *args, atol: float = 0.0) -> bool:
+    """Run ``fn`` twice; True iff outputs agree within atol (0 = bitwise).
+
+    With keyed jax.random and no data races there is no legitimate source of
+    run-to-run divergence — this is the framework's sanitizer.
+    """
+    a = jax.tree_util.tree_leaves(fn(*args))
+    b = jax.tree_util.tree_leaves(fn(*args))
+    for x, y in zip(a, b):
+        x = np.asarray(x)
+        y = np.asarray(y)
+        if atol == 0.0:
+            same = np.array_equal(x, y, equal_nan=True)
+        else:
+            same = np.allclose(x, y, atol=atol, equal_nan=True)
+        if not same:
+            return False
+    return True
+
+
+@contextlib.contextmanager
+def trace_annotation(name: str):
+    """Named span visible in jax.profiler traces."""
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -1,0 +1,56 @@
+"""Observability utilities."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from mfm_tpu.utils.obs import StageTimer, determinism_check, force
+
+
+def test_force_returns_finite_checksum():
+    x = {"a": jnp.ones((4, 4)), "b": jnp.asarray([jnp.nan, 1.0])}
+    assert force(x) == 17.0
+
+
+def test_stage_timer_accumulates():
+    t = StageTimer("test")
+    with t.stage("s1"):
+        pass
+    with t.stage("s1"):
+        pass
+    s = t.summary()
+    assert "s1" in s and s["total_s"] >= 0
+
+
+def test_determinism_check_keyed_random():
+    def fn():
+        k = jax.random.key(42)
+        return jax.random.normal(k, (8, 8)) @ jax.random.normal(k, (8, 8))
+
+    assert determinism_check(fn)
+
+
+def test_determinism_check_catches_divergence():
+    state = {"n": 0}
+
+    def fn():
+        state["n"] += 1
+        return np.array([state["n"]], float)
+
+    assert not determinism_check(fn)
+
+
+def test_riskmodel_pipeline_is_deterministic():
+    from mfm_tpu.config import RiskModelConfig
+    from mfm_tpu.models.risk_model import RiskModel
+    from __graft_entry__ import _synthetic_risk_inputs
+
+    args = _synthetic_risk_inputs(24, 16, 3, 2, dtype=jnp.float64, seed=5)
+    cfg = RiskModelConfig(eigen_n_sims=4, eigen_sim_length=50)
+
+    def run():
+        rm = RiskModel(*args, n_industries=3, config=cfg)
+        out = rm.run()
+        return out.factor_ret, out.vr_cov, out.lamb
+
+    assert determinism_check(run)
