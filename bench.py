@@ -1,29 +1,50 @@
-"""Benchmark: the north-star workload (BASELINE.json config 1) — full Barra
-risk-model pipeline (per-date constrained WLS + Newey-West + eigenfactor
-adjustment + vol-regime adjustment) on a CSI300-shaped panel
-(T=1390 dates x N=300 stocks, K = 1 + 31 + 10 factors).
+"""Benchmarks for the BASELINE.json configs.  Prints ONE JSON line.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": <TPU end-to-end seconds>, "unit": "s",
-   "vs_baseline": <CPU-reference-time / TPU-time>}
+Default (what the driver records): config 1, the north-star workload — full
+Barra risk-model pipeline (per-date constrained WLS + Newey-West +
+eigenfactor adjustment + vol-regime adjustment) on a CSI300-shaped panel
+(T=1390 dates x N=300 stocks, K = 1 + 31 + 10 factors, M=100 sims).
 
-The reference publishes no numbers (BASELINE.md), so the baseline is measured
-here: the golden NumPy implementation of the identical math (same serial
-per-date loops the reference runs, minus statsmodels overhead — a *favorable*
-proxy for the reference) timed on subsamples of each stage and extrapolated
-linearly in T.  vs_baseline > 1 means the TPU pipeline is faster end-to-end.
+  python bench.py                 # config 1 (the recorded metric)
+  python bench.py --config beta   # config 2: rolling 252d BETA+HSIGMA, CSI300
+  python bench.py --config factors# config 3: full style-factor calc + post
+  python bench.py --config alla   # config 4: all-A-share x-sec regression scale-up
+  python bench.py --config alpha  # config 5: 1000 alpha expressions, CSI300 panel
+
+The reference publishes no numbers (BASELINE.md), so the config-1 baseline is
+measured here: the golden NumPy implementation of the identical math (same
+serial per-date loops the reference runs, minus statsmodels overhead — a
+*favorable* proxy for the reference) timed on subsamples of each stage and
+extrapolated linearly in T.  vs_baseline > 1 means the TPU run is faster.
+
+NOTE: on this TPU tunnel ``block_until_ready`` does not actually block, so
+every timing forces a scalar host transfer of a checksum.
 """
 
+import argparse
 import json
 import time
 
 import numpy as np
 
 
-def _tpu_time():
+def _force(x):
+    return float(np.asarray(x))
+
+
+def _time3(fn, *args):
+    _force(fn(*args))  # compile + warmup
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _force(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def bench_riskmodel():
     import jax
     import jax.numpy as jnp
-
     from mfm_tpu.config import RiskModelConfig
     from mfm_tpu.models.eigen import simulated_eigen_covs
     from mfm_tpu.models.risk_model import RiskModel
@@ -41,30 +62,21 @@ def _tpu_time():
         rm = RiskModel(ret, cap, styles, industry, valid,
                        n_industries=P, config=cfg)
         out = rm.run(sim_covs=sim_covs)
-        # reduce outputs to one scalar: on this TPU tunnel block_until_ready
-        # does not actually block, so timing must force a (tiny) host
-        # transfer without paying multi-MB transfer costs
-        checksum = (
-            jnp.sum(out.factor_ret)
-            + jnp.sum(out.r2)
-            + jnp.sum(jnp.where(jnp.isfinite(out.vr_cov), out.vr_cov, 0.0))
-            + jnp.sum(out.lamb)
-        )
-        return checksum
+        return (jnp.sum(out.factor_ret) + jnp.sum(out.r2)
+                + jnp.sum(jnp.where(jnp.isfinite(out.vr_cov), out.vr_cov, 0.0))
+                + jnp.sum(out.lamb))
 
-    float(np.asarray(step(*args, sim_covs)))  # compile + warmup
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        float(np.asarray(step(*args, sim_covs)))
-        times.append(time.perf_counter() - t0)
-    return min(times), (T, N, P, Q, K, M), args
+    tpu_s = _time3(step, *args, sim_covs)
+    cpu_s = _cpu_baseline_riskmodel((T, N, P, Q, K, M), args)
+    return {"metric": "csi300_riskmodel_e2e_wall", "value": round(tpu_s, 4),
+            "unit": "s", "vs_baseline": round(cpu_s / tpu_s, 2)}
 
 
-def _cpu_baseline(shape, args):
+def _cpu_baseline_riskmodel(shape, args):
     """Golden NumPy serial loops (the reference's structure) on subsamples,
     extrapolated to full T."""
-    import sys, os
+    import os
+    import sys
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), "tests"))
     from golden import golden_cross_section, golden_newey_west, golden_eigen_adj
 
@@ -72,7 +84,6 @@ def _cpu_baseline(shape, args):
     ret, cap, styles, industry, valid = (np.asarray(a, np.float64) for a in args)
     industry = industry.astype(int)
 
-    # stage 1: per-date WLS — time n1 dates, scale by T
     n1 = 40
     t0 = time.perf_counter()
     for t in range(n1):
@@ -82,15 +93,12 @@ def _cpu_baseline(shape, args):
     reg_s = (time.perf_counter() - t0) / n1 * T
 
     f = 0.01 * np.random.default_rng(0).standard_normal((T, K))
-    # stage 2: expanding NW — time windows at stride, integrate over T
     sample_ts = list(range(K + 2, T, 100))
     t0 = time.perf_counter()
     for t in sample_ts:
         golden_newey_west(f[:t], 2, 252.0)
-    per_window = (time.perf_counter() - t0) / len(sample_ts)  # at avg t ~ T/2
-    nw_s = per_window * T
+    nw_s = (time.perf_counter() - t0) / len(sample_ts) * T
 
-    # stage 3: eigen MC — time n3 dates with the full M sims, scale by T
     cov = golden_newey_west(f, 2, 252.0)
     draws = np.random.default_rng(1).standard_normal((M, K, T))
     n3 = 3
@@ -98,21 +106,143 @@ def _cpu_baseline(shape, args):
     for _ in range(n3):
         golden_eigen_adj(cov, draws, 1.4)
     eig_s = (time.perf_counter() - t0) / n3 * T
-
-    # stage 4 (vol regime) is negligible next to 1-3; ignore (favors baseline)
+    # vol-regime stage is negligible next to these; omitting favors the baseline
     return reg_s + nw_s + eig_s
 
 
+def bench_beta(T=1390, N=300, label="csi300_beta_hsigma_wall"):
+    import jax
+    import jax.numpy as jnp
+    from mfm_tpu.ops.rolling import rolling_beta_hsigma
+
+    rng = np.random.default_rng(0)
+    ret = (0.01 * rng.standard_normal((T, N))).astype(np.float32)
+    ret[rng.random((T, N)) < 0.05] = np.nan
+    mkt = (0.008 * rng.standard_normal(T)).astype(np.float32)
+
+    f = jax.jit(lambda r, m: sum(
+        jnp.sum(jnp.where(jnp.isfinite(x), x, 0.0))
+        for x in rolling_beta_hsigma(r, m, window=252, half_life=63,
+                                     min_periods=42, block=32)))
+    tpu_s = _time3(f, jnp.asarray(ret), jnp.asarray(mkt))
+    # CPU proxy: per-window closed-form WLS in NumPy (far cheaper than the
+    # reference's statsmodels fit per window) on a subsample of stocks
+    import pandas as pd
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "tests"))
+    from golden import golden_beta_hsigma
+    ns = 3
+    t0 = time.perf_counter()
+    for n in range(ns):
+        golden_beta_hsigma(pd.Series(ret[:, n].astype(np.float64)),
+                           pd.Series(mkt.astype(np.float64)))
+    cpu_s = (time.perf_counter() - t0) / ns * N
+    return {"metric": label, "value": round(tpu_s, 4), "unit": "s",
+            "vs_baseline": round(cpu_s / tpu_s, 2)}
+
+
+def bench_factors():
+    import jax.numpy as jnp
+    from mfm_tpu.config import FactorConfig
+    from mfm_tpu.data.synthetic import synthetic_market_panel
+    from mfm_tpu.factors.engine import FactorEngine
+
+    data = synthetic_market_panel(T=1390, N=300, n_industries=31, seed=0)
+    fields = {k: jnp.asarray(v, jnp.float32) for k, v in data.items()
+              if k not in ("dates", "stocks", "industry", "index_close",
+                           "observed", "end_date_code")}
+    fields["end_date_code"] = jnp.asarray(data["end_date_code"])
+    eng = FactorEngine(fields, jnp.asarray(data["index_close"], jnp.float32),
+                       config=FactorConfig(), block=32)
+
+    def run():
+        out = eng.run()
+        import jax.numpy as jnp2
+        return sum(jnp2.sum(jnp2.where(jnp2.isfinite(v), v, 0.0))
+                   for v in out.values())
+
+    tpu_s = _time3(run)
+    return {"metric": "csi300_factor_pipeline_wall", "value": round(tpu_s, 4),
+            "unit": "s", "vs_baseline": None}
+
+
+def bench_alla():
+    import jax
+    import jax.numpy as jnp
+    from mfm_tpu.ops.xreg import regress_panel
+    from mfm_tpu.ops.rolling import rolling_beta_hsigma
+    from __graft_entry__ import _synthetic_risk_inputs
+
+    T, N, P, Q = 2500, 5000, 31, 10
+    args = _synthetic_risk_inputs(T, N, P, Q, seed=1)
+    rng = np.random.default_rng(2)
+    mkt = (0.008 * rng.standard_normal(T)).astype(np.float32)
+
+    def step(ret, cap, styles, industry, valid, mkt):
+        b, h = rolling_beta_hsigma(ret, mkt, window=252, half_life=63,
+                                   min_periods=42, block=16)
+        res = regress_panel(ret, cap, styles, industry, valid, n_industries=P)
+        return (jnp.sum(res.factor_ret)
+                + jnp.sum(jnp.where(jnp.isfinite(b), b, 0.0))
+                + jnp.sum(jnp.where(jnp.isfinite(h), h, 0.0)))
+
+    tpu_s = _time3(jax.jit(step), *args, jnp.asarray(mkt))
+    return {"metric": "alla_5000x2500_beta_plus_xreg_wall",
+            "value": round(tpu_s, 4), "unit": "s", "vs_baseline": None}
+
+
+def bench_alpha():
+    import jax.numpy as jnp
+    from mfm_tpu.alpha.dsl import evaluate_alphas
+    from mfm_tpu.alpha.metrics import alpha_summary
+
+    rng = np.random.default_rng(0)
+    T, N = 1390, 300
+    close = np.exp(np.cumsum(0.02 * rng.standard_normal((T, N)), axis=0))
+    panel = {
+        "close": jnp.asarray(close, jnp.float32),
+        "volume": jnp.asarray(np.exp(rng.normal(10, 1, (T, N))), jnp.float32),
+        "ret": jnp.asarray(np.vstack([np.full((1, N), np.nan),
+                                      close[1:] / close[:-1] - 1]), jnp.float32),
+    }
+    templates = [
+        "cs_rank(delta(close, {d}))",
+        "-ts_corr(close, volume, {w})",
+        "cs_zscore(ts_std(ret, {w}))",
+        "decay_linear(cs_demean(ret), {w}) * {c}",
+        "where(ret > 0, cs_rank(volume), -cs_rank(ts_mean(volume, {d})))",
+        "ts_rank(close, {w}) - cs_rank(delta(volume, {d}))",
+    ]
+    exprs = [templates[i % len(templates)].format(
+        d=2 + i % 9, w=5 + i % 20, c=round(0.5 + (i % 10) / 10, 2))
+        for i in range(1000)]
+    fwd = jnp.concatenate([panel["ret"][1:],
+                           jnp.full((1, N), jnp.nan, jnp.float32)], axis=0)
+
+    def run():
+        out = evaluate_alphas(exprs, panel)
+        s = alpha_summary(out, fwd)
+        return jnp.sum(jnp.where(jnp.isfinite(s["mean_ic"]), s["mean_ic"], 0.0))
+
+    tpu_s = _time3(run)
+    return {"metric": "alpha_1000_exprs_csi300_wall", "value": round(tpu_s, 4),
+            "unit": "s", "vs_baseline": None}
+
+
+CONFIGS = {
+    "riskmodel": bench_riskmodel,
+    "beta": bench_beta,
+    "factors": bench_factors,
+    "alla": bench_alla,
+    "alpha": bench_alpha,
+}
+
+
 def main():
-    tpu_s, shape, args = _tpu_time()
-    T, N, P, Q, K, M = shape
-    cpu_s = _cpu_baseline((T, N, P, Q, K, M), args)
-    print(json.dumps({
-        "metric": "csi300_riskmodel_e2e_wall",
-        "value": round(tpu_s, 4),
-        "unit": "s",
-        "vs_baseline": round(cpu_s / tpu_s, 2),
-    }))
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="riskmodel", choices=sorted(CONFIGS))
+    args = ap.parse_args()
+    print(json.dumps(CONFIGS[args.config]()))
 
 
 if __name__ == "__main__":
