@@ -192,8 +192,9 @@ def bench_alla():
 
 
 def bench_alpha():
+    import jax
     import jax.numpy as jnp
-    from mfm_tpu.alpha.dsl import evaluate_alphas
+    from mfm_tpu.alpha.dsl import compile_alpha_batch
     from mfm_tpu.alpha.metrics import alpha_summary
 
     rng = np.random.default_rng(0)
@@ -218,13 +219,15 @@ def bench_alpha():
         for i in range(1000)]
     fwd = jnp.concatenate([panel["ret"][1:],
                            jnp.full((1, N), jnp.nan, jnp.float32)], axis=0)
+    batch = compile_alpha_batch(exprs)
 
-    def run():
-        out = evaluate_alphas(exprs, panel)
+    @jax.jit
+    def run(p, fwd):
+        out = batch(p)
         s = alpha_summary(out, fwd)
         return jnp.sum(jnp.where(jnp.isfinite(s["mean_ic"]), s["mean_ic"], 0.0))
 
-    tpu_s = _time3(run)
+    tpu_s = _time3(run, dict(panel), fwd)
     return {"metric": "alpha_1000_exprs_csi300_wall", "value": round(tpu_s, 4),
             "unit": "s", "vs_baseline": None}
 

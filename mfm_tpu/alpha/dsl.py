@@ -95,25 +95,37 @@ def _ts_reduce(x, w, reducer, min_count=1):
     return jnp.where(n >= min_count, out, _nan(x.dtype))
 
 
+def _winsum(x, w: int):
+    """Trailing-window sum via cumsum difference — O(T), no window
+    materialization (this is what makes 1000-expression batches cheap)."""
+    cs = jnp.cumsum(x, axis=0)
+    return cs - jnp.concatenate(
+        [jnp.zeros((int(w),) + x.shape[1:], x.dtype), cs[:-int(w)]], axis=0
+    )[: x.shape[0]]
+
+
+def _moments(x, w, min_count):
+    m = jnp.isfinite(x)
+    n = _winsum(m.astype(x.dtype), w)
+    s = _winsum(jnp.where(m, x, 0.0), w)
+    return m, n, s, jnp.where(n >= min_count, 1.0, jnp.nan)
+
+
 def ts_sum(x, w):
-    return _ts_reduce(x, w, lambda win, m: jnp.sum(jnp.where(m, win, 0.0), axis=1))
+    m, n, s, gate = _moments(x, w, 1)
+    return s * gate
 
 
 def ts_mean(x, w):
-    return _ts_reduce(
-        x, w,
-        lambda win, m: jnp.sum(jnp.where(m, win, 0.0), axis=1) / jnp.sum(m, axis=1),
-    )
+    m, n, s, gate = _moments(x, w, 1)
+    return s / n * gate
 
 
 def ts_std(x, w):
-    def red(win, m):
-        n = jnp.sum(m, axis=1)
-        mu = jnp.sum(jnp.where(m, win, 0.0), axis=1) / n
-        var = jnp.sum(jnp.where(m, (win - mu[:, None]) ** 2, 0.0), axis=1) / (n - 1)
-        return jnp.sqrt(var)
-
-    return _ts_reduce(x, w, red, min_count=2)
+    m, n, s, gate = _moments(x, w, 2)
+    ss = _winsum(jnp.where(m, x * x, 0.0), w)
+    var = (ss - s * s / n) / (n - 1.0)
+    return jnp.sqrt(jnp.maximum(var, 0.0)) * gate
 
 
 def ts_min(x, w):
@@ -136,28 +148,43 @@ def ts_rank(x, w):
 
 
 def ts_corr(x, y, w):
-    winx, winy = _windows(x, w), _windows(y, w)
-    m = jnp.isfinite(winx) & jnp.isfinite(winy)
-    n = jnp.sum(m, axis=1)
-    xz = jnp.where(m, winx, 0.0)
-    yz = jnp.where(m, winy, 0.0)
-    mx = jnp.sum(xz, axis=1) / n
-    my = jnp.sum(yz, axis=1) / n
-    cov = jnp.sum(jnp.where(m, (winx - mx[:, None]) * (winy - my[:, None]), 0.0), axis=1)
-    vx = jnp.sum(jnp.where(m, (winx - mx[:, None]) ** 2, 0.0), axis=1)
-    vy = jnp.sum(jnp.where(m, (winy - my[:, None]) ** 2, 0.0), axis=1)
+    m = jnp.isfinite(x) & jnp.isfinite(y)
+    xz = jnp.where(m, x, 0.0)
+    yz = jnp.where(m, y, 0.0)
+    n = _winsum(m.astype(x.dtype), w)
+    sx = _winsum(xz, w)
+    sy = _winsum(yz, w)
+    sxy = _winsum(xz * yz, w)
+    sxx = _winsum(xz * xz, w)
+    syy = _winsum(yz * yz, w)
+    cov = sxy - sx * sy / n
+    vx = sxx - sx * sx / n
+    vy = syy - sy * sy / n
     out = cov / jnp.sqrt(vx * vy)
     return jnp.where(n >= 2, out, _nan(x.dtype))
 
 
 def decay_linear(x, w):
-    wts = jnp.arange(1, int(w) + 1, dtype=x.dtype)
-
-    def red(win, m):
-        ww = jnp.where(m, wts[None, :, None], 0.0)
-        return jnp.sum(ww * jnp.where(m, win, 0.0), axis=1) / jnp.sum(ww, axis=1)
-
-    return _ts_reduce(x, w, red)
+    """Linearly-decaying weighted mean: weight (p+1) at window position p,
+    renormalized over valid points.  Position weights are an affine function
+    of the date index, so two cumsum-window sums suffice: with weight
+    i - (t - w) for series index i, the weighted sum is
+    [sum i*x]_win - (t-w) [sum x]_win."""
+    w = int(w)
+    m = jnp.isfinite(x)
+    t_idx = jnp.arange(x.shape[0], dtype=x.dtype).reshape(
+        (-1,) + (1,) * (x.ndim - 1)
+    )
+    xz = jnp.where(m, x, 0.0)
+    mz = m.astype(x.dtype)
+    s_ix = _winsum(t_idx * xz, w)
+    s_x = _winsum(xz, w)
+    s_im = _winsum(t_idx * mz, w)
+    s_m = _winsum(mz, w)
+    base = t_idx - w  # weight of series index i in the window ending t: i-(t-w)
+    num = s_ix - base * s_x
+    den = s_im - base * s_m
+    return jnp.where(s_m >= 1, num / den, _nan(x.dtype))
 
 
 _ELEMENTWISE = {
@@ -271,20 +298,31 @@ def _eval_node(node, panel):
     raise ValueError(f"unsupported node {type(node).__name__}")
 
 
+def compile_alpha_batch(sources: Sequence[str]) -> Callable:
+    """Compile a batch of expressions into ONE jitted panel -> (E, T, N) fn.
+
+    XLA CSEs shared subexpressions across the batch; reuse the returned
+    callable to amortize compilation over repeated panels.
+    """
+    exprs = [compile_alpha(s) for s in sources]
+
+    @jax.jit
+    def run(p):
+        return jnp.stack([e(p) for e in exprs], axis=0)
+
+    return run
+
+
 def evaluate_alphas(
     sources: Sequence[str],
     panel: Mapping[str, jax.Array],
     jit: bool = True,
 ) -> jax.Array:
-    """Evaluate a batch of expressions -> (E, T, N), one fused XLA program.
+    """One-shot batch evaluation -> (E, T, N) (BASELINE.json config 5).
 
-    This is the BASELINE.json config-5 entry point: candidate expressions
-    (e.g. LLM-generated) over a shared panel; XLA CSEs shared subexpressions
-    across the batch.
+    For repeated evaluation compile once with :func:`compile_alpha_batch`.
     """
+    if jit:
+        return compile_alpha_batch(sources)(dict(panel))
     exprs = [compile_alpha(s) for s in sources]
-
-    def run(p):
-        return jnp.stack([e(p) for e in exprs], axis=0)
-
-    return jax.jit(run)(dict(panel)) if jit else run(dict(panel))
+    return jnp.stack([e(dict(panel)) for e in exprs], axis=0)
