@@ -1,0 +1,58 @@
+"""Stage-artifact checkpointing.
+
+The reference checkpoints *data* between stages everywhere (Mongo collections
+with watermarks, intermediate CSVs — SURVEY.md §5 "Checkpoint / resume").
+Here every stage boundary can persist its arrays to an .npz artifact with a
+schema stamp, and jitted executables persist via JAX's compilation cache.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Mapping
+
+import numpy as np
+
+import jax
+
+FORMAT_VERSION = 1
+
+
+def save_artifact(path: str, arrays: Mapping[str, object], meta: dict | None = None):
+    """Persist a flat dict of arrays (+ JSON-able metadata) atomically."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    payload = {k: np.asarray(v) for k, v in arrays.items()}
+    payload["__meta__"] = np.frombuffer(
+        json.dumps({"format": FORMAT_VERSION, **(meta or {})}).encode(), dtype=np.uint8
+    )
+    tmp = path + ".tmp.npz"  # savez appends .npz unless already present
+    np.savez_compressed(tmp, **payload)
+    os.replace(tmp, path)
+
+
+def load_artifact(path: str):
+    """Returns (arrays dict, meta dict)."""
+    with np.load(path, allow_pickle=False) as z:
+        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+        meta = json.loads(bytes(z["__meta__"]).decode()) if "__meta__" in z.files else {}
+    return arrays, meta
+
+
+def save_risk_outputs(path: str, outputs, meta: dict | None = None):
+    """Persist a RiskModelOutputs tuple (stage-6 artifact)."""
+    arrays = {f: np.asarray(getattr(outputs, f)) for f in outputs._fields}
+    save_artifact(path, arrays, meta)
+
+
+def enable_compilation_cache(cache_dir: str | None = None):
+    """Persist jitted executables across processes (the reference's analogue
+    is nothing — every run recompiles pandas ops; here a second run of the
+    same pipeline skips XLA compilation entirely)."""
+    cache_dir = cache_dir or os.environ.get(
+        "MFM_COMPILE_CACHE", os.path.expanduser("~/.cache/mfm_tpu_xla")
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    return cache_dir

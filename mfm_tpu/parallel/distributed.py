@@ -1,0 +1,82 @@
+"""Multi-host (multi-slice) execution helpers.
+
+The reference has no distributed backend at all (SURVEY.md §2.4) — its
+"communication" is MongoDB reads/writes.  Here the communication backend is
+XLA collectives: within a slice they ride ICI; across slices (DCN) only the
+date axis should be partitioned, because every cross-date dependency in the
+pipeline is either embarrassingly parallel (regression, eigen adjustment) or
+a tiny KxK scan (Newey-West, vol regime) that runs replicated.
+
+Topology doctrine for an (n_hosts x chips) fleet:
+
+  mesh axes     ('date', 'stock')
+  date axis     outer, spans hosts (DCN-friendly: no collectives cross it in
+                the regression/eigen stages; only the final gather of KxK
+                covariances does)
+  stock axis    inner, within a slice (the normal-equation psums and
+                cross-sectional reductions stay on ICI)
+
+Usage on each host of a jax.distributed job:
+
+    from mfm_tpu.parallel.distributed import initialize, make_global_mesh
+    initialize()                       # reads env (coordinator, process id)
+    mesh = make_global_mesh(n_stock=4)  # global devices, date x stock
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> bool:
+    """Initialize jax.distributed from args or environment; True if multi-host.
+
+    No-ops (returns False) when running single-process with no coordinator
+    configured, so code paths can be shared between laptop and fleet.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "MFM_COORDINATOR_ADDRESS"
+    )
+    if coordinator_address is None and num_processes is None:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_count() > 1
+
+
+def make_global_mesh(n_stock: int = 1) -> Mesh:
+    """('date', 'stock') mesh over ALL global devices.
+
+    The stock axis is kept within a host's devices (ICI) by construction:
+    global device order enumerates each process's local devices contiguously,
+    and n_stock must divide the local device count.
+    """
+    devs = np.array(jax.devices())
+    if devs.size % n_stock:
+        raise ValueError(f"{n_stock=} must divide device count {devs.size}")
+    local = jax.local_device_count()
+    if n_stock > local:
+        raise ValueError(
+            f"stock axis ({n_stock}) must fit within one host's {local} "
+            "devices so its collectives stay on ICI"
+        )
+    return Mesh(devs.reshape(devs.size // n_stock, n_stock), ("date", "stock"))
+
+
+def process_date_slice(T: int) -> slice:
+    """The date range this host should load (data parallel ingestion):
+    contiguous block partition of [0, T) over processes."""
+    p = jax.process_index()
+    n = jax.process_count()
+    chunk = -(-T // n)
+    return slice(p * chunk, min(T, (p + 1) * chunk))

@@ -1,0 +1,57 @@
+"""Stage artifacts and single-process distributed helpers."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mfm_tpu.data.artifacts import load_artifact, save_artifact, save_risk_outputs
+from mfm_tpu.parallel.distributed import (
+    initialize,
+    make_global_mesh,
+    process_date_slice,
+)
+
+
+def test_artifact_roundtrip(tmp_path):
+    p = str(tmp_path / "stage.npz")
+    arrays = {"a": np.arange(6.0).reshape(2, 3), "b": jnp.ones((4,))}
+    save_artifact(p, arrays, meta={"stage": "nw_cov", "T": 2})
+    out, meta = load_artifact(p)
+    np.testing.assert_array_equal(out["a"], np.arange(6.0).reshape(2, 3))
+    np.testing.assert_array_equal(out["b"], np.ones((4,)))
+    assert meta["stage"] == "nw_cov" and meta["format"] == 1
+
+
+def test_risk_outputs_roundtrip(tmp_path):
+    from mfm_tpu.config import RiskModelConfig
+    from mfm_tpu.models.risk_model import RiskModel
+    from __graft_entry__ import _synthetic_risk_inputs
+
+    args = _synthetic_risk_inputs(20, 12, 3, 2, dtype=jnp.float64, seed=0)
+    rm = RiskModel(*args, n_industries=3,
+                   config=RiskModelConfig(eigen_n_sims=4, eigen_sim_length=40))
+    out = rm.run()
+    p = str(tmp_path / "risk.npz")
+    save_risk_outputs(p, out, meta={"universe": "test"})
+    arrays, meta = load_artifact(p)
+    np.testing.assert_allclose(arrays["factor_ret"], np.asarray(out.factor_ret))
+    np.testing.assert_allclose(arrays["lamb"], np.asarray(out.lamb))
+    assert meta["universe"] == "test"
+
+
+def test_initialize_noop_single_process():
+    assert initialize() is False  # no coordinator configured -> single process
+
+
+def test_make_global_mesh_shapes():
+    mesh = make_global_mesh(n_stock=2)
+    assert mesh.axis_names == ("date", "stock")
+    assert mesh.devices.shape == (4, 2)
+    with pytest.raises(ValueError):
+        make_global_mesh(n_stock=3)
+
+
+def test_process_date_slice_covers_range():
+    s = process_date_slice(100)
+    assert s == slice(0, 100)  # single process owns everything
