@@ -74,9 +74,13 @@ def eigen_risk_adjust_by_time(
     s = jnp.sqrt(jnp.maximum(D0, 0.0))
     B = U0 * s[:, None, :]  # (T, K, K): maps unit draws to factor returns
 
-    # simulated covariances for every (date, sim): F = B C_m B'
+    # simulated covariances for every (date, sim): F = B C_m B'.  The bias
+    # ratios below are invariant to eigenvalue order and eigenvector signs,
+    # so the sim decompositions skip sorting/canonicalization (saves a full
+    # HBM pass over the (T*M, K, K) eigenvector batch)
     F = jnp.einsum("tik,mkl,tjl->tmij", B, sim_covs, B)
-    Dm, Um = batched_eigh(F, prefer_pallas=prefer_pallas)  # (T,M,K), (T,M,K,K)
+    Dm, Um = batched_eigh(F, prefer_pallas=prefer_pallas,
+                          canonical_signs=False, sort=False)
     Dm_hat = jnp.einsum("tmki,tkl,tmli->tmi", Um, safe, Um)
     v2 = jnp.mean(Dm_hat / Dm, axis=1)  # (T, K)
     v = scale_coef * (jnp.sqrt(v2) - 1.0) + 1.0

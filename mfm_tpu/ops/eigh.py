@@ -177,7 +177,7 @@ def eigh_small(A, *, use_jacobi: bool | None = None, canonical_signs=True):
 
 
 def batched_eigh(A, *, prefer_pallas: bool | None = None,
-                 canonical_signs: bool = True):
+                 canonical_signs: bool = True, sort: bool = True):
     """Backend-aware batched eigh for (B, n, n) symmetric matrices.
 
     On TPU with even n <= 128 the VMEM-resident Pallas Jacobi kernel is ~4.4x
@@ -194,7 +194,7 @@ def batched_eigh(A, *, prefer_pallas: bool | None = None,
         from mfm_tpu.ops.eigh_pallas import jacobi_eigh_tpu
 
         flat = A.reshape((-1,) + A.shape[-2:])
-        w, V = jacobi_eigh_tpu(flat, canonical_signs=canonical_signs)
+        w, V = jacobi_eigh_tpu(flat, canonical_signs=canonical_signs, sort=sort)
         return (w.reshape(A.shape[:-1]), V.reshape(A.shape))
     w, V = jnp.linalg.eigh(A)
     if canonical_signs:

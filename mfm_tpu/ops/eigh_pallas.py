@@ -99,14 +99,19 @@ def _make_kernel(n: int, sweeps: int, dtype):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("sweeps", "canonical_signs"))
+@functools.partial(jax.jit, static_argnames=("sweeps", "canonical_signs", "sort"))
 def jacobi_eigh_tpu(A: jax.Array, sweeps: int | None = None,
-                    canonical_signs: bool = True):
+                    canonical_signs: bool = True, sort: bool = True):
     """Batched eigh of symmetric (B, n, n) via the Pallas kernel.
 
     Returns (w (B, n) ascending, V (B, n, n)) like ``np.linalg.eigh``.
     n must be even (the risk model's K = 1 + P + Q = 42 is); odd-n callers
     use :func:`mfm_tpu.ops.eigh.jacobi_eigh`.
+
+    ``sort=False`` skips the eigenvalue ordering + eigenvector reordering and
+    sign pass (a full extra HBM round trip of V) — valid whenever the caller
+    only needs *consistent pairing* of (w_i, v_i), like the eigenfactor
+    Monte-Carlo whose bias ratios are order-invariant.
     """
     B, n, _ = A.shape
     assert n % 2 == 0, "pallas path requires even n"
@@ -139,9 +144,10 @@ def jacobi_eigh_tpu(A: jax.Array, sweeps: int | None = None,
 
     w = w.transpose(0, 2, 1).reshape(nb * LANES, n)[:B]
     V = V.transpose(0, 3, 1, 2).reshape(nb * LANES, n, n)[:B]
-    order = jnp.argsort(w, axis=-1)
-    w = jnp.take_along_axis(w, order, axis=-1)
-    V = jnp.take_along_axis(V, order[:, None, :], axis=-1)
+    if sort:
+        order = jnp.argsort(w, axis=-1)
+        w = jnp.take_along_axis(w, order, axis=-1)
+        V = jnp.take_along_axis(V, order[:, None, :], axis=-1)
     if canonical_signs:
         w, V = canonicalize_signs(w, V)
     return w, V
