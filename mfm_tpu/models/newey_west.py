@@ -43,9 +43,10 @@ def newey_west(ret: jax.Array, q: int = 2, half_life: float = 252.0) -> jax.Arra
 
 
 def newey_west_expanding(
-    ret: jax.Array, q: int = 2, half_life: float = 252.0, min_valid: int | None = None
+    ret: jax.Array, q: int = 2, half_life: float = 252.0,
+    min_valid: int | None = None, method: str = "scan",
 ):
-    """All expanding-window Newey-West covariances in one scan.
+    """All expanding-window Newey-West covariances in one pass.
 
     Returns ``(covs, valid)`` where ``covs[t]`` equals
     ``newey_west(ret[:t+1], q, half_life)`` and ``valid[t]`` is False when
@@ -62,7 +63,15 @@ def newey_west_expanding(
         Gamma_l = (P^l - b^l mu' - mu a^l' + z^l mu mu') / Z
     where a^l = S - (head l terms), b^l = S_{t-l} (the lag-shifted first
     moment), z^l = Z - (head l terms); heads follow their own EWMA recursions.
+
+    ``method``: "scan" runs the O(T) serial lax.scan (the single-chip
+    default); "associative" evaluates the same EWMA recurrences with
+    ``lax.associative_scan`` — O(log T) depth, the date axis stays sharded
+    (the framework's sequence-parallel formulation, see
+    :func:`newey_west_expanding_associative`).
     """
+    if method == "associative":
+        return newey_west_expanding_associative(ret, q, half_life, min_valid)
     T, K = ret.shape
     dtype = ret.dtype
     lam = jnp.asarray(0.5, dtype) ** (1.0 / half_life)
@@ -118,3 +127,92 @@ def newey_west_expanding(
     )
     _, (covs, valid) = jax.lax.scan(step, init, ret)
     return covs, valid
+
+
+def newey_west_expanding_associative(
+    ret: jax.Array, q: int = 2, half_life: float = 252.0,
+    min_valid: int | None = None,
+):
+    """Expanding Newey-West via ``lax.associative_scan`` — the
+    sequence-parallel formulation.
+
+    Every sum in the derivation above is a first-order linear recurrence
+    ``s_t = lam * s_{t-1} + u_t`` with a constant coefficient, so the whole
+    state (Z, S, A, P^l, heads) packs into one vector per date and the prefix
+    family evaluates with an associative combine
+    ``(a1, b1) . (a2, b2) = (a1*a2, a2*b1 + b2)`` in O(log T) depth.  Under
+    pjit with the date axis sharded this parallelizes across devices (the
+    serial lax.scan cannot); it is the framework's analogue of
+    sequence/context parallelism for the long-time-axis workloads
+    (SURVEY.md §5 "long-context").
+
+    The lag-shifted first moments b^l = S_{t-l} come from shifting the
+    scanned S outputs — no lagged state is carried.
+    """
+    T, K = ret.shape
+    dtype = ret.dtype
+    lam = jnp.asarray(0.5, dtype) ** (1.0 / half_life)
+    kmin = K if min_valid is None else min_valid
+    tgrid = jnp.arange(1, T + 1)
+
+    def shift_rows(x, l):
+        if l == 0:
+            return x
+        pad = jnp.zeros((l,) + x.shape[1:], dtype)
+        return jnp.concatenate([pad, x[:-l]], axis=0)
+
+    # per-date inject vectors for each recurrence
+    injects = [
+        jnp.ones((T, 1), dtype),                                     # Z
+        ret,                                                         # S
+        jnp.einsum("ti,tj->tij", ret, ret).reshape(T, K * K),        # A
+    ]
+    for lag in range(1, q + 1):
+        xlag = shift_rows(ret, lag)                                  # x_{t-1-l}
+        injects.append(jnp.einsum("ti,tj->tij", xlag, ret).reshape(T, K * K))
+    for lag in range(1, q + 1):
+        head_on = (tgrid <= lag).astype(dtype)[:, None]
+        injects.append(head_on * ret)                                # h^l
+        injects.append(head_on)                                      # g^l
+    U = jnp.concatenate(injects, axis=1)                             # (T, D)
+
+    a0 = jnp.full((T, 1), lam, dtype)
+
+    def combine(e1, e2):
+        a1, b1 = e1
+        a2, b2 = e2
+        return a1 * a2, a2 * b1 + b2
+
+    _, states = jax.lax.associative_scan(combine, (a0, U), axis=0)
+
+    # unpack per-date states
+    off = 0
+    def take(n):
+        nonlocal off
+        out = states[:, off:off + n]
+        off += n
+        return out
+
+    Z = take(1)[:, 0]
+    S = take(K)
+    A = take(K * K).reshape(T, K, K)
+    Ps = [take(K * K).reshape(T, K, K) for _ in range(q)]
+    heads = [(take(K), take(1)[:, 0]) for _ in range(q)]
+
+    mu = S / Z[:, None]
+    V = A / Z[:, None, None] - jnp.einsum("ti,tj->tij", mu, mu)
+    for li, lag in enumerate(range(1, q + 1)):
+        h_l, g_l = heads[li]
+        a_l = S - h_l
+        b_l = shift_rows(S, lag)          # S_{t-l}
+        z_l = Z - g_l
+        G = (
+            Ps[li]
+            - jnp.einsum("ti,tj->tij", b_l, mu)
+            - jnp.einsum("ti,tj->tij", mu, a_l)
+            + z_l[:, None, None] * jnp.einsum("ti,tj->tij", mu, mu)
+        ) / Z[:, None, None]
+        V = V + (1.0 - lag / (1.0 + q)) * (G + jnp.swapaxes(G, -1, -2))
+
+    valid = (tgrid > q) & (tgrid > kmin)
+    return V, valid

@@ -124,3 +124,31 @@ def test_bayes_shrink_matches_loopy_numpy():
         v = a / (a + s)
         expect[sel] = v * m + (1 - v) * np.abs(vol[sel])
     np.testing.assert_allclose(got, expect, rtol=1e-10)
+
+
+def test_newey_west_associative_matches_scan(fret):
+    covs_s, valid_s = newey_west_expanding(jnp.asarray(fret), q=2, half_life=252.0)
+    covs_a, valid_a = newey_west_expanding(jnp.asarray(fret), q=2,
+                                           half_life=252.0, method="associative")
+    np.testing.assert_array_equal(np.asarray(valid_s), np.asarray(valid_a))
+    np.testing.assert_allclose(np.asarray(covs_a), np.asarray(covs_s),
+                               rtol=1e-9, atol=1e-15)
+
+
+def test_newey_west_associative_date_sharded(fret):
+    """The sequence-parallel path with the date axis sharded over 8 devices."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mfm_tpu.parallel.mesh import make_mesh
+
+    f = jnp.asarray(np.tile(fret, (1, 2)))  # K=10
+    f = jnp.concatenate([f] * 2, axis=0)    # T=180... keep divisible by 8
+    f = f[:176]
+    mesh = make_mesh(8, 1)
+    fs = jax.device_put(f, NamedSharding(mesh, P("date", None)))
+    with jax.set_mesh(mesh):
+        covs, valid = jax.jit(
+            lambda r: newey_west_expanding(r, 2, 252.0, method="associative")
+        )(fs)
+    base, _ = newey_west_expanding(f, 2, 252.0)
+    np.testing.assert_allclose(np.asarray(covs), np.asarray(base),
+                               rtol=1e-8, atol=1e-14)
