@@ -10,7 +10,7 @@ TPU re-design (three structural wins over the reference's loop):
 
 1. ``np.linalg.eig`` on a symmetric PSD matrix becomes a *batched symmetric*
    eigh — and on TPU the VMEM-resident Pallas Jacobi kernel
-   (:mod:`mfm_tpu.ops.eigh_pallas`), ~4.4x XLA's QDWH at this size.
+   (:mod:`mfm_tpu.ops.eigh_pallas`), ~8x XLA's QDWH at this size.
 2. The reference re-seeds ``np.random.seed(m+1)`` *identically for every
    date* (``utils.py:71-74``), so the M standard-normal draw matrices — and
    therefore their sample covariances C_m — are the same for all dates.  We

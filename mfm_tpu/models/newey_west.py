@@ -72,6 +72,8 @@ def newey_west_expanding(
     """
     if method == "associative":
         return newey_west_expanding_associative(ret, q, half_life, min_valid)
+    if method != "scan":
+        raise ValueError(f"method must be 'scan' or 'associative', got {method!r}")
     T, K = ret.shape
     dtype = ret.dtype
     lam = jnp.asarray(0.5, dtype) ** (1.0 / half_life)

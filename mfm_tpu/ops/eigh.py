@@ -180,8 +180,8 @@ def batched_eigh(A, *, prefer_pallas: bool | None = None,
                  canonical_signs: bool = True, sort: bool = True):
     """Backend-aware batched eigh for (B, n, n) symmetric matrices.
 
-    On TPU with even n <= 128 the VMEM-resident Pallas Jacobi kernel is ~4.4x
-    XLA's QDWH eigh at the risk model's scale (139k 42x42 matrices: 3.2s ->
+    On TPU with even n <= 128 the VMEM-resident Pallas Jacobi kernel is ~8x
+    XLA's QDWH eigh at the risk model's scale (139k 42x42 matrices: 1.77s
     measured vs 14.2s); elsewhere XLA/LAPACK eigh wins.  Signs are
     canonicalized either way so both paths produce identical decompositions
     (eigenvalues ascending, leading component positive).

@@ -59,27 +59,36 @@ def _make_kernel(n: int, sweeps: int, dtype):
         c = 1.0 / jnp.sqrt(1.0 + t * t)
         s = t * c
 
-        # rows: A <- J^T A
-        xr = x.reshape(h, 2, n, LANES)
-        top, bot = xr[:, 0], xr[:, 1]
-        cN, sN = c[:, None, :], s[:, None, :]
-        x = jnp.stack([cN * top - sN * bot, sN * top + cN * bot],
-                      axis=1).reshape(n, n, LANES)
-        # cols: A <- A J
-        xc = x.reshape(n, h, 2, LANES)
-        topc, botc = xc[:, :, 0], xc[:, :, 1]
-        cM, sM = c[None, :, :], s[None, :, :]
-        x = jnp.stack([cM * topc - sM * botc, sM * topc + cM * botc],
-                      axis=2).reshape(n, n, LANES)
-        # eigenvector columns: V <- V J
-        vc = v.reshape(n, h, 2, LANES)
-        topv, botv = vc[:, :, 0], vc[:, :, 1]
-        v = jnp.stack([cM * topv - sM * botv, sM * topv + cM * botv],
-                      axis=2).reshape(n, n, LANES)
+        # Rotation and the fixed basis permutation to the next pairing are
+        # fused: each output row/column is the rotated row/column pi[.],
+        # written directly into its permuted slot — one restack per array per
+        # round instead of a rotation pass plus a permutation pass.
+        def rotated(idx):
+            i, even = idx // 2, idx % 2 == 0
+            return (i, even)
 
-        # fixed basis permutation to the next pairing
-        x = perm_cols(perm_rows(x, pi), pi)
-        v = perm_cols(v, pi)
+        # rows: A <- perm_rows(J^T A, pi)
+        rows = []
+        for r in range(n):
+            i, even = rotated(pi[r])
+            a, b = x[2 * i], x[2 * i + 1]           # (n, L)
+            rows.append(c[i] * a - s[i] * b if even
+                        else s[i] * a + c[i] * b)
+        y = jnp.stack(rows, axis=0)                 # (n, n, L)
+        # cols: A <- perm_cols(A J, pi)  (row perm commutes with col rotation)
+        cols, vcols = [], []
+        for q in range(n):
+            i, even = rotated(pi[q])
+            a, b = y[:, 2 * i], y[:, 2 * i + 1]
+            va, vb = v[:, 2 * i], v[:, 2 * i + 1]
+            if even:
+                cols.append(c[i] * a - s[i] * b)
+                vcols.append(c[i] * va - s[i] * vb)
+            else:
+                cols.append(s[i] * a + c[i] * b)
+                vcols.append(s[i] * va + c[i] * vb)
+        x = jnp.stack(cols, axis=1)
+        v = jnp.stack(vcols, axis=1)
         return (x, v)
 
     def kernel(a_ref, w_ref, v_ref):
@@ -99,9 +108,11 @@ def _make_kernel(n: int, sweeps: int, dtype):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("sweeps", "canonical_signs", "sort"))
+@functools.partial(jax.jit, static_argnames=("sweeps", "canonical_signs",
+                                             "sort", "interpret"))
 def jacobi_eigh_tpu(A: jax.Array, sweeps: int | None = None,
-                    canonical_signs: bool = True, sort: bool = True):
+                    canonical_signs: bool = True, sort: bool = True,
+                    interpret: bool = False):
     """Batched eigh of symmetric (B, n, n) via the Pallas kernel.
 
     Returns (w (B, n) ascending, V (B, n, n)) like ``np.linalg.eigh``.
@@ -140,6 +151,7 @@ def jacobi_eigh_tpu(A: jax.Array, sweeps: int | None = None,
             jax.ShapeDtypeStruct((nb, n, LANES), dtype),
             jax.ShapeDtypeStruct((nb, n, n, LANES), dtype),
         ],
+        interpret=interpret,
     )(Ax)
 
     w = w.transpose(0, 2, 1).reshape(nb * LANES, n)[:B]
