@@ -114,8 +114,28 @@ class RiskModelConfig:
     eigen_n_sims: int = 100
     eigen_scale_coef: float = 1.4
     eigen_sim_length: int | None = None  # None => use panel length T (MFM.py:119)
+    # Jacobi sweep cap for the (T, M) simulated eighs on the Pallas TPU path
+    # ("auto" => models.eigen.sim_sweeps_for(K, dtype, sim_length), e.g. 5
+    # at K=42 — measured bitwise-equal to the solver default there at ~30%
+    # less eigen-stage wall-clock; the reduction and the unsorted fast path
+    # only engage when the sims' near-diagonality premise holds, see
+    # models/eigen.py; None => solver default; ignored where batched_eigh
+    # falls back to XLA/LAPACK).  The F0 decomposition always runs at full
+    # precision.
+    eigen_sim_sweeps: int | str | None = "auto"
     vol_regime_half_life: float = 42.0
     seed: int = 0
+
+    def __post_init__(self):
+        s = self.eigen_sim_sweeps
+        ok = s is None or s == "auto" or (
+            isinstance(s, int) and not isinstance(s, bool) and s >= 1
+        )
+        if not ok:
+            raise ValueError(
+                f"eigen_sim_sweeps must be an int >= 1, None, or 'auto'; "
+                f"got {s!r}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

@@ -14,7 +14,10 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from mfm_tpu.utils.prec import highest_matmul_precision
 
+
+@highest_matmul_precision
 def eigenfactor_bias_stat(
     covs: jax.Array,
     valid: jax.Array,
@@ -61,6 +64,7 @@ def eigenfactor_bias_stat(
     return jnp.sqrt(var)
 
 
+@highest_matmul_precision
 def bayes_shrink(
     volatility: jax.Array,
     capital: jax.Array,

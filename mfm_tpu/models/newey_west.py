@@ -23,7 +23,10 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from mfm_tpu.utils.prec import highest_matmul_precision
 
+
+@highest_matmul_precision
 def newey_west(ret: jax.Array, q: int = 2, half_life: float = 252.0) -> jax.Array:
     """Single-window Newey-West covariance of (T, K) factor returns.
 
@@ -42,6 +45,7 @@ def newey_west(ret: jax.Array, q: int = 2, half_life: float = 252.0) -> jax.Arra
     return V
 
 
+@highest_matmul_precision
 def newey_west_expanding(
     ret: jax.Array, q: int = 2, half_life: float = 252.0,
     min_valid: int | None = None, method: str = "scan",

@@ -28,7 +28,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from mfm_tpu.config import RiskModelConfig
-from mfm_tpu.models.eigen import eigen_risk_adjust_by_time, simulated_eigen_covs
+from mfm_tpu.models.eigen import (
+    eigen_risk_adjust_by_time,
+    sim_sweeps_for,
+    simulated_eigen_covs,
+)
 from mfm_tpu.models.newey_west import newey_west_expanding
 from mfm_tpu.models.vol_regime import vol_regime_adjust_by_time
 from mfm_tpu.models.bias import eigenfactor_bias_stat
@@ -95,6 +99,10 @@ class RiskModel:
 
     # -- stage 3 -----------------------------------------------------------
     def eigen_risk_adj_by_time(self, nw_cov, nw_valid, key=None, sim_covs=None):
+        # sim_len stays None for caller-injected sim_covs: their draw count
+        # is unknown, so the adjustment takes the conservative sorted path
+        # at full sweep count (models/eigen.py)
+        sim_len = None
         if sim_covs is None:
             if key is None:
                 key = jax.random.key(self.config.seed)
@@ -103,8 +111,14 @@ class RiskModel:
                 key, self.K, sim_len, self.config.eigen_n_sims,
                 dtype=nw_cov.dtype,
             )
+        # value validation happens in RiskModelConfig.__post_init__
+        sweeps = self.config.eigen_sim_sweeps
+        if sweeps == "auto":
+            sweeps = (None if sim_len is None
+                      else sim_sweeps_for(self.K, nw_cov.dtype, sim_len))
         return eigen_risk_adjust_by_time(
-            nw_cov, nw_valid, sim_covs, self.config.eigen_scale_coef
+            nw_cov, nw_valid, sim_covs, self.config.eigen_scale_coef,
+            sim_sweeps=sweeps, sim_length=sim_len,
         )
 
     # -- stage 4 -----------------------------------------------------------

@@ -50,7 +50,7 @@ def _brent_luk_perms(n: int):
     return f, pi
 
 
-def _check_perm_schedule(n):  # pragma: no cover — dev-time sanity helper
+def _check_perm_schedule(n):  # exercised by tests/test_eigh.py
     b0, pi = _brent_luk_perms(n)
     basis = b0.copy()
     seen = set()
@@ -60,6 +60,9 @@ def _check_perm_schedule(n):  # pragma: no cover — dev-time sanity helper
             seen.add((min(a, b), max(a, b)))
         basis = basis[pi]
     assert len(seen) == n * (n - 1) // 2, len(seen)
+    # pi has order n-1: whole sweeps return the basis to b0 — the Pallas
+    # kernel's output emission order (eigh_pallas._make_kernel) relies on it
+    assert (basis == b0).all()
 
 
 def _sweeps_for(n: int, dtype) -> int:
@@ -177,7 +180,8 @@ def eigh_small(A, *, use_jacobi: bool | None = None, canonical_signs=True):
 
 
 def batched_eigh(A, *, prefer_pallas: bool | None = None,
-                 canonical_signs: bool = True, sort: bool = True):
+                 canonical_signs: bool = True, sort: bool = True,
+                 sweeps: int | None = None):
     """Backend-aware batched eigh for (B, n, n) symmetric matrices.
 
     On TPU with even n <= 128 the VMEM-resident Pallas Jacobi kernel is ~8x
@@ -185,6 +189,10 @@ def batched_eigh(A, *, prefer_pallas: bool | None = None,
     measured vs 14.2s); elsewhere XLA/LAPACK eigh wins.  Signs are
     canonicalized either way so both paths produce identical decompositions
     (eigenvalues ascending, leading component positive).
+
+    ``sweeps`` caps the Jacobi sweep count on the Pallas path only; the
+    XLA/LAPACK fallback (CPU, or odd/large n) always solves to full
+    precision and silently ignores it.
     """
     n = A.shape[-1]
     if prefer_pallas is None:
@@ -194,7 +202,8 @@ def batched_eigh(A, *, prefer_pallas: bool | None = None,
         from mfm_tpu.ops.eigh_pallas import jacobi_eigh_tpu
 
         flat = A.reshape((-1,) + A.shape[-2:])
-        w, V = jacobi_eigh_tpu(flat, canonical_signs=canonical_signs, sort=sort)
+        w, V = jacobi_eigh_tpu(flat, sweeps=sweeps,
+                               canonical_signs=canonical_signs, sort=sort)
         return (w.reshape(A.shape[:-1]), V.reshape(A.shape))
     w, V = jnp.linalg.eigh(A)
     if canonical_signs:

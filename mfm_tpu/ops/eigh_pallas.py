@@ -35,9 +35,19 @@ LANES = 128
 
 
 def _make_kernel(n: int, sweeps: int, dtype):
-    b0, pi = (x.tolist() for x in _brent_luk_perms(n))
+    b0_np, pi_np = _brent_luk_perms(n)
+    b0, pi = b0_np.tolist(), pi_np.tolist()
     h = n // 2
     tiny = float(np.finfo(np.float32).tiny * 100)
+    # pi has order n-1 (asserted in _brent_luk_perms' dev check), so after
+    # sweeps*(n-1) rounds the basis is back to b0: slot j holds original
+    # index b0[j] regardless of sweep count.  Outputs are emitted through
+    # inv = argsort(b0) so slot i of w/V corresponds to ORIGINAL index i —
+    # for near-diagonal input (the eigen Monte-Carlo's G, diagonal ~
+    # ascending D0) the eigenvalue tracking direction i lands at slot i,
+    # which the caller's per-slot statistics rely on (models/eigen.py pairs
+    # slot i with D0[i]).
+    inv = np.argsort(b0_np).tolist()
 
     def perm_rows(x, perm):
         return jnp.stack([x[i] for i in perm], axis=0)
@@ -102,8 +112,9 @@ def _make_kernel(n: int, sweeps: int, dtype):
 
         x, v = jax.lax.fori_loop(0, sweeps * (n - 1), one_round, (x, v))
 
-        w_ref[0] = jnp.stack([x[i, i] for i in range(n)])   # diagonal (n, L)
-        v_ref[0] = v
+        # emit in original index order (see inv above)
+        w_ref[0] = jnp.stack([x[inv[i], inv[i]] for i in range(n)])  # (n, L)
+        v_ref[0] = jnp.stack([v[:, inv[i]] for i in range(n)], axis=1)
 
     return kernel
 
@@ -120,9 +131,12 @@ def jacobi_eigh_tpu(A: jax.Array, sweeps: int | None = None,
     use :func:`mfm_tpu.ops.eigh.jacobi_eigh`.
 
     ``sort=False`` skips the eigenvalue ordering + eigenvector reordering and
-    sign pass (a full extra HBM round trip of V) — valid whenever the caller
-    only needs *consistent pairing* of (w_i, v_i), like the eigenfactor
-    Monte-Carlo whose bias ratios are order-invariant.
+    sign pass (a full extra HBM round trip of V).  Pairing of (w_i, v_i) is
+    always consistent, and slots follow the matrix's ORIGINAL index order:
+    for near-diagonal input, the eigenvalue tracking diagonal direction i is
+    at slot i.  The eigenfactor Monte-Carlo (models/eigen.py) relies on this
+    to pair slot i's bias ratio with F0's i-th eigenvalue; a basis-scrambled
+    slot order would silently mispair the per-direction biases.
     """
     B, n, _ = A.shape
     assert n % 2 == 0, "pallas path requires even n"

@@ -13,6 +13,8 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 
+from mfm_tpu.utils.prec import highest_matmul_precision
+
 
 def _as_mask(x: jax.Array, mask: jax.Array | None) -> jax.Array:
     if mask is None:
@@ -84,6 +86,7 @@ def zscore_cap_weighted(x, cap, mask=None, axis=-1):
     return jnp.where(m, (x - wmu) / sd, jnp.nan)
 
 
+@highest_matmul_precision
 def masked_ols_residuals(y, X, mask=None, *, min_valid: int | None = None):
     """Residuals of OLS y ~ [1, X] over the valid rows of one cross-section.
 

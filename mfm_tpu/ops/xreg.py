@@ -31,6 +31,8 @@ import jax.numpy as jnp
 
 from mfm_tpu.ops.masked import masked_var, zscore_cap_weighted
 
+from mfm_tpu.utils.prec import highest_matmul_precision
+
 
 class CrossSectionResult(NamedTuple):
     factor_ret: jax.Array  # (..., K) pure factor returns [country, P industries, Q styles]
@@ -56,6 +58,7 @@ def _constraint_matrix(ind_cap: jax.Array, Q: int) -> jax.Array:
     return R[:, keep]  # static-shape column delete
 
 
+@highest_matmul_precision
 def cross_section_regress(
     ret: jax.Array,
     cap: jax.Array,
@@ -125,6 +128,7 @@ def cross_section_regress(
     return CrossSectionResult(factor_ret, spec, r2, exposure)
 
 
+@highest_matmul_precision
 def regress_panel(
     ret: jax.Array,
     cap: jax.Array,

@@ -80,6 +80,67 @@ def test_eigen_adjust_by_time_masks_invalid(fret):
         np.testing.assert_allclose(out[t], out[t].T, rtol=1e-10)
 
 
+def test_eigen_adjust_exactly_singular_cov_stays_finite(fret):
+    """A covariance with an exactly-zero eigenvalue (rank-deficient NW
+    window) must not poison the date with 0/0 NaN: the zero direction
+    contributes v^2 * 0 to the rebuild, so the date stays valid and finite,
+    and the nonzero directions match the full-rank computation restricted to
+    them."""
+    K = fret.shape[1]
+    rng = np.random.default_rng(9)
+    draws = rng.standard_normal((8, K, 200))
+    d = draws - draws.mean(axis=-1, keepdims=True)
+    sim_covs = jnp.asarray(np.einsum("mkt,mlt->mkl", d, d) / (200 - 1))
+
+    # diagonal with an exact 0.0 entry: eigh returns the zero eigenvalue
+    # exactly, so the Dm == 0 guard path is hit deterministically
+    evals = np.array([0.0] + list(1e-4 * (1 + np.arange(K - 1))))
+    cov = np.diag(evals)
+    out, ok = eigen_risk_adjust_by_time(
+        jnp.asarray(cov)[None], jnp.ones((1,), bool), sim_covs, 1.4
+    )
+    out, ok = np.asarray(out[0]), bool(ok[0])
+    assert ok
+    assert np.isfinite(out).all()
+    # the zero direction stays (numerically) zero in the adjusted covariance
+    np.testing.assert_allclose(out[:, 0], 0.0, atol=1e-12)
+    np.testing.assert_allclose(out[0, :], 0.0, atol=1e-12)
+
+    # rank deficiency 2: both zero directions stay zero, and no nonzero
+    # direction is deflated by a degenerate slot's bias (the pre-fix Pallas
+    # slot order applied a zero-direction ratio to D0[2], scaling it by
+    # (1-scale_coef)^2 = 0.16)
+    evals2 = np.array([0.0, 0.0] + list(1e-4 * (1 + np.arange(K - 2))))
+    cov2 = np.diag(evals2)
+    out2, ok2 = eigen_risk_adjust_by_time(
+        jnp.asarray(cov2)[None], jnp.ones((1,), bool), sim_covs, 1.4
+    )
+    out2, ok2 = np.asarray(out2[0]), bool(ok2[0])
+    assert ok2 and np.isfinite(out2).all()
+    np.testing.assert_allclose(out2[:2, :], 0.0, atol=1e-12)
+    np.testing.assert_allclose(out2[:, :2], 0.0, atol=1e-12)
+    assert (np.diag(out2)[2:] > 0.3 * evals2[2:]).all()
+
+
+def test_sim_sweeps_gating_and_config_validation():
+    """The sweep reduction only engages when the near-diagonality premise
+    holds (sim_length >= 4*K), and bad eigen_sim_sweeps values raise at
+    config construction instead of deep inside the kernel."""
+    from mfm_tpu.config import RiskModelConfig
+    from mfm_tpu.models.eigen import sim_sweeps_for
+    from mfm_tpu.ops.eigh import _sweeps_for
+
+    assert sim_sweeps_for(42, jnp.float32, 1390) == _sweeps_for(42, jnp.float32) - 2
+    # premise fails -> solver default, no reduction
+    assert sim_sweeps_for(42, jnp.float32, 100) == _sweeps_for(42, jnp.float32)
+
+    for good in ("auto", None, 1, 7):
+        RiskModelConfig(eigen_sim_sweeps=good)
+    for bad in ("Auto", "5", 0, -1, 2.5, True):
+        with pytest.raises(ValueError, match="eigen_sim_sweeps"):
+            RiskModelConfig(eigen_sim_sweeps=bad)
+
+
 def test_vol_regime_matches_golden(fret):
     T, K = fret.shape
     rng = np.random.default_rng(5)
