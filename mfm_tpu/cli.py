@@ -4,6 +4,9 @@ SURVEY.md §1 L7).
   python -m mfm_tpu.cli risk --barra barra_data.csv --out results/
   python -m mfm_tpu.cli factors --panel panel.parquet --industry ind.csv --out results/
   python -m mfm_tpu.cli demo --out results/          # synthetic end-to-end
+  python -m mfm_tpu.cli crosscheck --ours a.csv --external b.csv
+  python -m mfm_tpu.cli etl-verify --store data/     # verify_data.py path
+  python -m mfm_tpu.cli etl-missing --store data/    # fill_missing_data.py path
 """
 
 from __future__ import annotations
@@ -38,6 +41,21 @@ def _risk(args):
     res.final_covariance().to_csv(os.path.join(args.out, "final_covariance.csv"))
     res.lambda_series().to_csv(os.path.join(args.out, "lambda.csv"))
     wall = time.perf_counter() - t0
+    # plotting stays outside the timed region (matplotlib import + render
+    # would otherwise pollute the reported pipeline wall-clock)
+    if args.bias_plot:
+        from mfm_tpu.models.bias import eigenfactor_bias_stat, plot_bias_stats
+
+        o = res.outputs
+        plot_bias_stats(
+            {
+                "newey_west": eigenfactor_bias_stat(
+                    o.nw_cov, o.nw_valid, o.factor_ret),
+                "eigen_adjusted": eigenfactor_bias_stat(
+                    o.eigen_cov, o.eigen_valid, o.factor_ret),
+            },
+            os.path.join(args.out, args.bias_plot),
+        )
     print(json.dumps({
         "dates": int(arrays.ret.shape[0]), "stocks": int(arrays.ret.shape[1]),
         "factors": len(arrays.factor_names()), "wall_s": round(wall, 3),
@@ -102,6 +120,46 @@ def _demo(args):
                       "out": args.out}))
 
 
+def _crosscheck(args):
+    import pandas as pd
+    from mfm_tpu.utils.crosscheck import crosscheck_factors
+
+    def read(p):
+        df = (pd.read_parquet(p) if p.endswith(".parquet")
+              else pd.read_csv(p))
+        # normalize the merge key regardless of the stored dtype so a CSV
+        # side and a parquet side still align
+        df[args.date_col] = pd.to_datetime(df[args.date_col])
+        return df
+
+    rep = crosscheck_factors(
+        read(args.ours), read(args.external),
+        factors=args.factors.split(",") if args.factors else None,
+        date_col=args.date_col, code_col=args.code_col,
+    )
+    if args.out:
+        rep.to_csv(args.out)
+    print(rep.to_json(orient="index"))
+
+
+def _etl_verify(args):
+    from mfm_tpu.data.etl import PanelStore, verify_store
+
+    print(json.dumps(verify_store(PanelStore(args.store), name=args.name,
+                                  code_col=args.code_col,
+                                  date_col=args.date_col)))
+
+
+def _etl_missing(args):
+    from mfm_tpu.data.etl import PanelStore, find_missing_stocks
+
+    missing = find_missing_stocks(PanelStore(args.store),
+                                  universe_name=args.universe,
+                                  data_name=args.name,
+                                  code_col=args.code_col)
+    print(json.dumps({"n_missing": len(missing), "missing": missing}))
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="mfm_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -117,6 +175,8 @@ def main(argv=None):
     r.add_argument("--vr-half-life", type=float, default=42.0)
     r.add_argument("--seed", type=int, default=0)
     r.add_argument("--dtype", default="float32")
+    r.add_argument("--bias-plot", default=None, metavar="FILE.png",
+                   help="also render the USE4 bias-statistic plot into OUT")
     r.set_defaults(fn=_risk)
 
     f = sub.add_parser("factors", help="style-factor production (main.py path)")
@@ -136,6 +196,35 @@ def main(argv=None):
     d.add_argument("--out", default="results")
     d.add_argument("--dtype", default="float32")
     d.set_defaults(fn=_demo)
+
+    c = sub.add_parser("crosscheck",
+                       help="compare factor tables vs an external source "
+                            "(beta.ipynb jqdatasdk check, generalized)")
+    c.add_argument("--ours", required=True)
+    c.add_argument("--external", required=True)
+    c.add_argument("--factors", default=None, help="comma list; default: "
+                   "all shared numeric columns")
+    c.add_argument("--date-col", default="trade_date")
+    c.add_argument("--code-col", default="ts_code")
+    c.add_argument("--out", default=None, help="write report CSV here")
+    c.set_defaults(fn=_crosscheck)
+
+    ev = sub.add_parser("etl-verify",
+                        help="store sanity counters (verify_data.py path)")
+    ev.add_argument("--store", required=True)
+    ev.add_argument("--name", default="daily_prices")
+    ev.add_argument("--code-col", default="ts_code")
+    ev.add_argument("--date-col", default="trade_date")
+    ev.set_defaults(fn=_etl_verify)
+
+    em = sub.add_parser("etl-missing",
+                        help="stocks in the universe with no price rows "
+                             "(fill_missing_data.py path)")
+    em.add_argument("--store", required=True)
+    em.add_argument("--universe", default="stock_info")
+    em.add_argument("--name", default="daily_prices")
+    em.add_argument("--code-col", default="ts_code")
+    em.set_defaults(fn=_etl_missing)
 
     args = ap.parse_args(argv)
     args.fn(args)

@@ -64,6 +64,36 @@ def eigenfactor_bias_stat(
     return jnp.sqrt(var)
 
 
+def plot_bias_stats(bias_by_label: dict, path: str) -> None:
+    """Plot eigenfactor bias statistics per eigen-portfolio rank.
+
+    The reference plots the bias statistic inside ``eigenfactor_bias_stat``
+    itself (``mfm/utils.py:116``, the USE4 acceptance picture: bias ~ 1 after
+    adjustment, U-shaped before).  Compute stays pure here; this renders any
+    number of labelled bias arrays (e.g. {"newey_west": b0, "eigen_adjusted":
+    b1}) to ``path``.  Renders through an explicit Agg canvas so the
+    process-global matplotlib backend (a notebook's inline backend, say) is
+    left untouched.
+    """
+    import numpy as np
+    from matplotlib.backends.backend_agg import FigureCanvasAgg
+    from matplotlib.figure import Figure
+
+    fig = Figure(figsize=(7, 4))
+    FigureCanvasAgg(fig)
+    ax = fig.add_subplot()
+    for label, b in bias_by_label.items():
+        b = np.asarray(b)
+        ax.plot(1 + np.arange(b.shape[0]), b, marker="o", ms=3, lw=1,
+                label=label)
+    ax.axhline(1.0, color="gray", lw=0.8, ls="--")
+    ax.set_xlabel("eigenfactor rank")
+    ax.set_ylabel("bias statistic")
+    ax.legend()
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+
+
 @highest_matmul_precision
 def bayes_shrink(
     volatility: jax.Array,

@@ -1,0 +1,80 @@
+"""External factor cross-validation.
+
+The reference's only external QC is a notebook comparison of its own size /
+beta / momentum series against jqdatasdk's factor service for a single stock
+(``beta.ipynb`` cells 29-30, SURVEY.md §4).  This generalizes that check to
+a first-class tool: align two long-format factor tables on (date, stock) and
+report per-factor agreement statistics over the full overlap, so a vendor
+table (jqdatasdk export, Barra delivery, a previous run) can gate a
+production run instead of an eyeballed plot.
+
+Host-side pandas/NumPy — this is data QC, not TPU compute.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+
+def crosscheck_factors(
+    ours: pd.DataFrame,
+    external: pd.DataFrame,
+    factors: list[str] | None = None,
+    date_col: str = "trade_date",
+    code_col: str = "ts_code",
+) -> pd.DataFrame:
+    """Per-factor agreement between two long (date, stock, factor...) tables.
+
+    Returns a DataFrame indexed by factor with columns:
+
+    - ``n_overlap``   rows where both sides have a finite value
+    - ``pearson``     correlation over the overlap
+    - ``rank_corr``   Spearman (rank) correlation — robust to the vendor
+                      using a different winsorization/standardization
+    - ``max_abs_diff`` / ``mean_abs_diff`` raw-value agreement (only
+      meaningful when both sides use the same normalization)
+    - ``coverage_ours`` / ``coverage_ext`` share of the union each side covers
+    """
+    if factors is None:
+        skip = {date_col, code_col}
+        # pd.api.types handles extension dtypes (StringDtype etc.) that
+        # np.issubdtype cannot interpret
+        factors = [c for c in ours.columns
+                   if c not in skip and c in external.columns
+                   and pd.api.types.is_numeric_dtype(ours[c])]
+    # raw vendor pulls often repeat (date, code) rows; a cartesian merge
+    # would silently double-weight them, so keep the first occurrence
+    keys = [date_col, code_col]
+    merged = ours[keys + factors].drop_duplicates(keys).merge(
+        external[keys + factors].drop_duplicates(keys),
+        on=keys, how="outer", suffixes=("_a", "_b"),
+    )
+    rows = {}
+    for f in factors:
+        a = merged[f + "_a"].to_numpy(float)
+        b = merged[f + "_b"].to_numpy(float)
+        both = np.isfinite(a) & np.isfinite(b)
+        either = np.isfinite(a) | np.isfinite(b)
+        n = int(both.sum())
+        if n >= 2 and np.nanstd(a[both]) > 0 and np.nanstd(b[both]) > 0:
+            pear = float(np.corrcoef(a[both], b[both])[0, 1])
+            ra = pd.Series(a[both]).rank().to_numpy()
+            rb = pd.Series(b[both]).rank().to_numpy()
+            rank = float(np.corrcoef(ra, rb)[0, 1])
+        else:
+            pear = rank = np.nan
+        diff = np.abs(a[both] - b[both]) if n else np.array([np.nan])
+        ne = int(either.sum())
+        rows[f] = {
+            "n_overlap": n,
+            "pearson": pear,
+            "rank_corr": rank,
+            "max_abs_diff": float(np.max(diff)) if n else np.nan,
+            "mean_abs_diff": float(np.mean(diff)) if n else np.nan,
+            "coverage_ours": float(np.isfinite(a).sum() / ne) if ne else 0.0,
+            "coverage_ext": float(np.isfinite(b).sum() / ne) if ne else 0.0,
+        }
+    out = pd.DataFrame.from_dict(rows, orient="index")
+    out.index.name = "factor"
+    return out

@@ -1,0 +1,130 @@
+"""Crosscheck tool, bias-stat plotting, and the QC CLI subcommands.
+
+Reference parity: the jqdatasdk factor comparison (``beta.ipynb`` cells
+29-30), the bias-statistic plot (``mfm/utils.py:116``), and the QC scripts
+``verify_data.py`` / ``fill_missing_data.py`` (SURVEY.md §4)."""
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from mfm_tpu.utils.crosscheck import crosscheck_factors
+
+
+@pytest.fixture
+def factor_tables():
+    rng = np.random.default_rng(0)
+    dates = pd.to_datetime(["2024-01-02", "2024-01-03", "2024-01-04"])
+    codes = [f"s{i:03d}.SZ" for i in range(40)]
+    idx = pd.MultiIndex.from_product([dates, codes],
+                                     names=["trade_date", "ts_code"])
+    a = pd.DataFrame(index=idx).reset_index()
+    a["size"] = rng.standard_normal(len(a))
+    a["beta"] = rng.standard_normal(len(a))
+    b = a.copy()
+    # external agrees on size up to noise, uses a different scaling for beta
+    b["size"] = a["size"] + 1e-6 * rng.standard_normal(len(a))
+    b["beta"] = 2.0 * a["beta"] + 0.5
+    # knock out some coverage on each side
+    a.loc[:10, "size"] = np.nan
+    b.loc[20:25, "size"] = np.nan
+    return a, b
+
+
+def test_crosscheck_statistics(factor_tables):
+    a, b = factor_tables
+    rep = crosscheck_factors(a, b)
+    assert set(rep.index) == {"size", "beta"}
+    # size: near-identical values
+    assert rep.loc["size", "pearson"] > 0.999999
+    assert rep.loc["size", "max_abs_diff"] < 1e-4
+    # beta: affine rescaling -> perfect correlation, large abs diff
+    assert rep.loc["beta", "pearson"] > 0.999999
+    assert rep.loc["beta", "rank_corr"] > 0.999999
+    assert rep.loc["beta", "max_abs_diff"] > 0.1
+    # coverage reflects the knocked-out rows
+    assert rep.loc["size", "coverage_ours"] < 1.0
+    assert rep.loc["size", "coverage_ext"] < 1.0
+    assert rep.loc["size", "n_overlap"] < len(a)
+
+
+def test_crosscheck_duplicate_keys_not_double_counted(factor_tables):
+    a, b = factor_tables
+    # a raw vendor pull repeating every row must not inflate the overlap
+    # (a cartesian merge would square the duplicated keys' weight)
+    b_dup = pd.concat([b, b], ignore_index=True)
+    rep = crosscheck_factors(a, b)
+    rep_dup = crosscheck_factors(a, b_dup)
+    pd.testing.assert_frame_equal(rep, rep_dup)
+
+
+def test_crosscheck_disjoint_tables():
+    a = pd.DataFrame({"trade_date": pd.to_datetime(["2024-01-02"]),
+                      "ts_code": ["x"], "size": [1.0]})
+    b = pd.DataFrame({"trade_date": pd.to_datetime(["2024-01-03"]),
+                      "ts_code": ["y"], "size": [2.0]})
+    rep = crosscheck_factors(a, b)
+    assert rep.loc["size", "n_overlap"] == 0
+    assert np.isnan(rep.loc["size", "pearson"])
+
+
+def test_crosscheck_cli_roundtrip(factor_tables, tmp_path, capsys):
+    from mfm_tpu.cli import main
+
+    a, b = factor_tables
+    pa, pb = str(tmp_path / "a.csv"), str(tmp_path / "b.csv")
+    a.to_csv(pa, index=False)
+    b.to_csv(pb, index=False)
+    out = str(tmp_path / "report.csv")
+    main(["crosscheck", "--ours", pa, "--external", pb, "--out", out])
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["size"]["pearson"] > 0.999
+    assert os.path.exists(out)
+
+
+def test_plot_bias_stats_writes_png(tmp_path):
+    from mfm_tpu.models.bias import plot_bias_stats
+
+    path = str(tmp_path / "bias.png")
+    plot_bias_stats({"before": np.linspace(0.8, 1.4, 10),
+                     "after": np.ones(10)}, path)
+    assert os.path.getsize(path) > 1000
+
+
+def test_risk_cli_bias_plot(tmp_path, capsys):
+    from mfm_tpu.cli import main
+    from mfm_tpu.data.synthetic import synthetic_barra_table
+
+    df, _ = synthetic_barra_table(T=50, N=25, P=3, Q=2, seed=1)
+    barra = str(tmp_path / "barra.csv")
+    df.to_csv(barra, index=False)
+    out = str(tmp_path / "res")
+    main(["risk", "--barra", barra, "--out", out, "--eigen-sims", "4",
+          "--bias-plot", "bias.png"])
+    assert os.path.getsize(os.path.join(out, "bias.png")) > 1000
+    json.loads(capsys.readouterr().out)
+
+
+def test_etl_cli_verify_and_missing(tmp_path, capsys):
+    from mfm_tpu.cli import main
+    from mfm_tpu.data.etl import PanelStore
+
+    store = PanelStore(str(tmp_path / "store"))
+    store.insert("stock_info", pd.DataFrame({"ts_code": ["a", "b", "c"]}))
+    store.insert("daily_prices", pd.DataFrame({
+        "ts_code": ["a", "a", "b"],
+        "trade_date": ["20240102", "20240103", "20240102"],
+        "close": [1.0, 1.1, 2.0],
+    }))
+
+    main(["etl-verify", "--store", str(tmp_path / "store")])
+    rep = json.loads(capsys.readouterr().out)
+    assert rep == {"rows": 3, "stocks": 2, "first_date": "20240102",
+                   "last_date": "20240103"}
+
+    main(["etl-missing", "--store", str(tmp_path / "store")])
+    rep = json.loads(capsys.readouterr().out)
+    assert rep == {"n_missing": 1, "missing": ["c"]}
