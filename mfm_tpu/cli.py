@@ -128,13 +128,19 @@ def _crosscheck(args):
         df = (pd.read_parquet(p) if p.endswith(".parquet")
               else pd.read_csv(p))
         # normalize the merge key regardless of the stored dtype so a CSV
-        # side and a parquet side still align
-        df[args.date_col] = pd.to_datetime(df[args.date_col])
+        # side and a parquet side still align.  Go through str for non-
+        # datetime columns: pd.to_datetime on int64 yyyymmdd (this repo's
+        # native trade_date format) would read them as epoch nanoseconds.
+        col = df[args.date_col]
+        if not pd.api.types.is_datetime64_any_dtype(col):
+            col = pd.to_datetime(col.astype(str))
+        df[args.date_col] = col
         return df
 
     rep = crosscheck_factors(
         read(args.ours), read(args.external),
-        factors=args.factors.split(",") if args.factors else None,
+        factors=([f.strip() for f in args.factors.split(",")]
+                 if args.factors else None),
         date_col=args.date_col, code_col=args.code_col,
     )
     if args.out:

@@ -43,6 +43,12 @@ def crosscheck_factors(
         factors = [c for c in ours.columns
                    if c not in skip and c in external.columns
                    and pd.api.types.is_numeric_dtype(ours[c])]
+    else:
+        missing = [f"{f} ({side})"
+                   for side, df in (("ours", ours), ("external", external))
+                   for f in factors if f not in df.columns]
+        if missing:
+            raise ValueError(f"factor columns not found: {missing}")
     # raw vendor pulls often repeat (date, code) rows; a cartesian merge
     # would silently double-weight them, so keep the first occurrence
     keys = [date_col, code_col]
@@ -52,8 +58,9 @@ def crosscheck_factors(
     )
     rows = {}
     for f in factors:
-        a = merged[f + "_a"].to_numpy(float)
-        b = merged[f + "_b"].to_numpy(float)
+        # vendor tables carry string sentinels ('NULL', '--') — coerce to NaN
+        a = pd.to_numeric(merged[f + "_a"], errors="coerce").to_numpy(float)
+        b = pd.to_numeric(merged[f + "_b"], errors="coerce").to_numpy(float)
         both = np.isfinite(a) & np.isfinite(b)
         either = np.isfinite(a) | np.isfinite(b)
         n = int(both.sum())
@@ -64,7 +71,7 @@ def crosscheck_factors(
             rank = float(np.corrcoef(ra, rb)[0, 1])
         else:
             pear = rank = np.nan
-        diff = np.abs(a[both] - b[both]) if n else np.array([np.nan])
+        diff = np.abs(a[both] - b[both])
         ne = int(either.sum())
         rows[f] = {
             "n_overlap": n,

@@ -85,6 +85,37 @@ def test_crosscheck_cli_roundtrip(factor_tables, tmp_path, capsys):
     assert os.path.exists(out)
 
 
+def test_crosscheck_explicit_factors_validated_and_sentinels_coerced():
+    a = pd.DataFrame({"trade_date": pd.to_datetime(["2024-01-02"] * 3),
+                      "ts_code": ["x", "y", "z"], "size": [1.0, 2.0, 3.0]})
+    b = a.copy()
+    b["size"] = ["1.0", "NULL", "3.0"]  # vendor sentinel -> object dtype
+    rep = crosscheck_factors(a, b, factors=["size"])
+    assert rep.loc["size", "n_overlap"] == 2
+    with pytest.raises(ValueError, match="not found"):
+        crosscheck_factors(a, b, factors=["Beta"])
+
+
+def test_crosscheck_cli_int_yyyymmdd_dates_vs_parquet_datetimes(tmp_path, capsys):
+    """The repo's native trade_date format is int yyyymmdd in CSVs; naive
+    pd.to_datetime would read those as epoch nanoseconds and report zero
+    overlap against a parquet side with real datetimes."""
+    from mfm_tpu.cli import main
+
+    a = pd.DataFrame({"trade_date": [20240102, 20240103],
+                      "ts_code": ["x", "x"], "size": [1.0, 2.0]})
+    b = pd.DataFrame({"trade_date": pd.to_datetime(["2024-01-02", "2024-01-03"]),
+                      "ts_code": ["x", "x"], "size": [1.0, 2.0]})
+    pa, pb = str(tmp_path / "a.csv"), str(tmp_path / "b.parquet")
+    a.to_csv(pa, index=False)
+    b.to_parquet(pb)
+    main(["crosscheck", "--ours", pa, "--external", pb,
+          "--factors", " size"])  # stray space must be stripped
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["size"]["n_overlap"] == 2
+    assert rep["size"]["max_abs_diff"] == 0.0
+
+
 def test_plot_bias_stats_writes_png(tmp_path):
     from mfm_tpu.models.bias import plot_bias_stats
 
