@@ -23,9 +23,14 @@ every timing forces a scalar host transfer of a checksum.
 
 import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
 
 
 def _force(x):
@@ -241,11 +246,110 @@ CONFIGS = {
 }
 
 
+def _probe_backend(attempts=2, timeout=90):
+    """Ask (in a subprocess, so a hung TPU plugin can't wedge this process)
+    which backend JAX actually brings up.  Round 1 died here: the axon TPU
+    client constructor blocks forever when the tunnel is down, and the first
+    `device_put` raised with no JSON emitted (VERDICT.md weak #2).  Returns
+    (platform|None, error|None)."""
+    err = None
+    for i in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print('PLATFORM=' + jax.devices()[0].platform)"],
+                capture_output=True, text=True, timeout=timeout, cwd=REPO,
+            )
+            for line in proc.stdout.splitlines():
+                if line.startswith("PLATFORM="):
+                    return line.split("=", 1)[1], None
+            tail = (proc.stderr or "").strip().splitlines()
+            err = tail[-1] if tail else f"probe rc={proc.returncode}"
+        except subprocess.TimeoutExpired:
+            err = f"backend probe timed out after {timeout}s"
+        if i + 1 < attempts:
+            time.sleep(5 * (i + 1))
+    return None, err
+
+
+def _run_inner(config, platform, timeout):
+    """Run one bench config in a subprocess; return (record|None, error|None).
+    The subprocess prints the JSON record as its last stdout line."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--config", config,
+           "--inner"]
+    if platform:
+        cmd += ["--platform", platform]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return None, f"bench subprocess timed out after {timeout}s"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+            if isinstance(rec, dict) and "metric" in rec:
+                return rec, None
+        except json.JSONDecodeError:
+            continue
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+    return None, (tail[-1] if tail else f"bench rc={proc.returncode}")
+
+
+def _inner_main(args):
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+        import jax
+        # the config API wins over the axon site hook's env pin
+        jax.config.update("jax_platforms", args.platform)
+    rec = CONFIGS[args.config]()
+    import jax
+    rec["backend"] = jax.devices()[0].platform
+    print(json.dumps(rec))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="riskmodel", choices=sorted(CONFIGS))
+    ap.add_argument("--inner", action="store_true",
+                    help="run the bench in-process (no probe/retry harness)")
+    ap.add_argument("--platform", default=None,
+                    help="pin a JAX platform (e.g. cpu) before running")
+    ap.add_argument("--timeout", type=float, default=2400.0,
+                    help="per-attempt subprocess timeout, seconds")
     args = ap.parse_args()
-    print(json.dumps(CONFIGS[args.config]()))
+
+    if args.inner:
+        _inner_main(args)
+        return
+
+    errors = []
+    if args.platform:
+        # an explicit pin is an explicit pin: no silent fallback — a failed
+        # TPU run must not emit a CPU timing under the same metric name
+        probe_err = None
+        attempts = [args.platform]
+    else:
+        platform, probe_err = _probe_backend()
+        # probe OK -> run on the default backend (don't re-pin: the plugin
+        # name, e.g. 'axon', need not match device.platform, e.g. 'tpu');
+        # probe dead -> go straight to the CPU fallback.  Unpinned runs
+        # always end with a CPU attempt so the driver records something.
+        attempts = ([None, "cpu"] if platform else ["cpu"])
+    if probe_err:
+        errors.append(f"probe: {probe_err}")
+    rec = None
+    for plat in attempts:
+        rec, err = _run_inner(args.config, plat, args.timeout)
+        if rec is not None:
+            break
+        errors.append(f"{plat or 'default'}: {err}")
+    if rec is None:
+        # nothing ran to completion — still emit one parseable JSON line
+        rec = {"metric": f"{args.config}_wall", "value": None, "unit": "s",
+               "vs_baseline": None, "backend": None}
+    if errors:
+        rec["errors"] = errors
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

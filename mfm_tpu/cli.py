@@ -120,6 +120,111 @@ def _demo(args):
                       "out": args.out}))
 
 
+def _prepare(args):
+    """Store -> factor-input artifacts (the ``load_and_prepare_data`` path,
+    ``load_data.py:66-418``): a long master panel parquet + index prices +
+    per-stock industry map, consumable by the ``factors`` subcommand."""
+    import pandas as pd
+    from mfm_tpu.data.etl import PanelStore
+    from mfm_tpu.data.prepare import load_and_prepare_data, sw_l1_map
+
+    store = PanelStore(args.store)
+    master, index_px, sw = load_and_prepare_data(
+        store, index_code=args.index_code, start_date=args.start,
+        end_date=args.end, fin_start_date=args.fin_start)
+    os.makedirs(args.out, exist_ok=True)
+    out = master.copy()
+    # encode report/announcement dates as yyyymmdd floats (NaN = none): the
+    # factors path re-ranks end_date into the TTM report id
+    for c in ("balance_sheet_f_ann_date", "financial_indicators_ann_date",
+              "cashflow_f_ann_date", "end_date"):
+        if c in out.columns:
+            dtc = pd.to_datetime(out[c])
+            out[c] = pd.to_numeric(dtc.dt.strftime("%Y%m%d"), errors="coerce")
+    panel_path = os.path.join(args.out, "panel.parquet")
+    index_path = os.path.join(args.out, "index_prices.csv")
+    industry_path = os.path.join(args.out, "industry_map.csv")
+    out.to_parquet(panel_path, index=False)
+    index_px.to_csv(index_path, index=False)
+    stocks = sorted(out["ts_code"].unique())
+    pd.DataFrame({"ts_code": stocks,
+                  "l1_code": sw_l1_map(sw, stocks)}).to_csv(
+        industry_path, index=False)
+    print(json.dumps({"rows": len(out), "stocks": len(stocks),
+                      "panel": panel_path, "index": index_path,
+                      "industry": industry_path}))
+
+
+def _pipeline(args):
+    """One-command end-to-end: raw store -> master panel -> factor table ->
+    risk outputs (the reference's ``main.py`` + ``demo.py`` chain), with a
+    stage artifact between the factor and risk stages for resume."""
+    import numpy as np
+    import pandas as pd
+    from mfm_tpu.config import PipelineConfig, RiskModelConfig
+    from mfm_tpu.data.artifacts import save_risk_outputs
+    from mfm_tpu.data.etl import PanelStore
+    from mfm_tpu.data.prepare import prepare_factor_inputs
+    from mfm_tpu.pipeline import run_factor_pipeline, run_risk_pipeline
+
+    cfg = PipelineConfig(
+        risk=RiskModelConfig(
+            nw_lags=args.nw_lags, nw_half_life=args.nw_half_life,
+            eigen_n_sims=args.eigen_sims, eigen_scale_coef=args.eigen_scale,
+            vol_regime_half_life=args.vr_half_life, seed=args.seed,
+        ),
+        dtype=args.dtype,
+    )
+    os.makedirs(args.out, exist_ok=True)
+    barra_path = os.path.join(args.out, "barra_data.csv")
+    industry_info_path = os.path.join(args.out, "industry_info.csv")
+    t0 = time.perf_counter()
+
+    if args.resume and os.path.exists(barra_path) \
+            and os.path.exists(industry_info_path):
+        barra = pd.read_csv(barra_path)
+    else:
+        store = PanelStore(args.store)
+        prep = prepare_factor_inputs(
+            store, index_code=args.index_code, start_date=args.start,
+            end_date=args.end, fin_start_date=args.fin_start)
+        barra, _ = run_factor_pipeline(
+            prep.fields, prep.index_close, prep.industry_l1,
+            prep.dates, prep.stocks, cfg)
+        barra.to_csv(barra_path, index=False)  # stage artifact (main.py:144)
+        # industry_info: code list fixing the one-hot order (main.py:137-143)
+        sw = store.read("sw_industries")
+        info = (sw.drop_duplicates(subset=["l1_code"])
+                if len(sw) else pd.DataFrame({"l1_code": []}))
+        info = info[info["l1_code"].isin(set(barra["industry"].dropna()))]
+        pd.DataFrame({
+            "code": info["l1_code"],
+            "industry_names": info.get("l1_name", info["l1_code"]),
+        }).sort_values("code").to_csv(industry_info_path, index=False)
+    factor_wall = time.perf_counter() - t0
+
+    codes = pd.read_csv(industry_info_path)["code"].to_numpy()
+    res = run_risk_pipeline(barra_df=barra, config=cfg, industry_codes=codes)
+    # the five demo.py result tables (demo.py:60-94)
+    res.factor_returns().to_csv(os.path.join(args.out, "factor_returns.csv"))
+    res.r_squared().to_csv(os.path.join(args.out, "r_squared.csv"))
+    res.specific_returns().to_csv(os.path.join(args.out, "specific_returns.csv"))
+    res.final_covariance().to_csv(os.path.join(args.out, "final_covariance.csv"))
+    res.lambda_series().to_csv(os.path.join(args.out, "lambda.csv"))
+    save_risk_outputs(os.path.join(args.out, "risk_outputs.npz"), res.outputs,
+                      meta={"source": args.store})
+    print(json.dumps({
+        "rows": int(len(barra)),
+        "dates": int(res.arrays.ret.shape[0]),
+        "stocks": int(res.arrays.ret.shape[1]),
+        "factors": len(res.arrays.factor_names()),
+        "factor_stage_wall_s": round(factor_wall, 3),
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "mean_r2": float(np.nanmean(np.asarray(res.outputs.r2))),
+        "out": args.out,
+    }))
+
+
 def _crosscheck(args):
     import pandas as pd
     from mfm_tpu.utils.crosscheck import crosscheck_factors
@@ -168,6 +273,10 @@ def _etl_missing(args):
 
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="mfm_tpu")
+    ap.add_argument("--platform", default=None, metavar="cpu|tpu",
+                    help="pin the JAX platform via the config API (env "
+                         "JAX_PLATFORMS loses to site hooks that pre-register "
+                         "a TPU plugin; this flag wins)")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     r = sub.add_parser("risk", help="risk model over a barra-format CSV (demo.py path)")
@@ -182,7 +291,8 @@ def main(argv=None):
     r.add_argument("--seed", type=int, default=0)
     r.add_argument("--dtype", default="float32")
     r.add_argument("--bias-plot", default=None, metavar="FILE.png",
-                   help="also render the USE4 bias-statistic plot into OUT")
+                   help="also render the USE4 bias-statistic plot into OUT "
+                        "(needs matplotlib: pip install 'mfm-tpu[plot]')")
     r.set_defaults(fn=_risk)
 
     f = sub.add_parser("factors", help="style-factor production (main.py path)")
@@ -202,6 +312,37 @@ def main(argv=None):
     d.add_argument("--out", default="results")
     d.add_argument("--dtype", default="float32")
     d.set_defaults(fn=_demo)
+
+    pp = sub.add_parser("prepare",
+                        help="store -> master-panel artifacts "
+                             "(load_and_prepare_data path)")
+    pp.add_argument("--store", required=True)
+    pp.add_argument("--out", default="prepared")
+    pp.add_argument("--index-code", default="000300.SH")
+    pp.add_argument("--start", default="20200101")
+    pp.add_argument("--end", default=None)
+    pp.add_argument("--fin-start", default="20190101")
+    pp.set_defaults(fn=_prepare)
+
+    pl = sub.add_parser("pipeline",
+                        help="one command: raw store -> factors -> risk "
+                             "outputs (main.py + demo.py chain)")
+    pl.add_argument("--store", required=True)
+    pl.add_argument("--out", default="results")
+    pl.add_argument("--index-code", default="000300.SH")
+    pl.add_argument("--start", default="20200101")
+    pl.add_argument("--end", default=None)
+    pl.add_argument("--fin-start", default="20190101")
+    pl.add_argument("--resume", action="store_true",
+                    help="reuse the barra_data.csv stage artifact if present")
+    pl.add_argument("--nw-lags", type=int, default=2)
+    pl.add_argument("--nw-half-life", type=float, default=252.0)
+    pl.add_argument("--eigen-sims", type=int, default=100)
+    pl.add_argument("--eigen-scale", type=float, default=1.4)
+    pl.add_argument("--vr-half-life", type=float, default=42.0)
+    pl.add_argument("--seed", type=int, default=0)
+    pl.add_argument("--dtype", default="float32")
+    pl.set_defaults(fn=_pipeline)
 
     c = sub.add_parser("crosscheck",
                        help="compare factor tables vs an external source "
@@ -233,6 +374,10 @@ def main(argv=None):
     em.set_defaults(fn=_etl_missing)
 
     args = ap.parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
     args.fn(args)
 
 

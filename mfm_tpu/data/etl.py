@@ -23,6 +23,7 @@ injectable so the logic is testable hermetically.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import time
 from typing import Callable, Iterable, Sequence
@@ -33,6 +34,7 @@ try:
     import pandas as pd
 except Exception:  # pragma: no cover
     pd = None
+
 
 
 class RateLimiter:
@@ -70,57 +72,238 @@ def with_retry(fn: Callable, attempts: int = 3, backoff_s: float = 5.0,
 
 
 class PanelStore:
-    """Parquet-per-collection store with unique-key dedup and watermarks."""
+    """Partitioned-parquet-per-collection store with unique-key dedup and
+    watermarks.
+
+    Each collection is a directory of append-only part files: an insert
+    writes ONE new part instead of rewriting the whole collection (the
+    round-1 O(total^2) IO finding; the reference's Mongo insert is likewise
+    incremental, ``update_mongo_db.py:118-128``).  Unique-key enforcement
+    uses a per-process key-set cache, loaded once per collection via a
+    key-columns-only scan, then maintained incrementally — so N inserts cost
+    O(rows inserted), not O(total stored) each.  Legacy single-file
+    ``<name>.parquet`` stores are read transparently.
+    """
 
     def __init__(self, root: str):
         if pd is None:  # pragma: no cover
             raise ImportError("pandas required")
         self.root = root
         os.makedirs(root, exist_ok=True)
+        self._keys: dict = {}   # (name, unique cols) -> set of key tuples
 
-    def _path(self, name: str) -> str:
+    def _legacy_path(self, name: str) -> str:
         return os.path.join(self.root, f"{name}.parquet")
 
-    def read(self, name: str):
-        p = self._path(name)
-        if not os.path.exists(p):
+    def _dir(self, name: str) -> str:
+        return os.path.join(self.root, name)
+
+    def _marker_path(self, name: str) -> str:
+        return os.path.join(self._dir(name), "_compact.json")
+
+    def _heal(self, name: str) -> None:
+        """Roll an interrupted _rewrite forward (idempotent).
+
+        The marker is written *after* the merged part and *before* any
+        deletion, so its presence means the merged data is complete: finish
+        the rename, drop the obsolete parts, drop the marker.  A ``.pending``
+        file with no marker is an aborted write — discard it."""
+        d = self._dir(name)
+        if not os.path.isdir(d):
+            return
+        marker = self._marker_path(name)
+        if os.path.exists(marker):
+            with open(marker) as f:
+                m = json.load(f)
+            pending = os.path.join(d, m["pending"])
+            final = os.path.join(d, m["final"])
+            if os.path.exists(pending) and not os.path.exists(final):
+                os.replace(pending, final)
+            for rel in m["obsolete"]:
+                p = os.path.join(self.root, rel)
+                if os.path.exists(p):
+                    os.remove(p)
+            os.remove(marker)
+            self._keys = {k: v for k, v in self._keys.items() if k[0] != name}
+        for f in os.listdir(d):
+            if f.endswith(".pending"):
+                os.remove(os.path.join(d, f))
+
+    def _parts(self, name: str) -> list:
+        self._heal(name)
+        parts = []
+        if os.path.exists(self._legacy_path(name)):
+            parts.append(self._legacy_path(name))
+        d = self._dir(name)
+        if os.path.isdir(d):
+            parts += sorted(
+                os.path.join(d, f) for f in os.listdir(d)
+                if f.endswith(".parquet")
+            )
+        return parts
+
+    def read(self, name: str, columns: Sequence[str] | None = None):
+        parts = self._parts(name)
+        if not parts:
             return pd.DataFrame()
-        return pd.read_parquet(p)
+        cols = list(columns) if columns is not None else None
+        dfs = [pd.read_parquet(p, columns=cols) for p in parts]
+        if len(dfs) == 1:
+            return dfs[0]
+        return pd.concat(dfs, ignore_index=True)
+
+    @staticmethod
+    def _hash_keys(df, cols: tuple) -> np.ndarray:
+        """64-bit key hashes with normalized nulls.
+
+        NaN/None/NaT all normalize to one sentinel per column before hashing
+        so null-keyed rows dedup like ``drop_duplicates`` treats them (NaN !=
+        NaN under tuple equality would re-admit them forever).  Hashing keeps
+        the cache at 8-ish bytes/key instead of a tuple per row — the
+        all-A-share scale (~13.5M daily keys) stays well under a GB.  A
+        64-bit collision silently drops one row with probability ~n^2/2^64
+        (~5e-6 at that scale); the reference's Mongo unique index is exact,
+        so is the on-disk state here — only the admission check is hashed.
+        """
+        kdf = df[list(cols)].copy()
+        for c in kdf.columns:
+            if kdf[c].dtype == object:
+                kdf[c] = kdf[c].where(pd.notna(kdf[c]), None)
+        return pd.util.hash_pandas_object(kdf, index=False).to_numpy(np.uint64)
+
+    def _key_set(self, name: str, cols: tuple) -> set:
+        """Unique-key cache for one collection, kept in sync with the part
+        files on disk: parts written by OTHER store instances since the last
+        look are key-scanned incrementally, and any *deletion* of a seen part
+        (another instance's replace_where/compact) invalidates the cache
+        entirely.  Concurrent writers racing on the same collection still
+        need external locking — the reference's arbiter there is Mongo's
+        unique index."""
+        cache_key = (name, cols)
+        keys, seen_parts = self._keys.get(cache_key, (set(), set()))
+        current = set(self._parts(name))
+        if seen_parts - current:  # a seen part vanished: cache is stale
+            keys, seen_parts = set(), set()
+        for p in sorted(current - seen_parts):
+            cur = pd.read_parquet(p, columns=list(cols))
+            keys.update(self._hash_keys(cur, cols).tolist())
+            seen_parts.add(p)
+        self._keys[cache_key] = (keys, seen_parts)
+        return keys
+
+    def _next_part_index(self, d: str) -> int:
+        idx = -1
+        for f in os.listdir(d):
+            if f.startswith("part-") and f.endswith(".parquet"):
+                try:
+                    idx = max(idx, int(f.split("-")[1]))
+                except ValueError:
+                    continue
+        return idx + 1
+
+    def _write_part(self, name: str, df) -> str:
+        d = self._dir(name)
+        os.makedirs(d, exist_ok=True)
+        # max-existing-index + 1, NOT the file count: after a rewrite removes
+        # parts, a count-based name would collide with (and os.replace would
+        # clobber) a live part
+        n = self._next_part_index(d)
+        path = os.path.join(d, f"part-{n:06d}-{os.getpid()}.parquet")
+        tmp = path + ".tmp"
+        df.to_parquet(tmp, index=False)
+        os.replace(tmp, path)
+        return path
 
     def insert(self, name: str, df, unique: Sequence[str] | None = None):
-        """Append rows; rows whose ``unique`` key already exists are dropped
-        (the unique-index + ordered=False insert semantics)."""
+        """Append rows as one new part; rows whose ``unique`` key already
+        exists are dropped (the unique-index + ordered=False semantics)."""
         if df is None or len(df) == 0:
             return 0
-        cur = self.read(name)
-        if len(cur) and unique:
-            merged = pd.concat([cur, df], ignore_index=True)
-            merged = merged.drop_duplicates(subset=list(unique), keep="first")
-            added = len(merged) - len(cur)
-            merged.to_parquet(self._path(name), index=False)
-            return added
-        out = pd.concat([cur, df], ignore_index=True) if len(cur) else df
-        out.to_parquet(self._path(name), index=False)
+        if unique:
+            cols = tuple(unique)
+            have = self._key_set(name, cols)
+            incoming = self._hash_keys(df, cols).tolist()
+            seen: set = set()
+            keep = np.empty(len(incoming), bool)
+            for i, k in enumerate(incoming):
+                fresh = k not in have and k not in seen
+                keep[i] = fresh
+                if fresh:
+                    seen.add(k)
+            df = df[keep]
+            if not len(df):
+                return 0
+            have.update(seen)
+        else:
+            # un-keyed insert: existing key caches for this collection are
+            # no longer complete
+            self._keys = {k: v for k, v in self._keys.items() if k[0] != name}
+        path = self._write_part(name, df.reset_index(drop=True))
+        if unique:
+            # our own part is already reflected in the key set
+            self._keys[(name, tuple(unique))][1].add(path)
         return len(df)
 
     def replace_where(self, name: str, mask_fn, df):
         """Delete rows matching ``mask_fn`` then insert ``df`` (the index-
-        components refresh pattern)."""
+        components refresh pattern) — compacts the collection."""
         cur = self.read(name)
         if len(cur):
             cur = cur[~mask_fn(cur)]
         out = pd.concat([cur, df], ignore_index=True) if len(cur) else df
-        out.to_parquet(self._path(name), index=False)
+        self._rewrite(name, out)
+
+    def compact(self, name: str):
+        """Merge all parts into one (maintenance; reads stay correct
+        either way)."""
+        cur = self.read(name)
+        if len(cur):
+            self._rewrite(name, cur)
+
+    def _rewrite(self, name: str, df) -> None:
+        """Replace the collection's contents atomically w.r.t. crashes:
+        merged part first, then a marker, then deletions (see _heal)."""
+        old = self._parts(name)
+        d = self._dir(name)
+        os.makedirs(d, exist_ok=True)
+        final = f"part-{self._next_part_index(d):06d}-{os.getpid()}.parquet"
+        pending = final + ".pending"
+        df.reset_index(drop=True).to_parquet(os.path.join(d, pending),
+                                             index=False)
+        marker = {
+            "pending": pending, "final": final,
+            "obsolete": [os.path.relpath(p, self.root) for p in old],
+        }
+        tmp = self._marker_path(name) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(marker, f)
+        os.replace(tmp, self._marker_path(name))
+        os.replace(os.path.join(d, pending), os.path.join(d, final))
+        for p in old:
+            os.remove(p)
+        os.remove(self._marker_path(name))
+        self._keys = {k: v for k, v in self._keys.items() if k[0] != name}
 
     def last_date(self, name: str, date_col: str = "trade_date"):
         """Watermark: newest date present (``update_mongo_db.py:19-30``)."""
-        cur = self.read(name)
-        if not len(cur) or date_col not in cur.columns:
+        parts = self._parts(name)
+        if not parts:
             return None
-        return cur[date_col].max()
+        import pyarrow.parquet as pq
+
+        # a missing date column is a clean None; IO/corruption errors from
+        # the schema read or data read propagate — they must not silently
+        # reset the watermark and trigger a full refetch
+        if date_col not in pq.read_schema(parts[0]).names:
+            return None
+        cur = self.read(name, columns=[date_col])
+        return cur[date_col].max() if len(cur) else None
 
     def distinct_count(self, name: str, col: str) -> int:
-        cur = self.read(name)
+        parts = self._parts(name)
+        if not parts:
+            return 0
+        cur = self.read(name, columns=[col])
         return 0 if not len(cur) else cur[col].nunique()
 
 

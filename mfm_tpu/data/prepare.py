@@ -1,0 +1,241 @@
+"""Master-panel orchestration: PanelStore -> FactorEngine inputs.
+
+The framework's equivalent of the reference's ``load_and_prepare_data()``
+(``Barra_factor_cal/load_data.py:66-418``) plus the SW-industry merge of
+``Barra_factor_cal/main.py:98``:
+
+1. universe = latest index constituents (``load_data.py:92-123``)
+2. load the six collections, projected to the pipeline's columns
+   (``load_data.py:130-257``)
+3. statement dedup — two-pass for balancesheet/cashflow
+   (``load_data.py:268-298``), single-pass for financial indicators
+   (``load_data.py:305-309``)
+4. chained point-in-time as-of joins on announcement dates
+   (``load_data.py:329-378``)
+5. missing-value policy: per-stock ffill then 0 (``load_data.py:390-418``;
+   the reference's trailing per-date median fill runs after ``fillna(0)``
+   and is therefore dead code — see :func:`mfm_tpu.data.pit.fill_missing`)
+6. densify the long master frame into the (T, N) field dict
+   :class:`mfm_tpu.factors.engine.FactorEngine` consumes, plus the aligned
+   index close series and per-stock SW L1 industry codes.
+
+Documented deviations from the reference (quirks, not omissions):
+
+- ``load_data.py:83`` hardcodes ``index_code="000016.SH"`` (SSE 50) inside
+  the nominally-CSI300 pipeline; here the index is a parameter defaulting to
+  CSI300 (``000300.SH``), the universe the rest of the reference uses.
+- The reference ffills *and zero-fills* the announcement/report **date**
+  columns (``load_data.py:396-407``), so pre-first-report rows carry epoch
+  dates.  Here date columns are ffilled but never zero-filled; rows with no
+  report yet get ``end_date_code = -1`` (no-report sentinel), which the TTM
+  kernel treats as missing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Sequence
+
+import numpy as np
+
+from mfm_tpu.data.pit import asof_join, dedup_statements, fill_missing
+from mfm_tpu.panel import Panel
+
+try:
+    import pandas as pd
+except Exception:  # pragma: no cover
+    pd = None
+
+#: projections per collection (load_data.py:134-257)
+DAILY_FIELDS = ("close", "total_mv", "circ_mv", "pb", "turnover_rate", "pe_ttm")
+BALANCE_FIELDS = ("total_ncl", "total_hldr_eqy_inc_min_int")
+INDICATOR_FIELDS = ("q_profit_yoy", "q_sales_yoy", "debt_to_assets")
+CASHFLOW_FIELDS = ("n_cashflow_act",)
+
+#: the master-frame fill set (load_data.py:393-402), numeric part
+FILL_FIELDS = ("pe_ttm", "pb", "total_ncl", "total_hldr_eqy_inc_min_int",
+               "debt_to_assets", "q_sales_yoy", "q_profit_yoy",
+               "n_cashflow_act")
+#: announcement/report date columns, ffilled but never zero-filled (see
+#: module docstring)
+FILL_DATE_COLS = ("balance_sheet_f_ann_date", "financial_indicators_ann_date",
+                  "cashflow_f_ann_date", "end_date")
+
+
+def _to_dt(s, col):
+    out = s.copy()
+    if not pd.api.types.is_datetime64_any_dtype(out[col]):
+        out[col] = pd.to_datetime(out[col].astype(str), format="%Y%m%d")
+    return out
+
+
+def latest_index_constituents(store, index_code: str) -> list:
+    """Universe selection: constituents at the newest trade_date recorded for
+    ``index_code`` (``load_data.py:92-123``)."""
+    comp = store.read("index_components")
+    if not len(comp):
+        raise ValueError("index_components collection is empty")
+    comp = comp[comp["index_code"] == index_code]
+    if not len(comp):
+        raise ValueError(f"no index_components rows for {index_code!r}")
+    latest = comp["trade_date"].max()
+    return sorted(comp.loc[comp["trade_date"] == latest, "con_code"].unique())
+
+
+def dedup_indicators(df, by="ts_code", ann_col="ann_date", end_col="end_date"):
+    """The financial-indicator dedup is SINGLE-pass in the reference
+    (``load_data.py:305-309``): keep the latest report period per (stock,
+    announcement date) — unlike the two-pass statement dedup."""
+    df = df.sort_values([by, ann_col, end_col], ascending=[True, True, False])
+    return df.drop_duplicates(subset=[by, ann_col], keep="first")
+
+
+def load_and_prepare_data(
+    store,
+    index_code: str = "000300.SH",
+    start_date: str | None = "20200101",
+    end_date: str | None = None,
+    fin_start_date: str | None = "20190101",
+    median_fill: bool = False,
+):
+    """Store -> (master long frame, index prices frame, sw industry frame).
+
+    Mirrors ``load_and_prepare_data`` end to end (``load_data.py:66-418``).
+    Returns pandas objects; :func:`prepare_factor_inputs` densifies them.
+    """
+    if pd is None:  # pragma: no cover
+        raise ImportError("pandas required")
+
+    universe = latest_index_constituents(store, index_code)
+
+    def _window(df, col, lo, hi):
+        if lo is not None:
+            df = df[df[col] >= lo]
+        if hi is not None:
+            df = df[df[col] <= hi]
+        return df
+
+    daily = store.read("daily_prices")
+    daily = daily[daily["ts_code"].isin(universe)]
+    daily = _window(daily, "trade_date", start_date, end_date)
+    daily = _to_dt(daily[["ts_code", "trade_date", *DAILY_FIELDS]], "trade_date")
+
+    def _stmt(name, ann_col, cols):
+        df = store.read(name)
+        if not len(df):
+            raise ValueError(f"collection {name!r} is empty")
+        df = df[df["ts_code"].isin(universe)]
+        df = _window(df, "end_date", fin_start_date, end_date)
+        df = df[["ts_code", ann_col, "end_date", *cols]]
+        return _to_dt(_to_dt(df, ann_col), "end_date")
+
+    balance = dedup_statements(
+        _stmt("balancesheet", "f_ann_date", BALANCE_FIELDS))
+    cashflow = dedup_statements(
+        _stmt("cashflow", "f_ann_date", CASHFLOW_FIELDS))
+    indicators = dedup_indicators(
+        _stmt("financial_indicators", "ann_date", INDICATOR_FIELDS))
+
+    index_px = store.read("index_daily_prices")
+    index_px = index_px[index_px["ts_code"] == index_code]
+    index_px = _window(index_px, "trade_date", start_date, end_date)
+    index_px = _to_dt(index_px[["ts_code", "trade_date", "close"]], "trade_date")
+
+    sw = store.read("sw_industries")
+    keep = [c for c in ("ts_code", "l1_code", "l1_name", "in_date",
+                        "out_date", "is_new") if c in sw.columns]
+    sw = sw[keep] if len(sw) else sw
+
+    # --- PIT join chain (load_data.py:329-378) -------------------------------
+    # rename announcement columns up front (the reference renames after each
+    # merge); drop the balancesheet/indicator report periods (end_date_x/_y,
+    # load_data.py:383) so the surviving end_date is the cashflow's
+    balance = balance.rename(columns={"f_ann_date": "balance_sheet_f_ann_date"})
+    balance = balance.drop(columns=["end_date"])
+    indicators = indicators.rename(
+        columns={"ann_date": "financial_indicators_ann_date"})
+    indicators = indicators.drop(columns=["end_date"])
+    cashflow = cashflow.rename(columns={"f_ann_date": "cashflow_f_ann_date"})
+
+    master = asof_join(daily, balance, left_on="trade_date",
+                       right_on="balance_sheet_f_ann_date")
+    master = asof_join(master, indicators, left_on="trade_date",
+                       right_on="financial_indicators_ann_date")
+    master = asof_join(master, cashflow, left_on="trade_date",
+                       right_on="cashflow_f_ann_date")
+
+    # --- fill policy (load_data.py:390-418) ---------------------------------
+    master = fill_missing(master, FILL_FIELDS, median_fill=median_fill)
+    date_cols = [c for c in FILL_DATE_COLS if c in master.columns]
+    master[date_cols] = master.groupby("ts_code", observed=True)[date_cols].ffill()
+    return master, index_px, sw
+
+
+def sw_l1_map(sw, stocks: Sequence) -> np.ndarray:
+    """Per-stock SW L1 code, aligned to ``stocks``.
+
+    The reference merges ``sw_industry_data[['ts_code','l1_code']]`` straight
+    onto the factor frame (``main.py:98``), which silently duplicates rows
+    when a stock has several classification records; here current membership
+    wins (``is_new == 'Y'`` where the column exists, else the last record).
+    """
+    df = sw
+    if len(df) and "is_new" in df.columns:
+        cur = df[df["is_new"] == "Y"]
+        df = cur if len(cur) else df
+    ser = (df.drop_duplicates("ts_code", keep="last")
+           .set_index("ts_code")["l1_code"])
+    return ser.reindex(stocks).to_numpy()
+
+
+@dataclasses.dataclass
+class PreparedData:
+    """Dense FactorEngine inputs + metadata, ready for
+    :func:`mfm_tpu.pipeline.run_factor_pipeline`."""
+
+    fields: Dict[str, np.ndarray]   # (T, N) float arrays + int end_date_code
+    index_close: np.ndarray         # (T,)
+    industry_l1: np.ndarray         # (N,) SW L1 codes
+    dates: np.ndarray               # (T,) datetime64[D]
+    stocks: np.ndarray              # (N,)
+
+
+def prepare_factor_inputs(
+    store,
+    index_code: str = "000300.SH",
+    start_date: str | None = "20200101",
+    end_date: str | None = None,
+    fin_start_date: str | None = "20190101",
+    median_fill: bool = False,
+) -> PreparedData:
+    """The full store -> FactorEngine-fields path (missing piece #1 of
+    VERDICT round 1): universe, collections, dedup, PIT joins, fill,
+    densify."""
+    master, index_px, sw = load_and_prepare_data(
+        store, index_code, start_date, end_date, fin_start_date, median_fill)
+
+    value_cols = list(dict.fromkeys(DAILY_FIELDS + FILL_FIELDS))
+    p = Panel.from_long(master, value_cols=value_cols)
+
+    # report id for the TTM kernel: rank-encode the (ffilled, never
+    # zero-filled) cashflow end_date; NaT -> -1
+    ed = master["end_date"]
+    codes = np.sort(ed.dropna().unique())
+    rid_long = np.where(ed.isna(), -1, np.searchsorted(codes, ed.to_numpy()))
+    t_idx = {d: i for i, d in enumerate(p.dates)}
+    s_idx = {s: j for j, s in enumerate(p.stocks)}
+    rid = np.full((p.T, p.N), -1, np.int32)
+    rid[master["trade_date"].map(t_idx).to_numpy(),
+        master["ts_code"].map(s_idx).to_numpy()] = rid_long
+    fields = dict(p.fields)
+    fields["end_date_code"] = rid
+
+    index_close = (index_px.set_index("trade_date")["close"]
+                   .reindex(pd.Index(p.dates)).to_numpy(np.float64))
+    return PreparedData(
+        fields=fields,
+        index_close=index_close,
+        industry_l1=sw_l1_map(sw, p.stocks),
+        dates=np.asarray(p.dates),
+        stocks=np.asarray(p.stocks),
+    )

@@ -133,6 +133,140 @@ def synthetic_market_panel(
     }
 
 
+def synthetic_collections(
+    store,
+    T: int = 120,
+    N: int = 20,
+    n_industries: int = 5,
+    index_code: str = "000300.SH",
+    seed: int = 0,
+    start: str = "2020-01-02",
+    missing: float = 0.02,
+    listing_gap: float = 0.2,
+    revision_rate: float = 0.3,
+):
+    """Fill a :class:`mfm_tpu.data.etl.PanelStore` with raw tushare-shaped
+    collections (yyyymmdd string dates, the storage format of the reference's
+    Mongo collections, ``update_mongo_db.py:59-342``).
+
+    Produces the six collections ``load_and_prepare_data`` consumes plus
+    ``stock_info``: daily_prices, balancesheet, cashflow,
+    financial_indicators, index_daily_prices, index_components,
+    sw_industries.  ``revision_rate`` of statements get a second announcement
+    (same end_date, later f_ann_date, revised values) to exercise the
+    two-pass dedup; one extra stock exists outside the index to exercise
+    universe selection.
+    """
+    if pd is None:  # pragma: no cover
+        raise ImportError("pandas required")
+    rng = np.random.default_rng(seed)
+    dates = pd.bdate_range(start, periods=T)
+    date_strs = dates.strftime("%Y%m%d")
+    # N constituents + 1 non-member (must be excluded by universe selection)
+    all_stocks = [f"{600000 + i}.SH" for i in range(N + 1)]
+    members, outsider = all_stocks[:N], all_stocks[N]
+    l1_codes = [f"801{(i % n_industries):02d}0.SI" for i in range(N + 1)]
+
+    store.insert("stock_info", pd.DataFrame({
+        "ts_code": all_stocks,
+        "name": [f"stk{i}" for i in range(N + 1)],
+        "list_date": ["20100101"] * (N + 1),
+    }), unique=("ts_code",))
+
+    # --- daily prices (with listing gaps + random holes) -------------------
+    mkt = 0.0003 + 0.01 * rng.standard_normal(T)
+    rows = []
+    for j, code in enumerate(all_stocks):
+        beta = 0.5 + rng.random()
+        ret = beta * mkt + 0.015 * rng.standard_normal(T)
+        close = np.exp(2.0 + rng.standard_normal()) * np.cumprod(1 + ret)
+        mv0 = np.exp(rng.normal(11.0, 1.0))
+        start_i = (rng.integers(1, max(2, T // 3))
+                   if rng.random() < listing_gap else 0)
+        for t in range(start_i, T):
+            if rng.random() < missing:
+                continue
+            rows.append({
+                "ts_code": code, "trade_date": date_strs[t],
+                "close": close[t], "total_mv": mv0 * close[t] / close[0],
+                "circ_mv": 0.7 * mv0 * close[t] / close[0],
+                "pb": np.exp(rng.normal(0.8, 0.3)),
+                "turnover_rate": np.exp(rng.normal(0.0, 0.6)),
+                "pe_ttm": np.exp(rng.normal(3.0, 0.5)),
+            })
+    store.insert("daily_prices", pd.DataFrame(rows),
+                 unique=("ts_code", "trade_date"))
+
+    # --- quarterly statements (with revisions) -----------------------------
+    q_ends = pd.date_range(
+        pd.Timestamp(start) - pd.offsets.QuarterEnd() * 6,
+        dates[-1], freq="QE")
+    bal_rows, cf_rows, fi_rows = [], [], []
+    for code in all_stocks:
+        for qe in q_ends:
+            ann = qe + pd.Timedelta(days=int(rng.integers(20, 80)))
+            rec = {
+                "ts_code": code,
+                "end_date": qe.strftime("%Y%m%d"),
+                "f_ann_date": ann.strftime("%Y%m%d"),
+            }
+            bal_rows.append({**rec,
+                             "total_ncl": np.exp(rng.normal(10.0, 0.5)),
+                             "total_hldr_eqy_inc_min_int":
+                                 np.exp(rng.normal(10.5, 0.5))})
+            cf_rows.append({**rec,
+                            "n_cashflow_act": rng.normal(1e5, 5e4)})
+            fi_rows.append({"ts_code": code,
+                            "end_date": rec["end_date"],
+                            "ann_date": rec["f_ann_date"],
+                            "q_profit_yoy": rng.normal(10, 15),
+                            "q_sales_yoy": rng.normal(8, 12),
+                            "debt_to_assets": 80 * rng.random()})
+            if rng.random() < revision_rate:  # revised announcement
+                ann2 = ann + pd.Timedelta(days=int(rng.integers(5, 40)))
+                bal_rows.append({**rec,
+                                 "f_ann_date": ann2.strftime("%Y%m%d"),
+                                 "total_ncl": np.exp(rng.normal(10.0, 0.5)),
+                                 "total_hldr_eqy_inc_min_int":
+                                     np.exp(rng.normal(10.5, 0.5))})
+                cf_rows.append({**rec,
+                                "f_ann_date": ann2.strftime("%Y%m%d"),
+                                "n_cashflow_act": rng.normal(1e5, 5e4)})
+    store.insert("balancesheet", pd.DataFrame(bal_rows),
+                 unique=("ts_code", "end_date", "f_ann_date"))
+    store.insert("cashflow", pd.DataFrame(cf_rows),
+                 unique=("ts_code", "end_date", "f_ann_date"))
+    store.insert("financial_indicators", pd.DataFrame(fi_rows),
+                 unique=("ts_code", "end_date", "ann_date"))
+
+    # --- index prices + components + SW industries -------------------------
+    store.insert("index_daily_prices", pd.DataFrame({
+        "ts_code": index_code, "trade_date": date_strs,
+        "close": 3000.0 * np.cumprod(1 + mkt),
+    }), unique=("ts_code", "trade_date"))
+    # two snapshots; universe selection must use the latest one only
+    old_members = members[: max(1, N - 2)] + [outsider]
+    comp = pd.concat([
+        pd.DataFrame({"index_code": index_code, "trade_date": date_strs[0],
+                      "con_code": old_members}),
+        pd.DataFrame({"index_code": index_code, "trade_date": date_strs[-1],
+                      "con_code": members}),
+    ])
+    store.insert("index_components", comp,
+                 unique=("index_code", "trade_date", "con_code"))
+    sw = pd.DataFrame({
+        "ts_code": all_stocks, "l1_code": l1_codes,
+        "l1_name": [f"ind_{c[3:5]}" for c in l1_codes],
+        "in_date": "20100101", "out_date": None, "is_new": "Y",
+    })
+    # a stale membership row that must lose to is_new == 'Y'
+    stale = sw.iloc[:2].copy()
+    stale["l1_code"] = "801990.SI"
+    stale["is_new"] = "N"
+    store.insert("sw_industries", pd.concat([stale, sw]))
+    return {"dates": date_strs, "stocks": members, "index_code": index_code}
+
+
 def synthetic_barra_table(
     T: int = 120,
     N: int = 60,

@@ -111,15 +111,21 @@ def eigen_risk_adjust_by_time(
     (measured; 4 sweeps deviates ~8e-3 in the kernel's off-diagonal
     residual, ~5e-4 in the final adjusted covariance).
 
-    ``sim_length`` is the number of draws behind ``sim_covs``.  It gates the
-    per-slot bias pairing: when G is near-diagonal (sim_length >= 4*K) the
-    unsorted Pallas fast path is valid (slot i tracks direction i); when it
-    is not — short panels, or sim_covs injected without declaring a length —
-    the simulated eighs are sorted so ascending sim eigenvalues pair with
-    ascending D0, matching the CPU/XLA fallback and the reference.
+    ``sim_length`` is the number of draws behind ``sim_covs``; it sizes the
+    auto sweep cap (see :func:`sim_sweeps_for`).  The bias pairing itself is
+    **rank-based and order-invariant**: ``Dm_hat`` is computed in whatever
+    slot order the solver emits, then the scalar (Dm, Dm_hat) pairs are
+    sorted by Dm, so ascending sim eigenvalues always pair with ascending
+    D0 — identical semantics on the unsorted Pallas fast path and the
+    always-ascending XLA/LAPACK fallback, even when sampling noise reorders
+    near-degenerate eigenvalues (round-1 advisor finding).  The eigenvector
+    batch itself is never sorted (that would be a full HBM round trip over
+    (T*M, K, K)); only two (T, M, K) value tensors are.
     """
     dtype = covs.dtype
     K = covs.shape[-1]
+    if sim_sweeps is None and sim_length is not None:
+        sim_sweeps = sim_sweeps_for(K, dtype, sim_length)
     eye = jnp.eye(K, dtype=dtype)
     safe = jnp.where(valid[:, None, None], covs, eye)
 
@@ -128,26 +134,35 @@ def eigen_risk_adjust_by_time(
     s = jnp.sqrt(jnp.maximum(D0, 0.0))
 
     # simulated covariances in F0's eigenbasis: G = diag(s) C_m diag(s), an
-    # elementwise scaling (module docstring, point 3).  When G is
-    # near-diagonal (diagonal ~ ascending D0) the sim decompositions skip
-    # the sort + sign pass (a full HBM round trip over the (T*M, K, K)
-    # eigenvector batch): the unsorted Pallas path already yields slot i ~
-    # direction i (its contract, ops/eigh_pallas.py) and the per-slot ratios
-    # below pair with D0[i]; signs cancel in W*W and Dm_hat/Dm.  Otherwise
-    # sort, so pairing is by eigenvalue rank like the CPU/XLA path.
+    # elementwise scaling (module docstring, point 3).  The sim eighs never
+    # sort their eigenvector batch (sort=False skips a full HBM round trip
+    # over (T*M, K, K) on the Pallas path; the XLA fallback is ascending
+    # anyway and ignores the flag); pairing is restored below by sorting the
+    # scalar (Dm, Dm_hat) pairs.  Signs cancel in W*W.
     G = s[:, None, :, None] * sim_covs[None] * s[:, None, None, :]
     Dm, W = batched_eigh(G, prefer_pallas=prefer_pallas,
-                         canonical_signs=False,
-                         sort=not _near_diagonal_sims(K, sim_length),
+                         canonical_signs=False, sort=False,
                          sweeps=sim_sweeps)
     # D_hat = diag(U_m' F0 U_m) with U_m = U0 W  ->  sum_k W_ki^2 D0_k
     Dm_hat = jnp.einsum("tmki,tk->tmi", W * W, D0)
-    # An exactly-zero eigenvalue D0_k = 0 (rank-deficient covariance) zeroes
-    # G's k-th row/column, so the Jacobi leaves that direction untouched and
-    # Dm = Dm_hat = 0.0 exactly there — guard the 0/0.  The substituted ratio
-    # is irrelevant to the output: the rebuild below scales v^2 by D0 = 0 in
-    # that direction.
-    v2 = jnp.mean(Dm_hat / jnp.where(Dm == 0, 1.0, Dm), axis=1)  # (T, K)
+    # rank pairing, order-invariant across backends: i-th smallest sim
+    # eigenvalue pairs with the i-th smallest D0 (D0 is already ascending)
+    order = jnp.argsort(Dm, axis=-1)
+    Dm = jnp.take_along_axis(Dm, order, axis=-1)
+    Dm_hat = jnp.take_along_axis(Dm_hat, order, axis=-1)
+    # A numerically-zero sim eigenvalue (rank-deficient covariance: D0_k = 0
+    # zeroes G's k-th row/column, and LAPACK/Jacobi may emit 0 or -eps there)
+    # would make the ratio 0/0 or a huge spurious value — substitute ratio 1
+    # wherever |Dm| is below eps * lambda_max.  The substituted value only
+    # shifts v in directions the rebuild then scales by D0 ~ 0.
+    eps = jnp.asarray(jnp.finfo(dtype).eps, dtype)
+    thr = eps * jnp.max(jnp.abs(Dm), axis=-1, keepdims=True)
+    degenerate = jnp.abs(Dm) <= thr
+    ratio = jnp.where(degenerate, 1.0,
+                      Dm_hat / jnp.where(degenerate, 1.0, Dm))
+    # clamp: tiny-negative Dm just above thr could still push the mean
+    # negative, and sqrt of a negative poisons the whole date with NaN
+    v2 = jnp.maximum(jnp.mean(ratio, axis=1), 0.0)  # (T, K)
     v = scale_coef * (jnp.sqrt(v2) - 1.0) + 1.0
 
     out = jnp.einsum("tik,tk,tjk->tij", U0, v * v * D0, U0)

@@ -30,7 +30,6 @@ import numpy as np
 from mfm_tpu.config import RiskModelConfig
 from mfm_tpu.models.eigen import (
     eigen_risk_adjust_by_time,
-    sim_sweeps_for,
     simulated_eigen_covs,
 )
 from mfm_tpu.models.newey_west import newey_west_expanding
@@ -98,11 +97,12 @@ class RiskModel:
         )
 
     # -- stage 3 -----------------------------------------------------------
-    def eigen_risk_adj_by_time(self, nw_cov, nw_valid, key=None, sim_covs=None):
-        # sim_len stays None for caller-injected sim_covs: their draw count
-        # is unknown, so the adjustment takes the conservative sorted path
-        # at full sweep count (models/eigen.py)
-        sim_len = None
+    def eigen_risk_adj_by_time(self, nw_cov, nw_valid, key=None, sim_covs=None,
+                               sim_length=None):
+        # ``sim_length`` lets callers that inject sim_covs declare the draw
+        # count behind them, enabling the production auto-sweep path (e.g.
+        # tools/tpu_parity.py).  Undeclared (None) means full sweep count.
+        sim_len = sim_length
         if sim_covs is None:
             if key is None:
                 key = jax.random.key(self.config.seed)
@@ -111,11 +111,12 @@ class RiskModel:
                 key, self.K, sim_len, self.config.eigen_n_sims,
                 dtype=nw_cov.dtype,
             )
-        # value validation happens in RiskModelConfig.__post_init__
+        # value validation happens in RiskModelConfig.__post_init__; "auto"
+        # (None here) lets eigen_risk_adjust_by_time derive the sweep cap
+        # from sim_length via sim_sweeps_for
         sweeps = self.config.eigen_sim_sweeps
         if sweeps == "auto":
-            sweeps = (None if sim_len is None
-                      else sim_sweeps_for(self.K, nw_cov.dtype, sim_len))
+            sweeps = None
         return eigen_risk_adjust_by_time(
             nw_cov, nw_valid, sim_covs, self.config.eigen_scale_coef,
             sim_sweeps=sweeps, sim_length=sim_len,
@@ -129,11 +130,11 @@ class RiskModel:
         )
 
     # -- full pipeline ------------------------------------------------------
-    def run(self, key=None, sim_covs=None) -> RiskModelOutputs:
+    def run(self, key=None, sim_covs=None, sim_length=None) -> RiskModelOutputs:
         factor_ret, specific_ret, r2 = self.reg_by_time()
         nw_cov, nw_valid = self.newey_west_by_time(factor_ret)
         eigen_cov, eigen_valid = self.eigen_risk_adj_by_time(
-            nw_cov, nw_valid, key=key, sim_covs=sim_covs
+            nw_cov, nw_valid, key=key, sim_covs=sim_covs, sim_length=sim_length
         )
         vr_cov, lamb = self.vol_regime_adj_by_time(factor_ret, eigen_cov, eigen_valid)
         return RiskModelOutputs(

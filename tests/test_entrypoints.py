@@ -1,0 +1,61 @@
+"""Subprocess tests for the two driver entry points (`__graft_entry__.py`).
+
+These are the only functions the harness actually calls, and round 1 shipped
+with an un-tested hang in `dryrun_multichip` (bare device query initializing
+the axon TPU plugin, which blocks when the tunnel is down — VERDICT.md weak
+#1).  Each test runs the literal driver command in a fresh subprocess with a
+hard timeout so a regression shows up as a test failure, not a driver
+timeout.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(code, env_extra, timeout):
+    env = dict(os.environ)
+    # mimic the driver env: XLA_FLAGS carries the virtual device count; do
+    # NOT pin JAX_PLATFORMS — surviving an env that points at a dead TPU
+    # backend is exactly what these tests gate.
+    env.pop("JAX_PLATFORMS", None)
+    env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_8_devices_driver_command():
+    # the literal driver gate: N virtual CPU devices, one sharded step
+    proc = _run(
+        "import __graft_entry__; __graft_entry__.dryrun_multichip(8)",
+        {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "dryrun_multichip OK on 8 devices" in proc.stdout
+
+
+@pytest.mark.slow
+def test_entry_compiles_and_runs_single_chip():
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu');\n"
+        "import __graft_entry__\n"
+        "fn, args = __graft_entry__.entry()\n"
+        "out = jax.jit(fn)(*args)\n"
+        "jax.block_until_ready(out)\n"
+        "print('entry OK', [getattr(o, 'shape', None) for o in out])\n"
+    )
+    proc = _run(code, {}, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "entry OK" in proc.stdout

@@ -123,6 +123,207 @@ def test_statements_and_components(tmp_path):
     assert len(store.read("index_components")) == 2
 
 
+def _day_frame(d, n=40):
+    return pd.DataFrame({
+        "ts_code": [f"{600000 + i}.SH" for i in range(n)],
+        "trade_date": [f"2024{d // 31 + 1:02d}{d % 31 + 1:02d}"] * n,
+        "close": np.linspace(1, 2, n) + d,
+    })
+
+
+def test_insert_appends_without_rescanning(tmp_path, monkeypatch):
+    """The round-1 O(total^2) finding: an insert must not re-read the whole
+    collection.  After the one-time key scan, N inserts perform zero reads."""
+    store = PanelStore(str(tmp_path))
+    reads = []
+    orig = PanelStore.read
+
+    def counting_read(self, name, columns=None):
+        reads.append(name)
+        return orig(self, name, columns)
+
+    monkeypatch.setattr(PanelStore, "read", counting_read)
+    for d in range(60):
+        store.insert("daily_prices", _day_frame(d),
+                     unique=("ts_code", "trade_date"))
+    assert reads.count("daily_prices") <= 1
+    monkeypatch.setattr(PanelStore, "read", orig)
+    assert len(store.read("daily_prices")) == 60 * 40
+
+    # a fresh instance (cold key cache) still dedups against what's on disk
+    s2 = PanelStore(str(tmp_path))
+    assert s2.insert("daily_prices", _day_frame(0),
+                     unique=("ts_code", "trade_date")) == 0
+    assert s2.last_date("daily_prices") == _day_frame(59)["trade_date"][0]
+
+
+@pytest.mark.slow
+def test_insert_wall_clock_grows_linearly(tmp_path):
+    import time as _time
+
+    store = PanelStore(str(tmp_path))
+
+    def batch(lo, hi):
+        t0 = _time.perf_counter()
+        for d in range(lo, hi):
+            store.insert("daily_prices", _day_frame(d),
+                         unique=("ts_code", "trade_date"))
+        return _time.perf_counter() - t0
+
+    first = batch(0, 100)
+    # warm steady state: per-insert cost must not scale with store size (the
+    # old full-rewrite design was >5x slower by the second batch); generous
+    # margin because this is a wall-clock assertion on shared hardware
+    second = batch(100, 200)
+    assert second < 5.0 * max(first, 0.05), (first, second)
+
+
+def test_legacy_single_file_store_reads_and_dedups(tmp_path):
+    legacy = pd.DataFrame({"ts_code": ["A", "B"], "trade_date": ["d1", "d1"],
+                           "close": [1.0, 2.0]})
+    legacy.to_parquet(str(tmp_path / "x.parquet"), index=False)
+    store = PanelStore(str(tmp_path))
+    assert len(store.read("x")) == 2
+    # inserts dedup against the legacy file and append as parts
+    added = store.insert("x", pd.DataFrame({
+        "ts_code": ["A", "C"], "trade_date": ["d1", "d1"],
+        "close": [9.0, 3.0]}), unique=("ts_code", "trade_date"))
+    assert added == 1
+    got = store.read("x").sort_values("ts_code")
+    assert list(got["ts_code"]) == ["A", "B", "C"]
+    assert got[got.ts_code == "A"]["close"].item() == 1.0  # first wins
+
+
+def test_compact_preserves_contents(tmp_path):
+    store = PanelStore(str(tmp_path))
+    for d in range(5):
+        store.insert("y", _day_frame(d, n=3), unique=("ts_code", "trade_date"))
+    before = store.read("y").sort_values(["trade_date", "ts_code"])
+    assert len(store._parts("y")) == 5
+    store.compact("y")
+    assert len(store._parts("y")) == 1
+    after = store.read("y").sort_values(["trade_date", "ts_code"])
+    pd.testing.assert_frame_equal(before.reset_index(drop=True),
+                                  after.reset_index(drop=True))
+    # key cache was reset; dedup still correct post-compaction
+    assert store.insert("y", _day_frame(0, n=3),
+                        unique=("ts_code", "trade_date")) == 0
+
+
+def test_repeated_rewrites_do_not_clobber(tmp_path):
+    """Part names must come from max-index+1, not the file count: two
+    consecutive replace_where calls previously wiped the collection."""
+    store = PanelStore(str(tmp_path))
+    store.insert("c", pd.DataFrame({"index_code": ["i"], "trade_date": ["d1"],
+                                    "con_code": ["A"]}))
+    for day in ("d2", "d3"):
+        store.replace_where(
+            "c", lambda cur, day=day: cur["trade_date"] == day,
+            pd.DataFrame({"index_code": ["i"], "trade_date": [day],
+                          "con_code": ["A"]}))
+    got = store.read("c")
+    assert sorted(got["trade_date"]) == ["d1", "d2", "d3"]
+
+    # compact followed by inserts must also not collide/lose parts
+    store2 = PanelStore(str(tmp_path / "s2"))
+    for d in range(3):
+        store2.insert("y", _day_frame(d, n=2), unique=("ts_code", "trade_date"))
+    store2.compact("y")
+    for d in range(3, 7):
+        store2.insert("y", _day_frame(d, n=2), unique=("ts_code", "trade_date"))
+    assert len(store2.read("y")) == 7 * 2
+
+
+def test_nan_unique_keys_dedup_like_drop_duplicates(tmp_path):
+    """Null key values (real in tushare announcement dates) must dedup:
+    NaN != NaN under tuple equality previously re-admitted them forever."""
+    store = PanelStore(str(tmp_path))
+    df = pd.DataFrame({
+        "ts_code": ["A", "A"], "end_date": ["20240331", "20240630"],
+        "f_ann_date": [None, np.nan],
+        "n_cashflow_act": [1.0, 2.0],
+    })
+    u = ("ts_code", "end_date", "f_ann_date")
+    assert store.insert("cashflow", df, unique=u) == 2
+    assert store.insert("cashflow", df, unique=u) == 0
+    # and across a fresh instance (keys reloaded from parquet)
+    assert PanelStore(str(tmp_path)).insert("cashflow", df, unique=u) == 0
+    assert len(store.read("cashflow")) == 2
+
+
+def test_cross_instance_deletion_invalidates_cache(tmp_path):
+    """replace_where by ANOTHER instance must not leave this instance's key
+    cache claiming the deleted keys still exist (silent row loss)."""
+    a = PanelStore(str(tmp_path))
+    b = PanelStore(str(tmp_path))
+    u = ("index_code", "trade_date", "con_code")
+    row = pd.DataFrame({"index_code": ["i"], "trade_date": ["d1"],
+                        "con_code": ["A"]})
+    assert a.insert("c", row, unique=u) == 1
+    b.replace_where("c", lambda cur: cur["trade_date"] == "d1",
+                    pd.DataFrame({"index_code": ["i"], "trade_date": ["d2"],
+                                  "con_code": ["A"]}))
+    # the d1 row is gone on disk; A must accept its corrected re-insert
+    assert a.insert("c", row, unique=u) == 1
+    got = a.read("c")
+    assert sorted(got["trade_date"]) == ["d1", "d2"]
+
+
+def test_interrupted_rewrite_heals_without_duplicates(tmp_path):
+    store = PanelStore(str(tmp_path))
+    u = ("ts_code", "trade_date")
+    for d in range(3):
+        store.insert("y", _day_frame(d, n=2), unique=u)
+    before = store.read("y").sort_values(["trade_date", "ts_code"])
+
+    # simulate a crash mid-_rewrite: merged part + marker written, old parts
+    # NOT yet deleted (the double-count window)
+    old = store._parts("y")
+    d = store._dir("y")
+    final = f"part-{store._next_part_index(d):06d}-999.parquet"
+    before.reset_index(drop=True).to_parquet(
+        os.path.join(d, final), index=False)
+    import json as _json
+    with open(store._marker_path("y"), "w") as f:
+        _json.dump({"pending": final + ".pending", "final": final,
+                    "obsolete": [os.path.relpath(p, store.root) for p in old]},
+                   f)
+
+    fresh = PanelStore(str(tmp_path))
+    after = fresh.read("y").sort_values(["trade_date", "ts_code"])
+    assert len(after) == len(before)  # healed: no doubled rows
+    pd.testing.assert_frame_equal(before.reset_index(drop=True),
+                                  after.reset_index(drop=True))
+    assert not os.path.exists(store._marker_path("y"))
+    assert fresh.insert("y", _day_frame(0, n=2), unique=u) == 0
+
+
+def test_second_instance_inserts_are_seen(tmp_path):
+    """A stale per-instance key cache must not re-admit keys another store
+    instance wrote to the same root."""
+    a = PanelStore(str(tmp_path))
+    b = PanelStore(str(tmp_path))
+    u = ("ts_code", "trade_date")
+    assert a.insert("d", _day_frame(0, n=2), unique=u) == 2
+    assert b.insert("d", _day_frame(1, n=2), unique=u) == 2
+    assert a.insert("d", _day_frame(1, n=2), unique=u) == 0  # stale cache
+    assert len(a.read("d")) == 4
+
+
+def test_corrupt_part_does_not_reset_watermark(tmp_path):
+    store = PanelStore(str(tmp_path))
+    store.insert("daily_prices", _day_frame(0), unique=("ts_code", "trade_date"))
+    part = store._parts("daily_prices")[0]
+    with open(part, "wb") as f:
+        f.write(b"not parquet")
+    with pytest.raises(Exception):
+        store.last_date("daily_prices")  # surfaced, not None
+    # a missing date column, by contrast, is a clean None
+    s2 = PanelStore(str(tmp_path / "s2"))
+    s2.insert("z", pd.DataFrame({"a": [1]}))
+    assert s2.last_date("z") is None
+
+
 def test_repair_and_verify(tmp_path):
     store = PanelStore(str(tmp_path))
     store.insert("stock_info", pd.DataFrame({"ts_code": ["A", "B", "C"]}))

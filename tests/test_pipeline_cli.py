@@ -1,0 +1,81 @@
+"""End-to-end CLI smoke tests: raw synthetic collections -> prepare ->
+factors -> risk, and the one-command ``pipeline`` path (VERDICT round-1
+missing #2).  Asserts all five demo.py result tables exist and are sane."""
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from mfm_tpu.cli import main as cli_main
+from mfm_tpu.data.etl import PanelStore
+from mfm_tpu.data.synthetic import synthetic_collections
+
+
+@pytest.fixture(scope="module")
+def store_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("store")
+    synthetic_collections(PanelStore(str(d)), T=100, N=16, n_industries=4,
+                          seed=7)
+    return str(d)
+
+
+RESULT_TABLES = ("factor_returns.csv", "r_squared.csv",
+                 "specific_returns.csv", "final_covariance.csv", "lambda.csv")
+
+
+def test_pipeline_one_command(store_dir, tmp_path, capsys):
+    out = str(tmp_path / "results")
+    cli_main(["pipeline", "--store", store_dir, "--out", out,
+              "--eigen-sims", "8", "--start", "20200101"])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["stocks"] == 16
+    assert rec["rows"] > 0
+
+    # stage artifacts
+    assert os.path.exists(os.path.join(out, "barra_data.csv"))
+    assert os.path.exists(os.path.join(out, "industry_info.csv"))
+    assert os.path.exists(os.path.join(out, "risk_outputs.npz"))
+    for name in RESULT_TABLES:  # the five demo.py:60-94 tables
+        assert os.path.exists(os.path.join(out, name)), name
+
+    fr = pd.read_csv(os.path.join(out, "factor_returns.csv"), index_col=0)
+    info = pd.read_csv(os.path.join(out, "industry_info.csv"))
+    # country + industries + 10 styles
+    assert fr.shape[1] == 1 + len(info) + 10
+    assert np.isfinite(fr.to_numpy()).any()
+    r2 = pd.read_csv(os.path.join(out, "r_squared.csv"), index_col=0)
+    assert np.nanmean(r2.to_numpy()) > 0.0
+
+    cov = pd.read_csv(os.path.join(out, "final_covariance.csv"), index_col=0)
+    assert cov.shape[0] == cov.shape[1] == fr.shape[1]
+    c = cov.to_numpy()
+    assert np.allclose(c, c.T, atol=1e-8)
+
+    # resume path: reuses the stage artifact without touching the store
+    cli_main(["pipeline", "--store", str(tmp_path / "nonexistent"),
+              "--out", out, "--resume", "--eigen-sims", "8"])
+    rec2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec2["rows"] == rec["rows"]
+
+
+def test_prepare_then_factors_chain(store_dir, tmp_path, capsys):
+    prep_out = str(tmp_path / "prepared")
+    cli_main(["prepare", "--store", store_dir, "--out", prep_out,
+              "--start", "20200101"])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["stocks"] == 16
+    for k in ("panel", "index", "industry"):
+        assert os.path.exists(rec[k])
+
+    fact_out = str(tmp_path / "factors")
+    cli_main(["factors", "--panel", rec["panel"], "--index", rec["index"],
+              "--industry", rec["industry"], "--out", fact_out])
+    rec2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    barra = pd.read_csv(rec2["out"])
+    for col in ("date", "stocknames", "capital", "ret", "industry", "size",
+                "beta", "momentum", "residual_volatility", "liquidity"):
+        assert col in barra.columns, col
+    assert barra["stocknames"].nunique() == 16

@@ -1,0 +1,155 @@
+"""Golden tests for the store -> FactorEngine-fields orchestration
+(``mfm_tpu/data/prepare.py`` vs a straight pandas re-implementation of the
+reference's ``load_and_prepare_data`` chain, ``load_data.py:66-418``)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from mfm_tpu.data.etl import PanelStore
+from mfm_tpu.data.prepare import (
+    latest_index_constituents,
+    load_and_prepare_data,
+    prepare_factor_inputs,
+    sw_l1_map,
+    DAILY_FIELDS,
+    FILL_FIELDS,
+)
+from mfm_tpu.data.synthetic import synthetic_collections
+
+
+@pytest.fixture(scope="module")
+def store(tmp_path_factory):
+    s = PanelStore(str(tmp_path_factory.mktemp("collections")))
+    synthetic_collections(s, T=90, N=12, n_industries=4, seed=3)
+    return s
+
+
+def _golden_master(store, universe, index_code):
+    """The reference's chain, written independently with per-stock
+    ``pd.merge_asof`` (``load_data.py:41-62``) and explicit dedup sorts
+    (``load_data.py:268-309``)."""
+    def dt(df, cols):
+        df = df.copy()
+        for c in cols:
+            df[c] = pd.to_datetime(df[c].astype(str), format="%Y%m%d")
+        return df
+
+    daily = store.read("daily_prices")
+    daily = dt(daily[daily.ts_code.isin(universe)], ["trade_date"])
+    daily = daily[["ts_code", "trade_date", *DAILY_FIELDS]]
+
+    def two_pass(name, ann, cols):
+        df = dt(store.read(name), [ann, "end_date"])
+        df = df[df.ts_code.isin(universe)]
+        df = df.sort_values(["ts_code", "end_date", ann],
+                            ascending=[True, True, False])
+        df = df.drop_duplicates(["ts_code", "end_date"], keep="first")
+        df = df.sort_values(["ts_code", ann, "end_date"],
+                            ascending=[True, True, False])
+        df = df.drop_duplicates(["ts_code", ann], keep="first")
+        return df[["ts_code", ann, "end_date", *cols]]
+
+    bal = two_pass("balancesheet", "f_ann_date",
+                   ["total_ncl", "total_hldr_eqy_inc_min_int"])
+    cf = two_pass("cashflow", "f_ann_date", ["n_cashflow_act"])
+    fi = dt(store.read("financial_indicators"), ["ann_date", "end_date"])
+    fi = fi[fi.ts_code.isin(universe)]
+    fi = fi.sort_values(["ts_code", "ann_date", "end_date"],
+                        ascending=[True, True, False])
+    fi = fi.drop_duplicates(["ts_code", "ann_date"], keep="first")
+    fi = fi[["ts_code", "ann_date", "end_date",
+             "q_profit_yoy", "q_sales_yoy", "debt_to_assets"]]
+
+    def per_stock_asof(left, right, right_on):
+        chunks = []
+        for code, lg in left.groupby("ts_code", observed=True):
+            rg = right[right.ts_code == code].sort_values(right_on)
+            rg = rg.drop(columns=["ts_code"])
+            merged = pd.merge_asof(lg.sort_values("trade_date"), rg,
+                                   left_on="trade_date", right_on=right_on,
+                                   direction="backward")
+            chunks.append(merged)
+        return pd.concat(chunks, ignore_index=True)
+
+    m = per_stock_asof(daily, bal.rename(columns={"end_date": "ed_bal"}),
+                       "f_ann_date")
+    m = m.rename(columns={"f_ann_date": "balance_sheet_f_ann_date"})
+    m = per_stock_asof(m, fi.rename(columns={"end_date": "ed_fi"}), "ann_date")
+    m = m.rename(columns={"ann_date": "financial_indicators_ann_date"})
+    m = per_stock_asof(m, cf, "f_ann_date")
+    m = m.rename(columns={"f_ann_date": "cashflow_f_ann_date"})
+    m = m.drop(columns=["ed_bal", "ed_fi"])
+
+    m = m.sort_values(["ts_code", "trade_date"]).reset_index(drop=True)
+    m[list(FILL_FIELDS)] = m.groupby("ts_code", observed=True)[
+        list(FILL_FIELDS)].ffill()
+    m[list(FILL_FIELDS)] = m[list(FILL_FIELDS)].fillna(0)
+    return m
+
+
+def test_universe_is_latest_snapshot(store):
+    uni = latest_index_constituents(store, "000300.SH")
+    assert len(uni) == 12
+    assert "600012.SH" not in uni  # the outsider only in the OLD snapshot
+
+
+def test_master_frame_matches_pandas_golden(store):
+    uni = latest_index_constituents(store, "000300.SH")
+    master, _, _ = load_and_prepare_data(store, start_date=None,
+                                         fin_start_date=None)
+    golden = _golden_master(store, uni, "000300.SH")
+
+    key = ["ts_code", "trade_date"]
+    master = master.sort_values(key).reset_index(drop=True)
+    golden = golden.sort_values(key).reset_index(drop=True)
+    assert len(master) == len(golden)
+    assert (master["ts_code"].to_numpy() == golden["ts_code"].to_numpy()).all()
+    assert (master["trade_date"].to_numpy()
+            == golden["trade_date"].to_numpy()).all()
+    for col in set(DAILY_FIELDS) | set(FILL_FIELDS):
+        np.testing.assert_allclose(
+            master[col].to_numpy(np.float64),
+            golden[col].to_numpy(np.float64),
+            rtol=1e-12, err_msg=col)
+    # the surviving report period is the CASHFLOW's end_date
+    # (end_date_x/_y dropped, load_data.py:383); both sides ffilled
+    g_ed = golden.groupby("ts_code", observed=True)["end_date"].ffill()
+    assert master["end_date"].equals(g_ed.rename("end_date"))
+
+
+def test_prepared_fields_shapes_and_sentinels(store):
+    prep = prepare_factor_inputs(store, start_date=None, fin_start_date=None)
+    T, N = len(prep.dates), len(prep.stocks)
+    assert N == 12
+    for name in set(DAILY_FIELDS) | set(FILL_FIELDS):
+        assert prep.fields[name].shape == (T, N)
+    assert prep.fields["end_date_code"].shape == (T, N)
+    assert prep.index_close.shape == (T,)
+    assert np.isfinite(prep.index_close).all()
+
+    rid = prep.fields["end_date_code"]
+    close = prep.fields["close"]
+    obs = np.isfinite(close)
+    # report ids only on observed cells; monotone nondecreasing per stock
+    assert (rid[~obs] == -1).all()
+    for j in range(N):
+        r = rid[obs[:, j], j]
+        r = r[r >= 0]
+        assert (np.diff(r) >= 0).all()
+    # financial fields are never NaN on observed cells (ffill -> 0 policy)
+    for col in FILL_FIELDS:
+        assert np.isfinite(prep.fields[col][obs]).all(), col
+
+
+def test_sw_l1_map_prefers_current_membership(store):
+    sw = store.read("sw_industries")
+    l1 = sw_l1_map(sw, ["600000.SH", "600001.SH"])
+    # the stale is_new == 'N' rows (801990.SI) must lose
+    assert not any(c == "801990.SI" for c in l1)
+
+
+def test_missing_collection_raises(tmp_path):
+    s = PanelStore(str(tmp_path))
+    with pytest.raises(ValueError, match="index_components"):
+        latest_index_constituents(s, "000300.SH")
