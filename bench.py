@@ -8,7 +8,7 @@ eigenfactor adjustment + vol-regime adjustment) on a CSI300-shaped panel
   python bench.py                 # config 1 (the recorded metric)
   python bench.py --config beta   # config 2: rolling 252d BETA+HSIGMA, CSI300
   python bench.py --config factors# config 3: full style-factor calc + post
-  python bench.py --config alla   # config 4: all-A-share x-sec regression scale-up
+  python bench.py --config alla   # config 4: all-A full pipeline + risk stack
   python bench.py --config alpha  # config 5: 1000 alpha expressions, CSI300 panel
 
 The reference publishes no numbers (BASELINE.md), so the config-1 baseline is
@@ -72,9 +72,43 @@ def bench_riskmodel():
                 + jnp.sum(out.lamb))
 
     tpu_s = _time3(step, *args, sim_covs)
+
+    # per-stage split (VERDICT r3 weak #4): each stage jitted alone with its
+    # real inputs materialized, so drift in any one stage is attributable
+    rm = RiskModel(*args, n_industries=P, config=cfg)
+
+    def _sum_finite(*xs):
+        return sum(jnp.sum(jnp.where(jnp.isfinite(x), x, 0.0)) for x in xs)
+
+    reg_fn = jax.jit(lambda: _sum_finite(*rm.reg_by_time()[:2]))
+    reg_s = _time3(reg_fn)
+    factor_ret = rm.reg_by_time()[0]
+
+    nw_fn = jax.jit(lambda f: _sum_finite(*rm.newey_west_by_time(f)))
+    nw_s = _time3(nw_fn, factor_ret)
+    nw_cov, nw_valid = rm.newey_west_by_time(factor_ret)
+
+    eig_fn = jax.jit(lambda c, v, s: _sum_finite(
+        *rm.eigen_risk_adj_by_time(c, v, sim_covs=s, sim_length=T)))
+    eig_s = _time3(eig_fn, nw_cov, nw_valid, sim_covs)
+    eigen_cov, eigen_valid = rm.eigen_risk_adj_by_time(
+        nw_cov, nw_valid, sim_covs=sim_covs, sim_length=T)
+
+    vr_fn = jax.jit(lambda f, c, v: _sum_finite(
+        *rm.vol_regime_adj_by_time(f, c, v)))
+    vr_s = _time3(vr_fn, factor_ret, eigen_cov, eigen_valid)
+
     cpu_s = _cpu_baseline_riskmodel((T, N, P, Q, K, M), args)
     return {"metric": "csi300_riskmodel_e2e_wall", "value": round(tpu_s, 4),
-            "unit": "s", "vs_baseline": round(cpu_s / tpu_s, 2)}
+            "unit": "s", "vs_baseline": round(cpu_s / tpu_s, 2),
+            # BASELINE.json names "cross-sectional WLS dates/sec" as the
+            # metric — report it directly (T dates / regression-stage wall)
+            "xreg_dates_per_sec": round(T / reg_s),
+            "e2e_dates_per_sec": round(T / tpu_s),
+            "stages": {"regression": round(reg_s, 4),
+                       "newey_west": round(nw_s, 4),
+                       "eigen": round(eig_s, 4),
+                       "vol_regime": round(vr_s, 4)}}
 
 
 def _cpu_baseline_riskmodel(shape, args):
@@ -172,28 +206,77 @@ def bench_factors():
 
 
 def bench_alla():
+    """Config 4, the REAL workload (VERDICT r3 weak #5): full 16-factor
+    pipeline + post-processing + cross-sectional regression + covariance
+    stack at all-A scale (5,000 stocks x 2,500 dates).
+
+    Memory accounting for the 504-wide rolling windows (ops/rolling.py:52-90):
+    each rolling kernel materializes block*window*N floats per input; at
+    N=5000, window=504, f32 that is block*10.1 MB — block=16 keeps the
+    largest live window buffer at ~161 MB/input (BETA has 2 inputs), well
+    inside a single v5e chip's HBM next to the ~50 MB/field panel.
+    """
     import jax
     import jax.numpy as jnp
-    from mfm_tpu.ops.xreg import regress_panel
-    from mfm_tpu.ops.rolling import rolling_beta_hsigma
-    from __graft_entry__ import _synthetic_risk_inputs
+    from mfm_tpu.config import FactorConfig, RiskModelConfig
+    from mfm_tpu.data.synthetic import synthetic_market_panel
+    from mfm_tpu.factors.engine import (
+        FactorEngine, rowspace_index, gather_rows, scatter_rows)
+    from mfm_tpu.models.eigen import simulated_eigen_covs
+    from mfm_tpu.models.risk_model import RiskModel
+    from mfm_tpu.pipeline import BARRA_OUTPUT_STYLES
 
-    T, N, P, Q = 2500, 5000, 31, 10
-    args = _synthetic_risk_inputs(T, N, P, Q, seed=1)
-    rng = np.random.default_rng(2)
-    mkt = (0.008 * rng.standard_normal(T)).astype(np.float32)
+    T, N, P, Q, M = 2500, 5000, 31, 10, 100
+    K = 1 + P + Q
+    data = synthetic_market_panel(T=T, N=N, n_industries=P, seed=1)
+    fields = {k: jnp.asarray(v, jnp.float32) for k, v in data.items()
+              if k not in ("dates", "stocks", "industry", "index_close",
+                           "observed", "end_date_code")}
+    fields["end_date_code"] = jnp.asarray(data["end_date_code"])
+    index_close = jnp.asarray(data["index_close"], jnp.float32)
+    industry = jnp.broadcast_to(
+        jnp.asarray(data["industry"], jnp.int32)[None, :], (T, N))
 
-    def step(ret, cap, styles, industry, valid, mkt):
-        b, h = rolling_beta_hsigma(ret, mkt, window=252, half_life=63,
-                                   min_periods=42, block=16)
-        res = regress_panel(ret, cap, styles, industry, valid, n_industries=P)
-        return (jnp.sum(res.factor_ret)
-                + jnp.sum(jnp.where(jnp.isfinite(b), b, 0.0))
-                + jnp.sum(jnp.where(jnp.isfinite(h), h, 0.0)))
+    eng = FactorEngine(fields, index_close, config=FactorConfig(), block=16)
 
-    tpu_s = _time3(jax.jit(step), *args, jnp.asarray(mkt))
-    return {"metric": "alla_5000x2500_beta_plus_xreg_wall",
-            "value": round(tpu_s, 4), "unit": "s", "vs_baseline": None}
+    def factors_fn():
+        out = eng.run()
+        return sum(jnp.sum(jnp.where(jnp.isfinite(v), v, 0.0))
+                   for v in out.values())
+
+    fac_s = _time3(factors_fn)
+    factors = eng.run()  # executable + outputs now cached
+
+    cfg = RiskModelConfig(eigen_n_sims=M, eigen_sim_length=T)
+    sim_covs = simulated_eigen_covs(jax.random.key(1), K, T, M, jnp.float32)
+
+    @jax.jit
+    def risk_fn(factors, cap, industry, sim_covs):
+        styles = jnp.stack(
+            [factors[src] for src, _ in BARRA_OUTPUT_STYLES], axis=-1)
+        # t+1 return label in row space (main.py:99 groupby shift(-1))
+        observed = jnp.isfinite(factors["ret"]) | jnp.isfinite(cap)
+        idx = rowspace_index(observed)
+        rs = gather_rows(factors["ret"], idx)
+        nxt = scatter_rows(jnp.concatenate(
+            [rs[1:], jnp.full((1, N), jnp.nan, rs.dtype)], axis=0), idx)
+        valid = (jnp.isfinite(styles).all(axis=-1) & jnp.isfinite(nxt)
+                 & jnp.isfinite(cap) & (cap > 0))
+        rm = RiskModel(jnp.where(valid, nxt, jnp.nan), cap, styles, industry,
+                       valid, n_industries=P, config=cfg)
+        out = rm.run(sim_covs=sim_covs)
+        return (jnp.sum(jnp.where(jnp.isfinite(out.factor_ret),
+                                  out.factor_ret, 0.0))
+                + jnp.sum(jnp.where(jnp.isfinite(out.vr_cov), out.vr_cov, 0.0))
+                + jnp.sum(out.lamb))
+
+    risk_s = _time3(risk_fn, factors, fields["circ_mv"], industry, sim_covs)
+    return {"metric": "alla_full_pipeline_wall",
+            "value": round(fac_s + risk_s, 4), "unit": "s",
+            "vs_baseline": None,
+            "e2e_dates_per_sec": round(T / (fac_s + risk_s)),
+            "stages": {"factors_post": round(fac_s, 4),
+                       "risk_stack": round(risk_s, 4)}}
 
 
 def bench_alpha():
@@ -224,17 +307,23 @@ def bench_alpha():
         for i in range(1000)]
     fwd = jnp.concatenate([panel["ret"][1:],
                            jnp.full((1, N), jnp.nan, jnp.float32)], axis=0)
-    batch = compile_alpha_batch(exprs)
+    batch = compile_alpha_batch(exprs)  # chunked sub-jits: bounded compile
+    summ = jax.jit(lambda out, fwd: jnp.sum(jnp.where(
+        jnp.isfinite(alpha_summary(out, fwd)["mean_ic"]),
+        alpha_summary(out, fwd)["mean_ic"], 0.0)))
 
-    @jax.jit
+    # no outer jit around `batch` — tracing would inline every chunk back
+    # into the one unbounded program the chunking exists to avoid
     def run(p, fwd):
-        out = batch(p)
-        s = alpha_summary(out, fwd)
-        return jnp.sum(jnp.where(jnp.isfinite(s["mean_ic"]), s["mean_ic"], 0.0))
+        return summ(batch(p), fwd)
 
+    t0 = time.perf_counter()
+    _force(run(dict(panel), fwd))
+    compile_s = time.perf_counter() - t0
     tpu_s = _time3(run, dict(panel), fwd)
     return {"metric": "alpha_1000_exprs_csi300_wall", "value": round(tpu_s, 4),
-            "unit": "s", "vs_baseline": None}
+            "unit": "s", "vs_baseline": None,
+            "compile_s": round(compile_s, 2)}
 
 
 CONFIGS = {

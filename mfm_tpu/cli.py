@@ -253,6 +253,31 @@ def _crosscheck(args):
     print(rep.to_json(orient="index"))
 
 
+def _etl_update(args):
+    """Calendar-driven refresh of every collection — the reference's
+    ``update_mongo_db.py:__main__`` chain (``:579-614``), against the
+    parquet PanelStore with the same watermark/rate-limit/retry behavior."""
+    from mfm_tpu.data.etl import IncrementalUpdater, PanelStore, RateLimiter
+    from mfm_tpu.data.tushare_source import TushareSource
+
+    up = IncrementalUpdater(
+        store=PanelStore(args.store),
+        source=TushareSource(token=args.token),
+        limiter=RateLimiter(args.calls_per_min),
+    )
+    summary = up.run_all(
+        args.start,
+        args.end or time.strftime("%Y%m%d"),
+        index_codes=[s.strip() for s in args.index_codes.split(",")],
+        statements=([s.strip() for s in args.statements.split(",")]
+                    if args.statements else ()),
+        components_date=args.components_date,
+        sw=not args.no_sw,
+        sw_csv=args.sw_csv,
+    )
+    print(json.dumps(summary))
+
+
 def _etl_verify(args):
     from mfm_tpu.data.etl import PanelStore, verify_store
 
@@ -355,6 +380,31 @@ def main(argv=None):
     c.add_argument("--code-col", default="ts_code")
     c.add_argument("--out", default=None, help="write report CSV here")
     c.set_defaults(fn=_crosscheck)
+
+    eu = sub.add_parser("etl-update",
+                        help="calendar-driven refresh of all collections "
+                             "(update_mongo_db.py __main__ path)")
+    eu.add_argument("--store", required=True)
+    eu.add_argument("--start", required=True, help="yyyymmdd")
+    eu.add_argument("--end", default=None, help="yyyymmdd (default: today)")
+    eu.add_argument("--index-codes",
+                    default="000300.SH,000016.SH,000903.SH",
+                    help="comma list (reference __main__: CSI300/SSE50/CSI100)")
+    eu.add_argument("--statements",
+                    default="balancesheet,cashflow,income,"
+                            "financial_indicators",
+                    help="comma list of statement kinds; empty to skip")
+    eu.add_argument("--components-date", default=None,
+                    help="refresh index components at this yyyymmdd date")
+    eu.add_argument("--no-sw", action="store_true",
+                    help="skip the SW industry refresh")
+    eu.add_argument("--sw-csv", default=None,
+                    help="load SW industries from this CSV instead of the "
+                         "per-stock API (the reference's CSV path)")
+    eu.add_argument("--calls-per-min", type=int, default=480)
+    eu.add_argument("--token", default=None,
+                    help="tushare token (default: TUSHARE_TOKEN env)")
+    eu.set_defaults(fn=_etl_update)
 
     ev = sub.add_parser("etl-verify",
                         help="store sanity counters (verify_data.py path)")

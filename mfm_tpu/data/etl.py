@@ -368,6 +368,96 @@ class IncrementalUpdater:
                 df,
             )
 
+    def update_stock_info(self, name="stock_info") -> list:
+        """Full refresh of the live A-share list; every run replaces the old
+        collection and returns the ts_code universe the statement updaters
+        iterate (``update_mongo_db.py:32-57``: drop + insert_many)."""
+        df = self._call(self.source.fetch_stock_info)
+        if df is None or not len(df):
+            return []
+        self.store.replace_where(name, lambda c: np.ones(len(c), bool), df)
+        return list(df["ts_code"])
+
+    @staticmethod
+    def _next_day(date_str) -> str:
+        d = pd.to_datetime(str(date_str), format="%Y%m%d")
+        return (d + pd.Timedelta(days=1)).strftime("%Y%m%d")
+
+    def update_daily_index_prices(self, index_codes: Sequence[str],
+                                  end_date=None,
+                                  name="index_daily_prices") -> int:
+        """Collection-level watermark, then one ranged fetch per index
+        (``update_mongo_db.py:387-454``: start = watermark + 1 day, rate
+        limited, retried, duplicate-tolerant insert)."""
+        wm = self.store.last_date(name)
+        start = self._next_day(wm) if wm is not None else None
+        if start is not None and end_date is not None \
+                and str(start) > str(end_date):
+            return 0  # already up to date (update_mongo_db.py:401-403)
+        n = 0
+        for code in index_codes:
+            df = self._call(self.source.fetch_daily_index_prices,
+                            ts_code=code, start_date=start, end_date=end_date)
+            n += self.store.insert(name, df, unique=("ts_code", "trade_date"))
+        return n
+
+    def update_sw_industries(self, ts_codes: Sequence[str] | None = None,
+                             csv_path: str | None = None,
+                             name="sw_industries") -> int:
+        """Full refresh of the SW industry classification
+        (``update_mongo_db.py:536-576``: drop + insert_many from a CSV).
+        Either path works: ``csv_path`` mirrors the reference; ``ts_codes``
+        fetches per stock through the source's ``index_member_all`` wrapper
+        instead (the notebook path, ``industry_data.ipynb`` cell 3)."""
+        if csv_path is not None:
+            df = pd.read_csv(csv_path)
+        elif ts_codes is not None:
+            frames = [self._call(self.source.fetch_sw_industries, ts_code=c)
+                      for c in ts_codes]
+            frames = [f for f in frames if f is not None and len(f)]
+            df = pd.concat(frames, ignore_index=True) if frames \
+                else pd.DataFrame()
+        else:
+            raise ValueError("pass ts_codes or csv_path")
+        if not len(df):
+            return 0
+        self.store.replace_where(name, lambda c: np.ones(len(c), bool), df)
+        return len(df)
+
+    def run_all(self, start_date, end_date,
+                index_codes: Sequence[str] = ("000300.SH", "000016.SH",
+                                              "000903.SH"),
+                statements: Sequence[str] = ("balancesheet", "cashflow",
+                                             "income", "financial_indicators"),
+                components_date=None, sw: bool = True,
+                sw_csv: str | None = None) -> dict:
+        """Calendar-driven refresh of every collection, in the reference's
+        ``__main__`` order (``update_mongo_db.py:579-614``): stock_info ->
+        daily_prices over the trade calendar -> statements per stock ->
+        index daily prices -> index components -> SW industries.  The steps
+        the reference ships commented out ("run manually", ``:590-614``) are
+        on by default here and individually disableable."""
+        codes = self.update_stock_info()
+        cal = self._call(self.source.fetch_trade_calendar,
+                         start_date=start_date, end_date=end_date)
+        summary = {
+            "stock_info": len(codes),
+            "daily_prices": self.update_daily_prices(cal),
+            "statements": {
+                k: self.update_statements(codes, k, start_date, end_date)
+                for k in statements
+            },
+            "index_daily_prices": self.update_daily_index_prices(
+                index_codes, end_date=end_date),
+        }
+        if components_date is not None:
+            self.update_index_components(index_codes, components_date)
+            summary["index_components_date"] = str(components_date)
+        if sw:
+            summary["sw_industries"] = self.update_sw_industries(
+                ts_codes=codes, csv_path=sw_csv)
+        return summary
+
 
 def find_missing_stocks(store: PanelStore, universe_name="stock_info",
                         data_name="daily_prices", code_col="ts_code"):

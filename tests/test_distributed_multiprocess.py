@@ -85,7 +85,9 @@ def test_two_process_mesh_and_collective():
     outs = []
     for p in procs:
         try:
-            out, err = p.communicate(timeout=180)
+            # generous: under full-suite load the gloo handshake + two cold
+            # 4-device CPU backends can take minutes (flaked at 180 s)
+            out, err = p.communicate(timeout=600)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()

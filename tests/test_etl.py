@@ -1,6 +1,8 @@
 """Hermetic ETL tests: watermark resume, rate limiting, retry, dedup inserts,
 delete-then-insert refresh, repair tooling — all against fakes."""
 
+import os
+
 import numpy as np
 import pandas as pd
 import pytest

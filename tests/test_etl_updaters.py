@@ -1,0 +1,173 @@
+"""Hermetic full-surface ETL test (VERDICT r3 missing #2): every collection
+``prepare_factor_inputs`` reads is populated exclusively through
+``IncrementalUpdater`` methods against a fake source (no direct store
+inserts), then the one-command ``pipeline`` CLI runs off that store.
+
+Reference scope: ``update_mongo_db.py:579-614`` (the ``__main__`` chain:
+stock_info -> daily_prices -> statements -> index daily prices -> index
+components -> SW industries) plus the three updaters the repo previously
+lacked (``update_stock_info`` ``:32-57``, ``update_daily_index_prices``
+``:387-454``, ``update_sw_industries_from_csv`` ``:536-576``).
+"""
+
+import json
+
+import pandas as pd
+import pytest
+
+from mfm_tpu.cli import main as cli_main
+from mfm_tpu.data.etl import IncrementalUpdater, PanelStore
+from mfm_tpu.data.prepare import prepare_factor_inputs
+from mfm_tpu.data.synthetic import synthetic_collections
+
+COLLECTIONS = ("stock_info", "daily_prices", "balancesheet", "cashflow",
+               "financial_indicators", "index_daily_prices",
+               "index_components", "sw_industries")
+
+
+class FullFakeSource:
+    """Serves the synthetic truth frames through the tushare fetch surface."""
+
+    def __init__(self, truth, dates):
+        self.t = truth
+        self.dates = dates
+
+    def fetch_stock_info(self):
+        return self.t["stock_info"].copy()
+
+    def fetch_trade_calendar(self, start_date, end_date):
+        return [d for d in self.dates if start_date <= d <= end_date]
+
+    def fetch_daily_prices(self, trade_date):
+        df = self.t["daily_prices"]
+        return df[df["trade_date"] == trade_date].copy()
+
+    def _stmt(self, name, ts_code):
+        # the real API's start/end filter announcement dates; serving the
+        # stock's full history keeps the fixture simple and is a superset
+        df = self.t[name]
+        return df[df["ts_code"] == ts_code].copy()
+
+    def fetch_balancesheet_by_stock(self, ts_code, start_date=None,
+                                    end_date=None):
+        return self._stmt("balancesheet", ts_code)
+
+    def fetch_cashflow_by_stock(self, ts_code, start_date=None, end_date=None):
+        return self._stmt("cashflow", ts_code)
+
+    def fetch_income_by_stock(self, ts_code, start_date=None, end_date=None):
+        # the income collection exists in the reference DB but is unused by
+        # the factor pipeline; empty is a valid fetch result
+        return pd.DataFrame(columns=["ts_code", "end_date", "f_ann_date"])
+
+    def fetch_financial_indicators_by_stock(self, ts_code, start_date=None,
+                                            end_date=None):
+        return self._stmt("financial_indicators", ts_code)
+
+    def fetch_daily_index_prices(self, ts_code, start_date=None,
+                                 end_date=None):
+        df = self.t["index_daily_prices"]
+        df = df[df["ts_code"] == ts_code]
+        if start_date is not None:
+            df = df[df["trade_date"] >= start_date]
+        if end_date is not None:
+            df = df[df["trade_date"] <= end_date]
+        return df.copy()
+
+    def fetch_index_components(self, index_code, trade_date):
+        df = self.t["index_components"]
+        return df[(df["index_code"] == index_code)
+                  & (df["trade_date"] == trade_date)].copy()
+
+    def fetch_sw_industries(self, ts_code):
+        df = self.t["sw_industries"]
+        return df[df["ts_code"] == ts_code].copy()
+
+
+@pytest.fixture(scope="module")
+def truth(tmp_path_factory):
+    d = tmp_path_factory.mktemp("truth")
+    s = PanelStore(str(d))
+    meta = synthetic_collections(s, T=100, N=16, n_industries=4, seed=7)
+    return {n: s.read(n) for n in COLLECTIONS}, meta
+
+
+def test_run_all_populates_every_prepare_collection(truth, tmp_path, capsys):
+    frames, meta = truth
+    src = FullFakeSource(frames, list(meta["dates"]))
+    store_dir = str(tmp_path / "store")
+    store = PanelStore(store_dir)
+    up = IncrementalUpdater(store=store, source=src, sleep=lambda s: None)
+    start, end = meta["dates"][0], meta["dates"][-1]
+
+    summary = up.run_all(start, end, index_codes=(meta["index_code"],),
+                         components_date=meta["dates"][-1])
+
+    assert summary["stock_info"] == len(frames["stock_info"])
+    assert summary["daily_prices"] == len(frames["daily_prices"])
+    assert summary["index_daily_prices"] == len(frames["index_daily_prices"])
+    assert summary["sw_industries"] == len(frames["sw_industries"])
+    assert summary["statements"]["balancesheet"] == len(frames["balancesheet"])
+    assert summary["statements"]["cashflow"] == len(frames["cashflow"])
+    assert summary["statements"]["financial_indicators"] == \
+        len(frames["financial_indicators"])
+    assert summary["statements"]["income"] == 0  # empty fetch is fine
+
+    for name in COLLECTIONS:
+        if name == "index_components":
+            continue  # only the components_date snapshot is refreshed
+        got = store.read(name)
+        assert len(got), name
+
+    # watermark/dedup idempotence: a second chained run refetches nothing
+    summary2 = up.run_all(start, end, index_codes=(meta["index_code"],))
+    assert summary2["daily_prices"] == 0
+    assert summary2["index_daily_prices"] == 0
+    assert summary2["statements"]["balancesheet"] == 0
+    assert summary2["statements"]["financial_indicators"] == 0
+
+    # the full prepare path reads only updater-written collections
+    prep = prepare_factor_inputs(store, index_code=meta["index_code"],
+                                 start_date=start, fin_start_date=None)
+    assert prep.fields["close"].shape[1] == 16
+    assert prep.index_close.shape[0] == prep.fields["close"].shape[0]
+
+    # ... and the one-command pipeline runs end-to-end off that store
+    out = str(tmp_path / "results")
+    cli_main(["pipeline", "--store", store_dir, "--out", out,
+              "--eigen-sims", "8", "--start", start])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["stocks"] == 16
+    assert rec["rows"] > 0
+
+
+def test_sw_industries_from_csv(truth, tmp_path):
+    """The reference's CSV refresh path (``update_mongo_db.py:536-576``)."""
+    frames, _ = truth
+    csv = tmp_path / "sw.csv"
+    frames["sw_industries"].to_csv(csv, index=False)
+    store = PanelStore(str(tmp_path / "store"))
+    up = IncrementalUpdater(store=store, source=object(),
+                            sleep=lambda s: None)
+    n = up.update_sw_industries(csv_path=str(csv))
+    assert n == len(frames["sw_industries"])
+    # full-refresh semantics: a second load replaces, not appends
+    assert up.update_sw_industries(csv_path=str(csv)) == n
+    assert len(store.read("sw_industries")) == n
+
+
+def test_etl_update_cli(truth, tmp_path, capsys, monkeypatch):
+    frames, meta = truth
+    src = FullFakeSource(frames, list(meta["dates"]))
+    import mfm_tpu.data.tushare_source as ts_mod
+    monkeypatch.setattr(ts_mod, "TushareSource", lambda token=None: src)
+    store_dir = str(tmp_path / "store")
+    cli_main(["etl-update", "--store", store_dir,
+              "--start", meta["dates"][0], "--end", meta["dates"][-1],
+              "--index-codes", meta["index_code"],
+              "--components-date", meta["dates"][-1]])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["daily_prices"] == len(frames["daily_prices"])
+    assert rec["index_daily_prices"] == len(frames["index_daily_prices"])
+    assert PanelStore(store_dir).distinct_count(
+        "index_components", "con_code") == 16

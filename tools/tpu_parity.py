@@ -7,9 +7,13 @@ numbers as the CPU path on the full CSI300-shaped workload.  Run it twice,
 then compare:
 
     python tools/tpu_parity.py run --out /tmp/parity_tpu.npz           # on TPU
-    PYTHONPATH= JAX_PLATFORMS=cpu \
-        python tools/tpu_parity.py run --out /tmp/parity_cpu.npz       # on CPU
+    python tools/tpu_parity.py run --platform cpu --out /tmp/parity_cpu.npz
     python tools/tpu_parity.py compare /tmp/parity_tpu.npz /tmp/parity_cpu.npz
+
+(use ``--platform cpu``, not ``JAX_PLATFORMS=cpu``: a site hook that
+pre-registers the TPU plugin wins over the env var, and the compare would
+silently diff TPU against itself — the verdict line's ``platforms`` field is
+the check that both backends really ran)
 
 ``compare`` prints one JSON line per stage with max/median relative
 difference over valid dates and exits nonzero if any stage exceeds
@@ -31,6 +35,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def _run(args):
     import jax
+
+    if args.platform:
+        # env JAX_PLATFORMS loses to site hooks that pre-register the TPU
+        # plugin (same pitfall as cli.py --platform); the config API wins
+        jax.config.update("jax_platforms", args.platform)
+    if args.x64:
+        # the 1e-5 contract is defined against the float64 reference; x64
+        # runs (XLA emulates f64 on TPU) prove the TPU *path* is correct,
+        # while f32 runs measure the fast path's precision drift
+        jax.config.update("jax_enable_x64", True)
     import jax.numpy as jnp
     from mfm_tpu.config import RiskModelConfig
     from mfm_tpu.models.eigen import simulated_eigen_covs
@@ -39,10 +53,11 @@ def _run(args):
 
     T, N, P, Q, M = args.dates, args.stocks, args.industries, args.styles, args.sims
     K = 1 + P + Q
-    inputs = _synthetic_risk_inputs(T, N, P, Q, dtype=jnp.float32, seed=0)
+    dtype = jnp.float64 if args.x64 else jnp.float32
+    inputs = _synthetic_risk_inputs(T, N, P, Q, dtype=dtype, seed=0)
     cfg = RiskModelConfig(eigen_n_sims=M, eigen_sim_length=T)
     # identical draws on both backends: jax.random is backend-deterministic
-    sim_covs = simulated_eigen_covs(jax.random.key(0), K, T, M, jnp.float32)
+    sim_covs = simulated_eigen_covs(jax.random.key(0), K, T, M, dtype)
 
     rm = RiskModel(*inputs, n_industries=P, config=cfg)
     # declaring sim_length runs the PRODUCTION eigen path (auto sweep cap,
@@ -87,8 +102,12 @@ def _compare(args):
     for name in ("nw_valid", "eigen_valid"):
         if not (a[name] == b[name]).all():
             failed.append(name)
+    plats = [str(a["platform"]), str(b["platform"])]
+    if plats[0] == plats[1]:
+        # same backend twice proves determinism, not hardware parity
+        failed.append("platforms:identical")
     verdict = {"parity": not failed, "gate": args.gate, "failed": failed,
-               "platforms": [str(a["platform"]), str(b["platform"])]}
+               "platforms": plats}
     print(json.dumps(verdict))
     sys.exit(1 if failed else 0)
 
@@ -103,6 +122,12 @@ def main(argv=None):
     r.add_argument("--industries", type=int, default=31)
     r.add_argument("--styles", type=int, default=10)
     r.add_argument("--sims", type=int, default=40)
+    r.add_argument("--platform", default=None, metavar="cpu|tpu",
+                   help="pin the JAX platform via the config API (the env "
+                        "var loses to site hooks that pre-register a plugin)")
+    r.add_argument("--x64", action="store_true",
+                   help="run in float64 (the reference's precision; XLA "
+                        "emulates f64 on TPU — slow but exact)")
     r.set_defaults(fn=_run)
     c = sub.add_parser("compare")
     c.add_argument("a")
