@@ -74,29 +74,35 @@ def bench_riskmodel():
     tpu_s = _time3(step, *args, sim_covs)
 
     # per-stage split (VERDICT r3 weak #4): each stage jitted alone with its
-    # real inputs materialized, so drift in any one stage is attributable
-    rm = RiskModel(*args, n_industries=P, config=cfg)
-
+    # real inputs passed as jit ARGUMENTS (closed-over arrays would embed as
+    # constants and invite compile-time folding), so drift in any one stage
+    # is attributable
     def _sum_finite(*xs):
         return sum(jnp.sum(jnp.where(jnp.isfinite(x), x, 0.0)) for x in xs)
 
-    reg_fn = jax.jit(lambda: _sum_finite(*rm.reg_by_time()[:2]))
-    reg_s = _time3(reg_fn)
+    def mk(stage):
+        @jax.jit
+        def f(ret, cap, styles, industry, valid, *extra):
+            rm = RiskModel(ret, cap, styles, industry, valid,
+                           n_industries=P, config=cfg)
+            return _sum_finite(*stage(rm, *extra))
+        return f
+
+    rm = RiskModel(*args, n_industries=P, config=cfg)  # eager intermediates
     factor_ret = rm.reg_by_time()[0]
-
-    nw_fn = jax.jit(lambda f: _sum_finite(*rm.newey_west_by_time(f)))
-    nw_s = _time3(nw_fn, factor_ret)
     nw_cov, nw_valid = rm.newey_west_by_time(factor_ret)
-
-    eig_fn = jax.jit(lambda c, v, s: _sum_finite(
-        *rm.eigen_risk_adj_by_time(c, v, sim_covs=s, sim_length=T)))
-    eig_s = _time3(eig_fn, nw_cov, nw_valid, sim_covs)
     eigen_cov, eigen_valid = rm.eigen_risk_adj_by_time(
         nw_cov, nw_valid, sim_covs=sim_covs, sim_length=T)
 
-    vr_fn = jax.jit(lambda f, c, v: _sum_finite(
-        *rm.vol_regime_adj_by_time(f, c, v)))
-    vr_s = _time3(vr_fn, factor_ret, eigen_cov, eigen_valid)
+    reg_s = _time3(mk(lambda m: m.reg_by_time()[:2]), *args)
+    nw_s = _time3(mk(lambda m, f: m.newey_west_by_time(f)),
+                  *args, factor_ret)
+    eig_s = _time3(
+        mk(lambda m, c, v, s: m.eigen_risk_adj_by_time(
+            c, v, sim_covs=s, sim_length=T)),
+        *args, nw_cov, nw_valid, sim_covs)
+    vr_s = _time3(mk(lambda m, f, c, v: m.vol_regime_adj_by_time(f, c, v)),
+                  *args, factor_ret, eigen_cov, eigen_valid)
 
     cpu_s = _cpu_baseline_riskmodel((T, N, P, Q, K, M), args)
     return {"metric": "csi300_riskmodel_e2e_wall", "value": round(tpu_s, 4),
@@ -307,7 +313,7 @@ def bench_alpha():
         for i in range(1000)]
     fwd = jnp.concatenate([panel["ret"][1:],
                            jnp.full((1, N), jnp.nan, jnp.float32)], axis=0)
-    batch = compile_alpha_batch(exprs)  # chunked sub-jits: bounded compile
+    batch = compile_alpha_batch(exprs)  # one jit at E=1000; chunks above
     summ = jax.jit(lambda out, fwd: jnp.sum(jnp.where(
         jnp.isfinite(alpha_summary(out, fwd)["mean_ic"]),
         alpha_summary(out, fwd)["mean_ic"], 0.0)))

@@ -386,16 +386,24 @@ class IncrementalUpdater:
     def update_daily_index_prices(self, index_codes: Sequence[str],
                                   end_date=None,
                                   name="index_daily_prices") -> int:
-        """Collection-level watermark, then one ranged fetch per index
-        (``update_mongo_db.py:387-454``: start = watermark + 1 day, rate
-        limited, retried, duplicate-tolerant insert)."""
-        wm = self.store.last_date(name)
-        start = self._next_day(wm) if wm is not None else None
-        if start is not None and end_date is not None \
-                and str(start) > str(end_date):
-            return 0  # already up to date (update_mongo_db.py:401-403)
+        """Watermarked ranged fetch per index (``update_mongo_db.py:387-454``:
+        start = watermark + 1 day, rate limited, retried, duplicate-tolerant
+        insert).  Documented deviation: the reference keeps ONE watermark for
+        the whole collection (``:398``), so an index code added to the list
+        after the first run would silently get no history; here the
+        watermark is per index, and a first-seen code is fetched in full."""
+        have = self.store.read(name, columns=["ts_code", "trade_date"])
         n = 0
         for code in index_codes:
+            wm = None
+            if len(have):
+                mine = have.loc[have["ts_code"] == code, "trade_date"]
+                if len(mine):
+                    wm = mine.max()
+            start = self._next_day(wm) if wm is not None else None
+            if start is not None and end_date is not None \
+                    and str(start) > str(end_date):
+                continue  # this index is up to date (update_mongo_db.py:401-403)
             df = self._call(self.source.fetch_daily_index_prices,
                             ts_code=code, start_date=start, end_date=end_date)
             n += self.store.insert(name, df, unique=("ts_code", "trade_date"))

@@ -1,0 +1,140 @@
+"""MongoDB adapter for the :class:`mfm_tpu.data.etl.PanelStore` interface.
+
+The reference's actual storage layer is MongoDB (``Barra_database/database/
+update_mongo_db.py:579-614``: database ``barra_financial_data``, one
+collection per dataset, unique indexes + ``insert_many(ordered=False)`` for
+duplicate-tolerant idempotent loads).  This adapter exposes that backend
+through the same five methods the parquet :class:`PanelStore` offers —
+``insert`` / ``read`` / ``replace_where`` / ``last_date`` /
+``distinct_count`` — so :class:`mfm_tpu.data.etl.IncrementalUpdater`,
+:func:`mfm_tpu.data.prepare.prepare_factor_inputs`, and the CLI drivers run
+unchanged against either.
+
+pymongo is not part of this image; the import is guarded and the class
+raises a clear error when constructed without it.  The shared contract test
+(``tests/test_store_contract.py``) runs against the parquet store
+unconditionally and against Mongo when a server is reachable.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+try:
+    import pandas as pd
+except Exception:  # pragma: no cover
+    pd = None
+
+try:  # pragma: no cover - exercised only where pymongo exists
+    import pymongo
+    from pymongo.errors import BulkWriteError
+except Exception:  # pragma: no cover
+    pymongo = None
+    BulkWriteError = None
+
+
+class MongoPanelStore:
+    """PanelStore-compatible wrapper over a ``pymongo.database.Database``.
+
+    Unique-key enforcement is Mongo's own unique index (exact, server-side
+    — the arbiter the parquet store's hashed key cache approximates), with
+    ``insert_many(ordered=False)`` continuing past duplicate-key errors
+    (``update_mongo_db.py:118-128``).
+    """
+
+    def __init__(self, database):
+        if pymongo is None:  # pragma: no cover
+            raise ImportError("pymongo is required for MongoPanelStore")
+        if pd is None:  # pragma: no cover
+            raise ImportError("pandas required")
+        self.db = database
+        self._indexed: set = set()  # (name, unique cols) already ensured
+
+    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _records(df):
+        return df.reset_index(drop=True).to_dict("records")
+
+    def _frame(self, cursor, columns=None):
+        rows = list(cursor)
+        df = pd.DataFrame(rows)
+        if "_id" in df.columns:
+            df = df.drop(columns=["_id"])
+        if columns is not None:
+            df = df[list(columns)] if len(df) else pd.DataFrame(
+                columns=list(columns))
+        return df
+
+    # -- PanelStore interface ---------------------------------------------
+    def insert(self, name: str, df, unique: Sequence[str] | None = None) -> int:
+        """Append rows; rows whose ``unique`` key already exists are dropped
+        (unique index + ``ordered=False``)."""
+        if df is None or len(df) == 0:
+            return 0
+        coll = self.db[name]
+        if unique:
+            key = (name, tuple(unique))
+            if key not in self._indexed:
+                # once per (collection, key) per store instance — the ETL
+                # statement loop calls insert ~20k times per run_all and
+                # must not pay a createIndexes round-trip each time
+                coll.create_index([(c, 1) for c in unique], unique=True)
+                self._indexed.add(key)
+        # ordered=False also for un-keyed inserts: if the collection carries
+        # a unique index from an earlier keyed insert, duplicates are
+        # skipped (count returned) instead of raising mid-batch.  This is
+        # the one divergence from the parquet store, whose un-keyed insert
+        # appends duplicates — parquet has no index to enforce.
+        try:
+            res = coll.insert_many(self._records(df), ordered=False)
+            return len(res.inserted_ids)
+        except BulkWriteError as e:
+            return e.details.get("nInserted", 0)
+
+    def read(self, name: str, columns: Sequence[str] | None = None):
+        proj = {"_id": 0}
+        if columns is not None:
+            proj.update({c: 1 for c in columns})
+        return self._frame(self.db[name].find({}, proj), columns)
+
+    def replace_where(self, name: str, mask_fn, df) -> None:
+        """Delete rows matching ``mask_fn`` then insert ``df``.
+
+        ``mask_fn`` is a DataFrame predicate (the parquet store's contract),
+        so matching happens client-side: read, evaluate, delete by ``_id``.
+        """
+        coll = self.db[name]
+        rows = list(coll.find({}))
+        if rows:
+            cur = pd.DataFrame(rows)
+            # np.asarray: callers pass either a pandas Series predicate or a
+            # bare ndarray (etl.py's all-True full-refresh masks)
+            mask = np.asarray(mask_fn(cur.drop(columns=["_id"])))
+            if mask.all():
+                # full refresh (update_stock_info / update_sw_industries):
+                # one server-side wipe, no id round-trip
+                coll.delete_many({})
+            else:
+                ids = cur.loc[mask, "_id"]
+                if len(ids):
+                    coll.delete_many({"_id": {"$in": list(ids)}})
+        if df is not None and len(df):
+            # through insert() for ordered=False duplicate tolerance — a
+            # unique index from an earlier keyed insert must not abort the
+            # refresh mid-batch
+            self.insert(name, df)
+
+    def compact(self, name: str) -> None:
+        """No-op: Mongo has no parts to merge."""
+
+    def last_date(self, name: str, date_col: str = "trade_date"):
+        doc = self.db[name].find_one(
+            {date_col: {"$exists": True}}, {date_col: 1, "_id": 0},
+            sort=[(date_col, pymongo.DESCENDING)],
+        )
+        return None if doc is None else doc[date_col]
+
+    def distinct_count(self, name: str, col: str) -> int:
+        return len(self.db[name].distinct(col))

@@ -195,6 +195,10 @@ def batched_eigh(A, *, prefer_pallas: bool | None = None,
     precision and silently ignores it.
     """
     n = A.shape[-1]
+    if A.dtype == jnp.float64:
+        # Mosaic has no 64-bit support; x64 parity runs (tools/tpu_parity.py
+        # --x64) take XLA's emulated-f64 eigh on TPU instead
+        prefer_pallas = False
     if prefer_pallas is None:
         platform = jax.devices()[0].platform
         prefer_pallas = platform in ("tpu", "axon") and n % 2 == 0 and n <= 128

@@ -171,3 +171,31 @@ def test_etl_update_cli(truth, tmp_path, capsys, monkeypatch):
     assert rec["index_daily_prices"] == len(frames["index_daily_prices"])
     assert PanelStore(store_dir).distinct_count(
         "index_components", "con_code") == 16
+
+
+def test_index_watermark_is_per_index(truth, tmp_path):
+    """An index code added AFTER the first refresh must get its full
+    history (the reference's single collection-level watermark would skip
+    it, update_mongo_db.py:398 — documented deviation)."""
+    frames, meta = truth
+    two = frames["index_daily_prices"].copy()
+    other = two.assign(ts_code="000016.SH")
+    t = dict(frames)
+    t["index_daily_prices"] = pd.concat([two, other], ignore_index=True)
+    src = FullFakeSource(t, list(meta["dates"]))
+    store = PanelStore(str(tmp_path / "store"))
+    up = IncrementalUpdater(store=store, source=src, sleep=lambda s: None)
+    end = meta["dates"][-1]
+
+    assert up.update_daily_index_prices([meta["index_code"]],
+                                        end_date=end) == len(two)
+    # second run adds a brand-new code: full backfill, no refetch of the old
+    n = up.update_daily_index_prices([meta["index_code"], "000016.SH"],
+                                     end_date=end)
+    assert n == len(other)
+    got = store.read("index_daily_prices")
+    assert got["ts_code"].nunique() == 2
+    assert len(got) == len(two) + len(other)
+    # and now everything is a no-op
+    assert up.update_daily_index_prices([meta["index_code"], "000016.SH"],
+                                        end_date=end) == 0

@@ -1,0 +1,130 @@
+"""Shared storage contract test (VERDICT r3 missing #3): the same
+insert/read/replace_where/last_date/distinct_count semantics must hold for
+every PanelStore backend.  Runs against the parquet store unconditionally;
+against :class:`mfm_tpu.data.mongo_store.MongoPanelStore` when pymongo and a
+local server are available (skipped otherwise — pymongo is not in this
+image).
+
+Reference semantics under test: unique index + ``insert_many(ordered=False)``
+duplicate tolerance (``update_mongo_db.py:118-128``), delete-then-insert
+refresh (``:514-521``), last-date watermark (``:19-30``), distinct counts
+(``verify_data.py:8``).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from mfm_tpu.data.etl import PanelStore
+
+
+def _mongo_store():
+    try:
+        import pymongo
+    except ImportError:
+        pytest.skip("pymongo not installed")
+    from mfm_tpu.data.mongo_store import MongoPanelStore
+
+    client = pymongo.MongoClient("localhost", 27017,
+                                 serverSelectionTimeoutMS=500)
+    try:
+        client.admin.command("ping")
+    except Exception:
+        pytest.skip("no MongoDB server on localhost:27017")
+    db = client["mfm_tpu_contract_test"]
+    client.drop_database(db.name)
+    return MongoPanelStore(db)
+
+
+@pytest.fixture(params=["parquet", "mongo"])
+def store(request, tmp_path):
+    if request.param == "parquet":
+        return PanelStore(str(tmp_path))
+    return _mongo_store()
+
+
+def _frame(day, n=3, start=0):
+    return pd.DataFrame({
+        "ts_code": [f"{600000 + start + i}.SH" for i in range(n)],
+        "trade_date": f"2024010{day}",
+        "close": np.linspace(1.0, 2.0, n) + day,
+    })
+
+
+def test_insert_read_roundtrip(store):
+    n = store.insert("px", _frame(1), unique=("ts_code", "trade_date"))
+    assert n == 3
+    got = store.read("px").sort_values("ts_code").reset_index(drop=True)
+    assert list(got.columns) == ["ts_code", "trade_date", "close"]
+    assert len(got) == 3
+    # column projection
+    only = store.read("px", columns=["ts_code"])
+    assert list(only.columns) == ["ts_code"]
+
+
+def test_duplicate_tolerant_insert(store):
+    u = ("ts_code", "trade_date")
+    assert store.insert("px", _frame(1), unique=u) == 3
+    # full duplicate batch -> zero inserted
+    assert store.insert("px", _frame(1), unique=u) == 0
+    # mixed batch -> only the fresh rows land
+    mixed = pd.concat([_frame(1), _frame(2)], ignore_index=True)
+    assert store.insert("px", mixed, unique=u) == 3
+    assert len(store.read("px")) == 6
+
+
+def test_replace_where_refresh(store):
+    store.insert("comp", pd.DataFrame({
+        "index_code": ["A", "A", "B"],
+        "trade_date": ["20240101"] * 3,
+        "con_code": ["x", "y", "z"],
+    }))
+    store.replace_where(
+        "comp",
+        lambda c: (c["index_code"] == "A") & (c["trade_date"] == "20240101"),
+        pd.DataFrame({"index_code": ["A"], "trade_date": ["20240101"],
+                      "con_code": ["w"]}),
+    )
+    got = store.read("comp")
+    assert sorted(got["con_code"]) == ["w", "z"]
+
+
+def test_last_date_watermark(store):
+    assert store.last_date("px") is None
+    store.insert("px", _frame(1), unique=("ts_code", "trade_date"))
+    store.insert("px", _frame(3), unique=("ts_code", "trade_date"))
+    assert store.last_date("px") == "20240103"
+    # a collection without the date column is a clean None
+    store.insert("info", pd.DataFrame({"ts_code": ["600000.SH"]}))
+    assert store.last_date("info") is None
+
+
+def test_distinct_count(store):
+    store.insert("px", _frame(1, n=4), unique=("ts_code", "trade_date"))
+    store.insert("px", _frame(2, n=4), unique=("ts_code", "trade_date"))
+    assert store.distinct_count("px", "ts_code") == 4
+    assert store.distinct_count("px", "trade_date") == 2
+    assert store.distinct_count("nothing", "ts_code") == 0
+
+
+def test_updater_runs_on_any_backend(store):
+    """The IncrementalUpdater logic is backend-agnostic: watermark resume
+    works through the shared interface."""
+    from mfm_tpu.data.etl import IncrementalUpdater
+
+    class Src:
+        def __init__(self):
+            self.calls = []
+
+        def fetch_daily_prices(self, trade_date):
+            self.calls.append(trade_date)
+            return _frame(int(trade_date[-1]))
+
+    src = Src()
+    up = IncrementalUpdater(store=store, source=src, sleep=lambda s: None)
+    cal = ["20240101", "20240102", "20240103"]
+    assert up.update_daily_prices(cal) == 9
+    # resume: everything at/before the watermark is skipped
+    src.calls.clear()
+    assert up.update_daily_prices(cal + ["20240104"]) == 3
+    assert src.calls == ["20240104"]
