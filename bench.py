@@ -66,7 +66,11 @@ def bench_riskmodel():
     def step(ret, cap, styles, industry, valid, sim_covs):
         rm = RiskModel(ret, cap, styles, industry, valid,
                        n_industries=P, config=cfg)
-        out = rm.run(sim_covs=sim_covs)
+        # sim_length declares the draw count behind sim_covs, engaging the
+        # PRODUCTION eigen path (auto sweep cap — the path tools/
+        # tpu_parity.py gates); omitting it silently benchmarks the
+        # conservative full-sweep fallback instead
+        out = rm.run(sim_covs=sim_covs, sim_length=T)
         return (jnp.sum(out.factor_ret) + jnp.sum(out.r2)
                 + jnp.sum(jnp.where(jnp.isfinite(out.vr_cov), out.vr_cov, 0.0))
                 + jnp.sum(out.lamb))
@@ -270,7 +274,7 @@ def bench_alla():
                  & jnp.isfinite(cap) & (cap > 0))
         rm = RiskModel(jnp.where(valid, nxt, jnp.nan), cap, styles, industry,
                        valid, n_industries=P, config=cfg)
-        out = rm.run(sim_covs=sim_covs)
+        out = rm.run(sim_covs=sim_covs, sim_length=T)  # production eigen path
         return (jnp.sum(jnp.where(jnp.isfinite(out.factor_ret),
                                   out.factor_ret, 0.0))
                 + jnp.sum(jnp.where(jnp.isfinite(out.vr_cov), out.vr_cov, 0.0))

@@ -44,56 +44,21 @@ def _risk(args):
     # plotting stays outside the timed region (matplotlib import + render
     # would otherwise pollute the reported pipeline wall-clock)
     if args.bias_plot:
-        from mfm_tpu.models.bias import eigenfactor_bias_stat, plot_bias_stats
-
-        o = res.outputs
-        bias = {
-            "newey_west": eigenfactor_bias_stat(
-                o.nw_cov, o.nw_valid, o.factor_ret),
-            "eigen_adjusted": eigenfactor_bias_stat(
-                o.eigen_cov, o.eigen_valid, o.factor_ret),
-        }
-        plot_bias_stats(bias, os.path.join(args.out, args.bias_plot))
-        # the USE4 acceptance numbers behind the picture (utils.py:97-117):
-        # the eigen adjustment must pull the bias statistic toward 1, most
-        # visibly at the extreme eigenfactor ranks.  Reported twice: over
-        # all valid dates (the reference's convention) and excluding the
-        # expanding-window burn-in, where the near-singular early NW
-        # covariances make the smallest eigen-portfolios' predicted vol
-        # meaninglessly tiny and the full-sample max explodes
         import jax
-        import jax.numpy as jnp
+        from mfm_tpu.models.bias import bias_stats_summary, plot_bias_stats
 
-        burn = args.bias_burn_in
-        scopes = [("all_valid_dates", bias)]
-        # short panels (T <= burn) have no post-burn-in dates; skip the
-        # scope rather than writing all-NaN (invalid JSON) statistics
-        if bool(np.asarray(o.nw_valid)[burn:].any()):
-            t_ok = jnp.arange(o.factor_ret.shape[0]) >= burn
-            scopes.append((f"after_burn_in_{burn}", {
-                "newey_west": eigenfactor_bias_stat(
-                    o.nw_cov, o.nw_valid & t_ok, o.factor_ret),
-                "eigen_adjusted": eigenfactor_bias_stat(
-                    o.eigen_cov, o.eigen_valid & t_ok, o.factor_ret),
-            }))
-
-        def _num(x):  # NaN/inf -> null, keeping the file strict JSON
-            return round(float(x), 4) if np.isfinite(x) else None
-
-        summary = {}
-        for scope, stats in scopes:
-            summary[scope] = {}
-            for label, b in stats.items():
-                b = np.asarray(b)
-                dev = np.abs(b[np.isfinite(b)] - 1)  # one blown-up rank must
-                # not null the aggregates of the K-1 finite ones
-                summary[scope][label] = {
-                    "bias": [_num(x) for x in b],
-                    "mean_abs_dev_from_1":
-                        _num(np.mean(dev)) if dev.size else None,
-                    "max_abs_dev_from_1":
-                        _num(np.max(dev)) if dev.size else None,
-                }
+        # the USE4 acceptance numbers + picture (utils.py:97-117): the eigen
+        # adjustment must pull the bias statistic toward 1, most visibly at
+        # the extreme eigenfactor ranks
+        o = res.outputs
+        summary = bias_stats_summary(o.nw_cov, o.nw_valid, o.eigen_cov,
+                                     o.eigen_valid, o.factor_ret,
+                                     burn_in=args.bias_burn_in)
+        plot_bias_stats(
+            {label: np.array([np.nan if v is None else v for v in d["bias"]])
+             for label, d in summary["all_valid_dates"].items()},
+            os.path.join(args.out, args.bias_plot),
+        )
         summary["backend"] = jax.devices()[0].platform
         with open(os.path.join(args.out, "bias_stats.json"), "w") as fh:
             json.dump(summary, fh, indent=1)

@@ -393,13 +393,12 @@ class IncrementalUpdater:
         after the first run would silently get no history; here the
         watermark is per index, and a first-seen code is fetched in full."""
         have = self.store.read(name, columns=["ts_code", "trade_date"])
+        # one pass for all per-index maxima, not a filter per code
+        wms = (have.groupby("ts_code")["trade_date"].max().to_dict()
+               if len(have) else {})
         n = 0
         for code in index_codes:
-            wm = None
-            if len(have):
-                mine = have.loc[have["ts_code"] == code, "trade_date"]
-                if len(mine):
-                    wm = mine.max()
+            wm = wms.get(code)
             start = self._next_day(wm) if wm is not None else None
             if start is not None and end_date is not None \
                     and str(start) > str(end_date):

@@ -91,6 +91,12 @@ class MongoPanelStore:
             res = coll.insert_many(self._records(df), ordered=False)
             return len(res.inserted_ids)
         except BulkWriteError as e:
+            # only duplicate keys (11000) are tolerable; anything else
+            # (oversized doc, validation, shard key) must surface — the
+            # caller would otherwise advance its watermark past a silent gap
+            errs = e.details.get("writeErrors", [])
+            if any(we.get("code") != 11000 for we in errs):
+                raise
             return e.details.get("nInserted", 0)
 
     def read(self, name: str, columns: Sequence[str] | None = None):
@@ -130,6 +136,13 @@ class MongoPanelStore:
         """No-op: Mongo has no parts to merge."""
 
     def last_date(self, name: str, date_col: str = "trade_date"):
+        key = (name, ("__date__", date_col))
+        if key not in self._indexed:
+            # the compound unique key (ts_code, trade_date) cannot serve a
+            # sort on trade_date alone — without this, every watermark read
+            # is a full collection scan
+            self.db[name].create_index([(date_col, pymongo.DESCENDING)])
+            self._indexed.add(key)
         doc = self.db[name].find_one(
             {date_col: {"$exists": True}}, {date_col: 1, "_id": 0},
             sort=[(date_col, pymongo.DESCENDING)],

@@ -130,7 +130,10 @@ def test_sim_sweeps_gating_and_config_validation():
     from mfm_tpu.models.eigen import sim_sweeps_for
     from mfm_tpu.ops.eigh import _sweeps_for
 
-    assert sim_sweeps_for(42, jnp.float32, 1390) == _sweeps_for(42, jnp.float32) - 2
+    # deep near-diagonal regime (sim_length >= 32K): one more sweep off
+    assert sim_sweeps_for(42, jnp.float32, 1390) == _sweeps_for(42, jnp.float32) - 3
+    # moderate regime (4K <= sim_length < 32K)
+    assert sim_sweeps_for(42, jnp.float32, 200) == _sweeps_for(42, jnp.float32) - 2
     # premise fails -> solver default, no reduction
     assert sim_sweeps_for(42, jnp.float32, 100) == _sweeps_for(42, jnp.float32)
 
@@ -213,3 +216,40 @@ def test_newey_west_associative_date_sharded(fret):
     base, _ = newey_west_expanding(f, 2, 252.0)
     np.testing.assert_allclose(np.asarray(covs), np.asarray(base),
                                rtol=1e-8, atol=1e-14)
+
+
+def test_bias_stats_summary_scopes_and_nonfinite_handling():
+    """The JSON-ready acceptance summary (models/bias.py): burn-in scope
+    present iff post-burn-in valid dates exist; a non-finite rank becomes
+    null but does NOT null the finite ranks' aggregates."""
+    import json
+
+    from mfm_tpu.models.bias import bias_stats_summary
+
+    rng = np.random.default_rng(3)
+    T, K = 400, 4
+    f = jnp.asarray(0.01 * rng.standard_normal((T, K)))
+    covs = jnp.broadcast_to(0.0001 * jnp.eye(K), (T, K, K))
+    # one pathological date-0..9 window invalid; rest valid
+    valid = jnp.asarray(np.arange(T) >= 10)
+
+    s = bias_stats_summary(covs, valid, covs, valid, f, burn_in=252)
+    assert set(s) == {"all_valid_dates", "after_burn_in_252"}
+    for scope in s.values():
+        for stats in scope.values():
+            assert len(stats["bias"]) == K
+            assert stats["mean_abs_dev_from_1"] is not None
+    out = json.dumps(s)  # strict JSON round trip
+    assert "NaN" not in out
+
+    # short panel: no post-burn-in dates -> scope absent, file still valid
+    s2 = bias_stats_summary(covs[:100], valid[:100], covs[:100], valid[:100],
+                            f[:100], burn_in=252)
+    assert set(s2) == {"all_valid_dates"}
+
+    # a zero-variance rank (sigma=0 -> inf bias) nulls only itself
+    covs_bad = jnp.broadcast_to(
+        jnp.diag(jnp.asarray([0.0] + [1e-4] * (K - 1))), (T, K, K))
+    s3 = bias_stats_summary(covs_bad, valid, covs_bad, valid, f, burn_in=252)
+    st = s3["all_valid_dates"]["newey_west"]
+    assert st["mean_abs_dev_from_1"] is not None
