@@ -4,13 +4,15 @@ Grammar: Python expression syntax (parsed with ``ast``, no eval) over panel
 field names, numeric literals, arithmetic/comparison operators, and a fixed
 op vocabulary in the WorldQuant-alpha style:
 
-  elementwise: abs, log, sign, sqrt, where(cond, a, b), min, max, power
+  elementwise: abs, log, sign, sqrt, where(cond, a, b), min, max, power,
+      signed_power(x, a)
   cross-sectional (per date over valid stocks):
-      cs_rank, cs_zscore, cs_demean, cs_scale (unit L1 norm)
+      cs_rank, cs_zscore, cs_demean, cs_scale (unit L1 norm),
+      cs_winsorize(x, k), cs_neutralize(x, group_field)
   time-series (per stock, trailing window):
       delay(x, d), delta(x, d), ts_mean(x, w), ts_std(x, w), ts_sum(x, w),
       ts_min(x, w), ts_max(x, w), ts_rank(x, w), ts_corr(x, y, w),
-      decay_linear(x, w)
+      ts_cov(x, y, w), ts_argmax(x, w), ts_argmin(x, w), decay_linear(x, w)
 
 All ops are NaN-masked (missing stays missing; windows require full validity
 for corr/rank, count>=1 elsewhere), static-shaped, and jit/vmap-friendly —
@@ -187,6 +189,75 @@ def decay_linear(x, w):
     return jnp.where(s_m >= 1, num / den, _nan(x.dtype))
 
 
+def ts_cov(x, y, w):
+    """Trailing sample covariance (pandas ``rolling.cov`` ddof=1)."""
+    m = jnp.isfinite(x) & jnp.isfinite(y)
+    xz = jnp.where(m, x, 0.0)
+    yz = jnp.where(m, y, 0.0)
+    n = _winsum(m.astype(x.dtype), w)
+    cov = (_winsum(xz * yz, w) - _winsum(xz, w) * _winsum(yz, w) / n)
+    return jnp.where(n >= 2, cov / (n - 1.0), _nan(x.dtype))
+
+
+def ts_argmax(x, w):
+    """Days since the trailing-window maximum (0 = today is the max; ties
+    resolve to the most recent occurrence)."""
+    def red(win, m):
+        rev = jnp.where(m, win, -jnp.inf)[:, ::-1]  # position 0 = today
+        return jnp.argmax(rev, axis=1).astype(x.dtype)
+
+    return _ts_reduce(x, w, red)
+
+
+def ts_argmin(x, w):
+    """Days since the trailing-window minimum (0 = today; most recent tie)."""
+    def red(win, m):
+        rev = jnp.where(m, win, jnp.inf)[:, ::-1]
+        return jnp.argmin(rev, axis=1).astype(x.dtype)
+
+    return _ts_reduce(x, w, red)
+
+
+def signed_power(x, a):
+    """sign(x) * |x|**a — the WorldQuant convention for fractional powers
+    of signed signals."""
+    return jnp.sign(x) * jnp.abs(x) ** a
+
+
+def cs_winsorize(x, k=2.5):
+    """Per-date clip at mean ± k·std over valid stocks — the factor
+    pipeline's own winsorization (one implementation:
+    :func:`mfm_tpu.ops.masked.winsorize_cs`, ``post_processing.py:12-15``),
+    with the DSL's NaN-stays-NaN convention."""
+    from mfm_tpu.ops.masked import winsorize_cs
+
+    out = winsorize_cs(x, n_std=k)
+    return jnp.where(jnp.isfinite(x), out, _nan(x.dtype))
+
+
+def cs_neutralize(x, g, num_groups: int = 64):
+    """Subtract the per-(date, group) mean — industry/sector neutralization.
+
+    ``g`` is a (T, N) panel field of small integer group codes in
+    [0, num_groups) (float-encoded is fine).  Cells where x or g is missing
+    — or where the code is OUT OF RANGE (e.g. raw 801010-style SW codes
+    passed without ordinal-encoding first) — come back NaN rather than
+    silently aliasing into a wrong group.  Scatter-add into a
+    (T, num_groups) table keeps this O(T·N), no one-hot materialization.
+    """
+    m = (jnp.isfinite(x) & jnp.isfinite(g)
+         & (g >= 0) & (g < num_groups))
+    gi = jnp.where(m, g, 0).astype(jnp.int32)
+    T = x.shape[0]
+    rows = jnp.broadcast_to(jnp.arange(T)[:, None], x.shape)
+    sums = jnp.zeros((T, num_groups), x.dtype).at[rows, gi].add(
+        jnp.where(m, x, 0.0))
+    cnts = jnp.zeros((T, num_groups), x.dtype).at[rows, gi].add(
+        m.astype(x.dtype))
+    mu = sums / jnp.maximum(cnts, 1.0)
+    return jnp.where(m, x - mu[rows, gi], _nan(x.dtype))
+
+
 _ELEMENTWISE = {
     "abs": jnp.abs,
     "log": lambda x: jnp.log(jnp.where(x > 0, x, jnp.nan)),
@@ -213,7 +284,13 @@ _OPS: Dict[str, Callable] = {
     "ts_max": ts_max,
     "ts_rank": ts_rank,
     "ts_corr": ts_corr,
+    "ts_cov": ts_cov,
+    "ts_argmax": ts_argmax,
+    "ts_argmin": ts_argmin,
     "decay_linear": decay_linear,
+    "signed_power": signed_power,
+    "cs_winsorize": cs_winsorize,
+    "cs_neutralize": cs_neutralize,
 }
 
 _BINOPS = {

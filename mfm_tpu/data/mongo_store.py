@@ -140,8 +140,13 @@ class MongoPanelStore:
         if key not in self._indexed:
             # the compound unique key (ts_code, trade_date) cannot serve a
             # sort on trade_date alone — without this, every watermark read
-            # is a full collection scan
-            self.db[name].create_index([(date_col, pymongo.DESCENDING)])
+            # is a full collection scan.  Best-effort: a read-only role
+            # (monitoring/report clients) may not createIndexes; the
+            # find_one below still answers, just unindexed.
+            try:
+                self.db[name].create_index([(date_col, pymongo.DESCENDING)])
+            except Exception:
+                pass
             self._indexed.add(key)
         doc = self.db[name].find_one(
             {date_col: {"$exists": True}}, {date_col: 1, "_id": 0},

@@ -156,8 +156,16 @@ def run_risk_pipeline(
     config: PipelineConfig | None = None,
     industry_codes=None,
     sim_covs=None,
+    sim_length: int | None = None,
 ) -> RiskPipelineResult:
-    """Barra table -> full risk model (the ``demo.py`` path)."""
+    """Barra table -> full risk model (the ``demo.py`` path).
+
+    ``sim_length`` declares the draw count behind injected ``sim_covs``,
+    engaging the production eigen auto-sweep path; omitting it with
+    ``sim_covs`` set falls back to the conservative full-sweep count.
+    (Without ``sim_covs`` the draws are generated internally and
+    ``config.risk.eigen_sim_length`` already declares their count.)
+    """
     config = config or PipelineConfig()
     if arrays is None:
         arrays = barra_frame_to_arrays(barra_df, industry_codes=industry_codes)
@@ -168,5 +176,5 @@ def run_risk_pipeline(
         jnp.asarray(arrays.valid), n_industries=arrays.n_industries,
         config=config.risk, factor_names=arrays.factor_names(),
     )
-    out = rm.run(sim_covs=sim_covs)
+    out = rm.run(sim_covs=sim_covs, sim_length=sim_length)
     return RiskPipelineResult(outputs=out, arrays=arrays, model=rm)

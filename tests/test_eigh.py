@@ -179,7 +179,7 @@ def test_pallas_kernel_unsorted_slots_follow_original_indices():
 
 
 def test_production_sim_sweeps_deep_tier_accuracy():
-    """The deep near-diagonal tier (sim_length >= 16K -> default-3 sweeps,
+    """The deep near-diagonal tier (sim_length >= 32K -> default-3 sweeps,
     models/eigen.py::sim_sweeps_for): at K=42, 1390 draws the sweep
     reduction must stay well under the 1e-5 parity contract (measured
     1.5e-6 in the final adjusted covariance on TPU; 3 sweeps is 5e-5)."""
