@@ -142,12 +142,16 @@ class MongoPanelStore:
             # sort on trade_date alone — without this, every watermark read
             # is a full collection scan.  Best-effort: a read-only role
             # (monitoring/report clients) may not createIndexes; the
-            # find_one below still answers, just unindexed.
+            # find_one below still answers, just unindexed.  Only an
+            # authorization failure is cached as don't-retry — a transient
+            # error (stepdown, timeout) must not permanently degrade reads.
             try:
                 self.db[name].create_index([(date_col, pymongo.DESCENDING)])
+                self._indexed.add(key)
+            except pymongo.errors.OperationFailure:
+                self._indexed.add(key)
             except Exception:
                 pass
-            self._indexed.add(key)
         doc = self.db[name].find_one(
             {date_col: {"$exists": True}}, {date_col: 1, "_id": 0},
             sort=[(date_col, pymongo.DESCENDING)],

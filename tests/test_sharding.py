@@ -112,3 +112,40 @@ def test_regression_date_and_stock_sharded_2d(arrays):
         out = jax.jit(reg)(*args)
     np.testing.assert_allclose(np.asarray(out), np.asarray(base),
                                rtol=1e-9, atol=1e-12)
+
+
+def test_factor_engine_stock_sharded_matches_single_device():
+    """The full 16-factor engine — row-space argsort/gather/scatter included
+    — is embarrassingly parallel over stocks: sharding the stock axis must
+    not change a single output."""
+    from mfm_tpu.config import FactorConfig
+    from mfm_tpu.data.synthetic import synthetic_market_panel
+    from mfm_tpu.factors.engine import FactorEngine
+
+    data = synthetic_market_panel(T=80, N=32, n_industries=5, seed=3)
+    # float64: sharding changes the reduction order of the cross-sectional
+    # sums (NLSIZE's per-date OLS especially), which in f32 drifts ~1e-5 —
+    # an arithmetic artifact, not a layout bug; f64 pins it to ~1e-13
+    fields = {k: jnp.asarray(v, jnp.float64) for k, v in data.items()
+              if k not in ("dates", "stocks", "industry", "index_close",
+                           "observed", "end_date_code")}
+    fields["end_date_code"] = jnp.asarray(data["end_date_code"])
+    idx_close = jnp.asarray(data["index_close"], jnp.float64)
+
+    eng = FactorEngine(fields, idx_close, config=FactorConfig(), block=16)
+    base = {k: np.asarray(v) for k, v in eng.run().items()}
+
+    mesh = make_mesh(1, 8)  # all 8 devices on the stock axis
+    sharding = NamedSharding(mesh, P(None, "stock"))
+    sh_fields = {k: jax.device_put(v, sharding) for k, v in fields.items()}
+    eng_sh = FactorEngine(sh_fields, idx_close, config=FactorConfig(),
+                          block=16)
+    with jax.set_mesh(mesh):
+        out = {k: np.asarray(v) for k, v in eng_sh.run().items()}
+
+    assert set(out) == set(base)
+    for k in base:
+        # NLSIZE's SIZE^3-on-SIZE normal equations amplify the sharded
+        # reduction-order drift to ~8e-9 relative even in f64
+        np.testing.assert_allclose(out[k], base[k], rtol=1e-7, atol=1e-10,
+                                   equal_nan=True, err_msg=k)
