@@ -100,7 +100,8 @@ def _factors(args):
         p.fields["end_date_code"] = rid
         del p.fields["end_date"]
     barra, _ = run_factor_pipeline(
-        p.fields, idx_close, l1, p.dates, p.stocks, PipelineConfig(dtype=args.dtype)
+        p.fields, idx_close, l1, p.dates, p.stocks,
+        PipelineConfig(dtype=args.dtype, block=args.block),
     )
     os.makedirs(args.out, exist_ok=True)
     out_path = os.path.join(args.out, "barra_data.csv")
@@ -180,6 +181,7 @@ def _pipeline(args):
             vol_regime_half_life=args.vr_half_life, seed=args.seed,
         ),
         dtype=args.dtype,
+        block=args.block,
     )
     os.makedirs(args.out, exist_ok=True)
     barra_path = os.path.join(args.out, "barra_data.csv")
@@ -335,6 +337,10 @@ def main(argv=None):
     f.add_argument("--industry", required=True, help="ts_code -> l1_code csv")
     f.add_argument("--out", default="results")
     f.add_argument("--dtype", default="float32")
+    f.add_argument("--block", type=int, default=64,
+                   help="rolling-kernel date-block size (memory = block x "
+                        "window x stocks floats per input; use 16 at all-A "
+                        "5,000-stock scale)")
     f.set_defaults(fn=_factors)
 
     d = sub.add_parser("demo", help="synthetic end-to-end risk model")
@@ -376,6 +382,8 @@ def main(argv=None):
     pl.add_argument("--vr-half-life", type=float, default=42.0)
     pl.add_argument("--seed", type=int, default=0)
     pl.add_argument("--dtype", default="float32")
+    pl.add_argument("--block", type=int, default=64,
+                    help="rolling-kernel date-block size (16 at all-A scale)")
     pl.set_defaults(fn=_pipeline)
 
     c = sub.add_parser("crosscheck",

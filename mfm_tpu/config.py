@@ -154,3 +154,12 @@ class PipelineConfig:
     risk: RiskModelConfig = dataclasses.field(default_factory=RiskModelConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     dtype: str = "float32"  # compute dtype on TPU; tests use float64 on CPU
+    #: rolling-kernel date-block size (memory = block x window x N floats per
+    #: input, ops/rolling.py:52-90).  64 suits CSI300-sized panels; 16
+    #: measures fastest at all-A 5,000-stock scale (BASELINE.md block sweep).
+    block: int = 64
+
+    def __post_init__(self):
+        if not isinstance(self.block, int) or isinstance(self.block, bool) \
+                or self.block < 1:
+            raise ValueError(f"block must be a positive int, got {self.block!r}")

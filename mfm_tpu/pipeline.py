@@ -101,6 +101,7 @@ def run_factor_pipeline(
 
     ``fields`` must include everything :class:`FactorEngine` needs, plus
     ``circ_mv``.  This is the whole ``Barra_factor_cal/main.py`` path.
+    ``config.block`` sizes the rolling kernels' date blocks.
     """
     config = config or PipelineConfig()
     dtype = jnp.float64 if config.dtype == "float64" else jnp.float32
@@ -109,7 +110,7 @@ def run_factor_pipeline(
         for k, v in fields.items()
     }
     eng = FactorEngine(jfields, jnp.asarray(index_close, dtype),
-                       config=config.factors)
+                       config=config.factors, block=config.block)
     factors = {k: np.asarray(v) for k, v in eng.run().items()}
     observed = np.isfinite(np.asarray(fields["close"], np.float64))
     barra = assemble_barra_table(
