@@ -7,14 +7,28 @@ expressions into panel ops, evaluate them fused under one jit over the
 (T, N) panel, and score them (IC / rank-IC) against forward returns.
 """
 
-from mfm_tpu.alpha.dsl import AlphaExpr, compile_alpha, evaluate_alphas
-from mfm_tpu.alpha.metrics import information_coefficient, rank_ic, alpha_summary
+from mfm_tpu.alpha.dsl import (
+    AlphaExpr,
+    compile_alpha,
+    compile_alpha_batch,
+    evaluate_alphas,
+)
+from mfm_tpu.alpha.metrics import (
+    alpha_summary,
+    information_coefficient,
+    quantile_spread,
+    rank_ic,
+    rank_turnover,
+)
 
 __all__ = [
     "AlphaExpr",
     "compile_alpha",
+    "compile_alpha_batch",
     "evaluate_alphas",
     "information_coefficient",
     "rank_ic",
+    "rank_turnover",
+    "quantile_spread",
     "alpha_summary",
 ]

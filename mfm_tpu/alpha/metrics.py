@@ -33,24 +33,79 @@ def rank_ic(alpha: jax.Array, fwd_ret: jax.Array) -> jax.Array:
     return information_coefficient(ra, rr)
 
 
-def alpha_summary(alphas: jax.Array, fwd_ret: jax.Array) -> dict:
+def _turnover_from_ranks(r: jax.Array) -> jax.Array:
+    prev = jnp.concatenate(
+        [jnp.full_like(r[..., :1, :], jnp.nan), r[..., :-1, :]], axis=-2)
+    m = jnp.isfinite(r) & jnp.isfinite(prev)
+    n = jnp.sum(m, axis=-1)
+    d = jnp.sum(jnp.where(m, jnp.abs(r - prev), 0.0), axis=-1)
+    return jnp.where(n >= 1, d / n, jnp.nan)
+
+
+def rank_turnover(alpha: jax.Array) -> jax.Array:
+    """Per-date mean |Δ cross-sectional rank| between consecutive dates.
+
+    alpha: (..., T, N).  Returns (..., T); date 0 and dates where a stock is
+    valid on only one of the two days contribute through the stocks valid on
+    BOTH.  0 = identical ordering day-over-day, →0.5 = full reshuffle (the
+    expectation for independent uniform ranks is 1/3).
+    """
+    return _turnover_from_ranks(cs_rank(alpha))
+
+
+def _spread_from_ranks(r: jax.Array, fwd_ret: jax.Array,
+                       q: float) -> jax.Array:
+    f = jnp.broadcast_to(fwd_ret, r.shape)
+    m = jnp.isfinite(r) & jnp.isfinite(f)
+    top = m & (r > 1.0 - q)
+    bot = m & (r <= q)
+    n_top = jnp.sum(top, axis=-1)
+    n_bot = jnp.sum(bot, axis=-1)
+    mu_top = jnp.sum(jnp.where(top, f, 0.0), axis=-1) / n_top
+    mu_bot = jnp.sum(jnp.where(bot, f, 0.0), axis=-1) / n_bot
+    return jnp.where((n_top >= 1) & (n_bot >= 1), mu_top - mu_bot, jnp.nan)
+
+
+def quantile_spread(alpha: jax.Array, fwd_ret: jax.Array,
+                    q: float = 0.2) -> jax.Array:
+    """Per-date top-minus-bottom quantile forward return.
+
+    Mean forward return of the top-``q`` fraction of stocks by alpha minus
+    the bottom-``q`` fraction (by fractional cross-sectional rank).
+    alpha: (..., T, N); fwd_ret: (T, N).  Returns (..., T).
+    """
+    return _spread_from_ranks(cs_rank(alpha), fwd_ret, q)
+
+
+def _nanmean_last(x):
+    m = jnp.isfinite(x)
+    return jnp.sum(jnp.where(m, x, 0.0), axis=-1) / jnp.sum(m, axis=-1)
+
+
+def alpha_summary(alphas: jax.Array, fwd_ret: jax.Array,
+                  spread_q: float = 0.2) -> dict:
     """Batch scorecard for (E, T, N) alpha values.
 
     Returns per-expression arrays: mean IC, IC information ratio
-    (mean/std over dates), mean rank-IC, coverage (mean valid fraction).
+    (mean/std over dates), mean rank-IC, coverage (mean valid fraction),
+    mean day-over-day rank turnover, and the mean top-minus-bottom
+    ``spread_q``-quantile forward return.
     """
     ic = information_coefficient(alphas, fwd_ret)  # (E, T)
-    ric = rank_ic(alphas, fwd_ret)
+    # one double-argsort over (E, T, N) shared by rank-IC, turnover, spread
+    r = cs_rank(alphas)
+    ric = information_coefficient(r, cs_rank(fwd_ret))
     m = jnp.isfinite(ic)
     n = jnp.sum(m, axis=-1)
     mean_ic = jnp.sum(jnp.where(m, ic, 0.0), axis=-1) / n
     var_ic = jnp.sum(jnp.where(m, (ic - mean_ic[:, None]) ** 2, 0.0), axis=-1) / n
-    mr = jnp.isfinite(ric)
-    mean_ric = jnp.sum(jnp.where(mr, ric, 0.0), axis=-1) / jnp.sum(mr, axis=-1)
     coverage = jnp.mean(jnp.isfinite(alphas), axis=(-2, -1))
     return {
         "mean_ic": mean_ic,
         "ic_ir": mean_ic / jnp.sqrt(var_ic),
-        "mean_rank_ic": mean_ric,
+        "mean_rank_ic": _nanmean_last(ric),
         "coverage": coverage,
+        "mean_turnover": _nanmean_last(_turnover_from_ranks(r)),
+        "mean_spread": _nanmean_last(_spread_from_ranks(r, fwd_ret,
+                                                        spread_q)),
     }

@@ -246,3 +246,68 @@ def test_signed_power_expression(panel):
     m = np.isfinite(x)
     np.testing.assert_allclose(out[m], np.sign(x[m]) * np.abs(x[m]) ** 0.5,
                                rtol=1e-5, atol=1e-8)
+
+
+def test_rank_turnover_semantics(panel):
+    from mfm_tpu.alpha.dsl import cs_rank
+    from mfm_tpu.alpha.metrics import rank_turnover
+
+    x = panel["close"]
+    # a constant-through-time signal has zero turnover wherever defined on
+    # consecutive days
+    const = jnp.broadcast_to(x[0:1], x.shape)
+    to = np.asarray(rank_turnover(const))
+    defined = np.isfinite(to[1:])
+    np.testing.assert_allclose(to[1:][defined], 0.0, atol=1e-7)
+    # loopy check on the real signal
+    got = np.asarray(rank_turnover(x))
+    r = np.asarray(cs_rank(x))
+    t = 30
+    m = np.isfinite(r[t]) & np.isfinite(r[t - 1])
+    exp = np.abs(r[t][m] - r[t - 1][m]).mean()
+    np.testing.assert_allclose(got[t], exp, rtol=1e-6)
+
+
+def test_quantile_spread_perfect_alpha(panel):
+    from mfm_tpu.alpha.metrics import quantile_spread
+
+    fwd = jnp.concatenate(
+        [panel["ret"][1:], jnp.full((1, panel["ret"].shape[1]), jnp.nan)],
+        axis=0)
+    # alpha == forward return: the spread must be positive wherever defined
+    sp = np.asarray(quantile_spread(fwd, fwd, q=0.25))
+    d = sp[np.isfinite(sp)]
+    assert d.size > 10
+    assert (d > 0).all()
+    # loopy check for one date
+    t = 20
+    f = np.asarray(fwd, np.float64)[t]
+    m = np.isfinite(f)
+    ranks = pd.Series(f[m]).rank(pct=True, method="first").to_numpy()
+    exp = f[m][ranks > 0.75].mean() - f[m][ranks <= 0.25].mean()
+    np.testing.assert_allclose(sp[t], exp, rtol=1e-5)
+
+
+def test_alpha_summary_includes_new_metrics(panel):
+    from mfm_tpu.alpha.dsl import cs_rank
+
+    fwd = jnp.concatenate(
+        [panel["ret"][1:], jnp.full((1, panel["ret"].shape[1]), jnp.nan)],
+        axis=0)
+    # alphas genuinely aligned with the target: the rank of fwd itself and
+    # its negation (cs_rank(ret) would rank the SAME-day return — i.i.d. of
+    # fwd, so its spread sign would be a coin flip)
+    out = jnp.stack([cs_rank(fwd), -cs_rank(fwd)], axis=0)
+    s = alpha_summary(out, fwd)
+    for k in ("mean_turnover", "mean_spread"):
+        assert s[k].shape == (2,)
+        assert np.isfinite(np.asarray(s[k])).all()
+    # perfectly aligned alpha is positively spread; its negation flips.
+    # Exact antisymmetry does NOT hold: the top (r > 1-q) and bottom
+    # (r <= q) buckets capture different counts for N not divisible by 1/q.
+    sp = np.asarray(s["mean_spread"])
+    assert sp[0] > 0 > sp[1]
+    # negation approximately preserves turnover (not exactly: the reversal
+    # offset (n+1)/n shifts with the per-date valid count)
+    to = np.asarray(s["mean_turnover"])
+    np.testing.assert_allclose(to[0], to[1], rtol=2e-2)
