@@ -6,6 +6,7 @@ from mfm_tpu.models.newey_west import newey_west, newey_west_expanding
 from mfm_tpu.models.eigen import eigen_risk_adjust, eigen_risk_adjust_by_time
 from mfm_tpu.models.vol_regime import vol_regime_adjust_by_time
 from mfm_tpu.models.bias import eigenfactor_bias_stat, bayes_shrink
+from mfm_tpu.models.specific import ewma_specific_vol, specific_risk_by_time
 from mfm_tpu.models.risk_model import RiskModel, RiskModelOutputs
 
 __all__ = [
@@ -16,6 +17,8 @@ __all__ = [
     "vol_regime_adjust_by_time",
     "eigenfactor_bias_stat",
     "bayes_shrink",
+    "ewma_specific_vol",
+    "specific_risk_by_time",
     "RiskModel",
     "RiskModelOutputs",
 ]

@@ -145,6 +145,7 @@ def bayes_shrink(
     capital: jax.Array,
     ngroup: int = 10,
     q: float = 1.0,
+    mask: jax.Array | None = None,
 ) -> jax.Array:
     """Bayesian shrinkage of specific volatility toward cap-group means.
 
@@ -156,19 +157,54 @@ def bayes_shrink(
 
     Group assignment uses quantile edges (matching ``pd.qcut`` for distinct
     caps); ties across edges may bucket differently than pandas.
+
+    ``mask`` (bool (N,), optional) restricts the universe: quantile edges,
+    group means, and dispersions are computed over masked-in stocks only
+    (the per-date ragged universe of :func:`mfm_tpu.models.specific.
+    specific_risk_by_time`); masked-out entries return NaN.  ``mask=None``
+    matches the reference's all-stocks behavior except in two degenerate
+    cases where the reference emits NaN and this returns the limit value:
+    a 0/0 shrinkage intensity (singleton group / zero dispersion at the
+    group mean -> |vol| itself) and empty groups when N < ngroup.
     """
     dtype = volatility.dtype
-    n = capital.shape[0]
-    qs = jnp.quantile(capital, jnp.linspace(0.0, 1.0, ngroup + 1)[1:-1])
+    if mask is None:
+        qs = jnp.quantile(capital, jnp.linspace(0.0, 1.0, ngroup + 1)[1:-1])
+        mf = jnp.ones_like(volatility)
+    else:
+        # masked quantile, linear interpolation over the n valid caps (the
+        # same convention jnp.quantile uses over a full array)
+        mf = mask.astype(dtype)
+        n_valid = jnp.sum(mask)
+        s = jnp.sort(jnp.where(mask, capital, jnp.inf))
+        pos = jnp.linspace(0.0, 1.0, ngroup + 1)[1:-1] * (n_valid - 1)
+        lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, capital.shape[0] - 1)
+        hi = jnp.clip(jnp.ceil(pos).astype(jnp.int32), 0, capital.shape[0] - 1)
+        frac = (pos - lo).astype(dtype)
+        qs = s[lo] * (1.0 - frac) + s[hi] * frac
     group = jnp.searchsorted(qs, capital, side="left")  # (N,) in [0, ngroup)
     oh = (group[:, None] == jnp.arange(ngroup)[None, :]).astype(dtype)  # (N, G)
+    oh = oh * mf[:, None]
     cap_g = oh.T @ capital
-    m_g = (oh.T @ (volatility * capital)) / cap_g  # cap-weighted group mean
     cnt_g = jnp.sum(oh, axis=0)
+    # a group can be EMPTY when the universe is smaller than ngroup
+    # (coincident quantile edges); no stock belongs to it, but a NaN mean
+    # there would still poison every stock through 0*NaN in oh @ m_g
+    m_g = jnp.where(cnt_g > 0,
+                    (oh.T @ (volatility * capital))
+                    / jnp.where(cap_g > 0, cap_g, 1.0), 0.0)
     dev2 = (volatility[:, None] - m_g[None, :]) ** 2 * oh
-    s_g = jnp.sqrt(jnp.sum(dev2, axis=0) / cnt_g)
+    s_g = jnp.where(cnt_g > 0,
+                    jnp.sqrt(jnp.sum(dev2, axis=0)
+                             / jnp.where(cnt_g > 0, cnt_g, 1.0)), 0.0)
     m_s = oh @ m_g
     s_s = oh @ s_g
     a = q * jnp.abs(volatility - m_s)
-    v = a / (a + s_s)
-    return v * m_s + (1.0 - v) * jnp.abs(volatility)
+    # a == s == 0 (a singleton group, or vol exactly at its group mean with
+    # zero dispersion) is 0/0 in the reference (utils.py:163); both shrink
+    # targets coincide with |vol| there, so v = 0 is the value's limit
+    v = jnp.where(a + s_s > 0, a / jnp.where(a + s_s > 0, a + s_s, 1.0), 0.0)
+    out = v * m_s + (1.0 - v) * jnp.abs(volatility)
+    if mask is not None:
+        out = jnp.where(mask, out, jnp.nan)
+    return out

@@ -1,0 +1,86 @@
+"""Specific-risk model: EWMA specific volatility + Bayesian shrinkage.
+
+The reference defines ``bayes_shrink`` (``Barra-master/mfm/utils.py:133-168``)
+but never calls it (SURVEY.md §7.3); its drivers stop at factor covariances
+plus raw specific returns (``demo.py:60-94``).  This module completes the
+USE4 specific-risk stage that shrinkage exists for:
+
+1. :func:`ewma_specific_vol` — per-stock EWMA volatility of specific
+   returns, the same restricted-renormalized half-life machinery as the
+   factor vol-regime stage (``MFM.py:158-159``), masked over each stock's
+   valid dates.
+2. :func:`specific_risk_by_time` — the vol panel shrunk per date toward
+   cap-decile group means (``utils.py:133-168``, masked to the per-date
+   universe).
+
+The portfolio-level combination sigma_p^2 = x'Fx + sum w_i^2 sigma_i^2 —
+the model's end use — lives on
+:meth:`mfm_tpu.pipeline.RiskPipelineResult.portfolio_risk`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from mfm_tpu.models.bias import bayes_shrink
+
+
+def ewma_specific_vol(
+    specific_ret: jax.Array,
+    half_life: float = 42.0,
+    min_periods: int = 10,
+):
+    """Per-stock EWMA volatility of specific returns.
+
+    specific_ret: (T, N), NaN outside each date's universe.  For each (t, n),
+    ``vol = sqrt(sum_i w_i u_i^2 / sum_i w_i)`` over stock n's valid dates
+    i <= t with exp-decay weights of the given half-life (the vol-regime
+    stage's restricted renormalized EWMA, ``MFM.py:158-159``, applied per
+    stock).  Dates with fewer than ``min_periods`` valid observations so far
+    are NaN.  Returns (T, N).
+    """
+    dtype = specific_ret.dtype
+    lam = jnp.asarray(0.5, dtype) ** (1.0 / half_life)
+    m = jnp.isfinite(specific_ret)
+    u2 = jnp.where(m, specific_ret, 0.0) ** 2
+    mf = m.astype(dtype)
+
+    def step(carry, inp):
+        num, den, cnt = carry
+        x2, ok = inp
+        num = lam * num + ok * x2
+        den = lam * den + ok
+        cnt = cnt + ok
+        var = jnp.where((cnt >= min_periods) & (den > 0),
+                        num / jnp.maximum(den, 1e-30), jnp.nan)
+        return (num, den, cnt), var
+
+    zero = jnp.zeros(specific_ret.shape[1], dtype)
+    _, var = jax.lax.scan(step, (zero, zero, zero), (u2, mf))
+    return jnp.sqrt(var)
+
+
+def specific_risk_by_time(
+    specific_ret: jax.Array,
+    cap: jax.Array,
+    half_life: float = 42.0,
+    ngroup: int = 10,
+    q: float = 1.0,
+    min_periods: int = 10,
+):
+    """(T, N) specific-risk panel: EWMA vol, then per-date Bayesian
+    shrinkage toward cap-group means over that date's valid universe.
+
+    Returns (raw_vol (T, N), shrunk_vol (T, N)); cells with no vol estimate
+    yet (or no cap) are NaN in both.
+    """
+    vol = ewma_specific_vol(specific_ret, half_life, min_periods)
+    mask = jnp.isfinite(vol) & jnp.isfinite(cap) & (cap > 0)
+
+    def one(v, c, m):
+        return bayes_shrink(jnp.where(m, v, 0.0), jnp.where(m, c, 1.0),
+                            ngroup=ngroup, q=q, mask=m)
+
+    shrunk = jax.vmap(one)(vol, cap, mask)
+    return jnp.where(mask, vol, jnp.nan), jnp.where(mask, shrunk, jnp.nan)

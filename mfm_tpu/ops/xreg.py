@@ -59,6 +59,48 @@ def _constraint_matrix(ind_cap: jax.Array, Q: int) -> jax.Array:
 
 
 @highest_matmul_precision
+def regression_design(
+    ret: jax.Array,
+    cap: jax.Array,
+    styles: jax.Array,
+    industry: jax.Array,
+    valid: jax.Array,
+    *,
+    n_industries: int,
+    standardize_styles: bool = True,
+):
+    """One date's regression design in its exact estimation basis.
+
+    Returns (X (N, K), valid (N,), capz (N,)): the masked country column,
+    industry one-hot, cap-weighted-standardized styles — with the
+    regression's own universe narrowing (finite ret/cap, industry in
+    [0, P)).  Shared by :func:`cross_section_regress` and
+    ``RiskPipelineResult.portfolio_risk`` so portfolio exposures are always
+    computed in the basis the factor covariance was estimated in.
+    """
+    dtype = styles.dtype
+    P = n_industries
+    valid = valid & jnp.isfinite(ret) & jnp.isfinite(cap)
+    if P:
+        valid = valid & (industry >= 0) & (industry < P)
+    vf = valid.astype(dtype)
+
+    if standardize_styles:
+        s = zscore_cap_weighted(styles, cap[:, None], valid[:, None], axis=0)
+    else:
+        s = styles
+    s = jnp.where(valid[:, None], s, 0.0)
+    capz = jnp.where(valid, cap, 0.0)
+    country = vf[:, None]
+    if P:
+        ind_oh = (industry[:, None] == jnp.arange(P)[None, :]).astype(dtype) \
+            * vf[:, None]
+        X = jnp.concatenate([country, ind_oh, s], axis=1)  # (N, K)
+    else:
+        X = jnp.concatenate([country, s], axis=1)
+    return X, valid, capz
+
+
 def cross_section_regress(
     ret: jax.Array,
     cap: jax.Array,
@@ -82,28 +124,17 @@ def cross_section_regress(
       n_industries: P (static).  P=0 runs the no-industry branch
                 (``CrossSection.py:95-98``).
     """
-    dtype = styles.dtype
     P = n_industries
     Q = styles.shape[-1]
-    valid = valid & jnp.isfinite(ret) & jnp.isfinite(cap)
-    if P:
-        valid = valid & (industry >= 0) & (industry < P)
-    vf = valid.astype(dtype)
-
-    if standardize_styles:
-        s = zscore_cap_weighted(styles, cap[:, None], valid[:, None], axis=0)
-    else:
-        s = styles
-    s = jnp.where(valid[:, None], s, 0.0)
-
-    capz = jnp.where(valid, cap, 0.0)
+    X, valid, capz = regression_design(
+        ret, cap, styles, industry, valid, n_industries=P,
+        standardize_styles=standardize_styles,
+    )
     w = jnp.sqrt(capz)
     w = w / jnp.sum(w)
 
-    country = vf[:, None]
     if P:
-        ind_oh = (industry[:, None] == jnp.arange(P)[None, :]).astype(dtype) * vf[:, None]
-        X = jnp.concatenate([country, ind_oh, s], axis=1)  # (N, K)
+        ind_oh = X[:, 1:1 + P]
         ind_cap = ind_oh.T @ capz  # (P,) per-industry total cap (CrossSection.py:66)
         R = _constraint_matrix(ind_cap, Q)  # (K, K-1)
         Xr = X @ R  # (N, K-1)
@@ -111,7 +142,6 @@ def cross_section_regress(
         G = XtW @ Xr  # (K-1, K-1)
         omega = R @ (jnp.linalg.pinv(G) @ XtW)  # (K, N)
     else:
-        X = jnp.concatenate([country, s], axis=1)
         XtW = X.T * w[None, :]
         G = XtW @ X
         omega = jnp.linalg.pinv(G) @ XtW

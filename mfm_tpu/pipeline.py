@@ -124,6 +124,8 @@ class RiskPipelineResult:
     outputs: RiskModelOutputs
     arrays: BarraArrays
     model: RiskModel
+    #: (half_life, ngroup, q, min_periods) -> (T, N) shrunk specific vol
+    _spec_cache: dict = dataclasses.field(default_factory=dict, repr=False)
 
     # -- demo.py:60-94 result tables --------------------------------------
     def factor_returns(self):
@@ -149,6 +151,98 @@ class RiskPipelineResult:
     def lambda_series(self):
         return pd.DataFrame(np.asarray(self.outputs.lamb),
                             index=self.arrays.dates, columns=["lambda"])
+
+    # -- portfolio-level combination (the model's end use; the reference
+    # -- stops at the covariance CSVs, demo.py:60-94) -----------------------
+    def specific_risk(self, half_life: float = 42.0, ngroup: int = 10,
+                      q: float = 1.0, min_periods: int = 10):
+        """(raw, shrunk) per-stock specific-vol DataFrames (T x N):
+        EWMA specific volatility Bayes-shrunk toward cap-group means
+        (``utils.py:133-168``, the stage the reference defines but never
+        wires)."""
+        from mfm_tpu.models.specific import specific_risk_by_time
+
+        raw, shrunk = specific_risk_by_time(
+            self.outputs.specific_ret, jnp.asarray(self.arrays.cap),
+            half_life=half_life, ngroup=ngroup, q=q,
+            min_periods=min_periods)
+        f = lambda x: pd.DataFrame(np.asarray(x), index=self.arrays.dates,
+                                   columns=self.arrays.stocks)
+        return f(raw), f(shrunk)
+
+    def _shrunk_specific_vol(self, half_life, ngroup, q, min_periods):
+        """Cached (T, N) shrunk specific-vol panel per parameter set."""
+        from mfm_tpu.models.specific import specific_risk_by_time
+
+        key = (half_life, ngroup, q, min_periods)
+        if key not in self._spec_cache:
+            _, shrunk = specific_risk_by_time(
+                self.outputs.specific_ret, jnp.asarray(self.arrays.cap),
+                half_life=half_life, ngroup=ngroup, q=q,
+                min_periods=min_periods)
+            self._spec_cache[key] = np.asarray(shrunk)
+        return self._spec_cache[key]
+
+    def portfolio_risk(self, weights, t: int = -1, specific_vol=None,
+                       half_life: float = 42.0, ngroup: int = 10,
+                       q: float = 1.0, min_periods: int = 10) -> dict:
+        """Predicted portfolio risk at date ``t``:
+        ``sigma_p^2 = x'Fx + sum_i w_i^2 sigma_i^2`` with x = X_t' w.
+
+        ``weights``: (N,) finite, aligned to ``arrays.stocks``; weight on
+        stocks outside date t's regression universe must be 0 (raises).
+        X_t is the regression's own design (shared builder
+        :func:`mfm_tpu.ops.xreg.regression_design`), so F (the
+        vol-regime-adjusted covariance) applies to x in the exact basis it
+        was estimated in.  ``specific_vol``: (N,) per-stock vol at date t;
+        defaults to the shrunk EWMA specific risk with the given
+        ``half_life``/``ngroup``/``q``/``min_periods`` (same defaults as
+        :meth:`specific_risk`; the panel is computed once and cached per
+        parameter set).  Held stocks with no vol estimate raise rather than
+        silently dropping their idiosyncratic variance.
+        """
+        from mfm_tpu.ops.xreg import regression_design
+
+        a = self.arrays
+        T = a.ret.shape[0]
+        t = int(t) % T
+        w = np.asarray(weights, np.float64)
+        if not np.isfinite(w).all():
+            raise ValueError("weights must be finite (reindex fills of NaN "
+                             "on out-of-universe stocks must be 0)")
+        X, valid, _ = regression_design(
+            jnp.asarray(a.ret[t]), jnp.asarray(a.cap[t]),
+            jnp.asarray(a.styles[t]), jnp.asarray(a.industry[t]),
+            jnp.asarray(a.valid[t]), n_industries=a.n_industries)
+        X, valid = np.asarray(X, np.float64), np.asarray(valid)
+        if np.abs(w[~valid]).sum() > 0:
+            raise ValueError("nonzero weight on stocks outside the date-t "
+                             "regression universe")
+        F = np.asarray(self.outputs.vr_cov[t], np.float64)
+        if not np.isfinite(F).all():
+            raise ValueError(f"no valid adjusted covariance at date index {t}")
+        x = X.T @ w
+        factor_var = float(x @ F @ x)
+        if specific_vol is None:
+            specific_vol = self._shrunk_specific_vol(
+                half_life, ngroup, q, min_periods)[t]
+        sv = np.asarray(specific_vol, np.float64)
+        held = np.abs(w) > 0
+        if np.isnan(sv[held]).any():
+            n_bad = int(np.isnan(sv[held]).sum())
+            raise ValueError(
+                f"{n_bad} held stock(s) have no specific-vol estimate at "
+                f"date index {t} (fewer than min_periods={min_periods} "
+                "observations); pass specific_vol= explicitly or zero their "
+                "weight")
+        spec_var = float(np.sum((w[held] ** 2) * (sv[held] ** 2)))
+        return {
+            "date": a.dates[t],
+            "factor_var": factor_var,
+            "specific_var": spec_var,
+            "total_vol": float(np.sqrt(factor_var + spec_var)),
+            "factor_exposures": pd.Series(x, index=a.factor_names()),
+        }
 
 
 def run_risk_pipeline(

@@ -1,0 +1,160 @@
+"""Specific-risk stage (the USE4 stage behind the reference's never-called
+``bayes_shrink``, ``utils.py:133-168``) + the portfolio-risk combination."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from mfm_tpu.models.bias import bayes_shrink
+from mfm_tpu.models.specific import ewma_specific_vol, specific_risk_by_time
+
+
+def _loopy_ewma_vol(u, half_life, min_periods):
+    T, N = u.shape
+    lam = 0.5 ** (1.0 / half_life)
+    out = np.full((T, N), np.nan)
+    for n in range(N):
+        num = den = cnt = 0.0
+        for t in range(T):
+            ok = np.isfinite(u[t, n])
+            num = lam * num + (u[t, n] ** 2 if ok else 0.0)
+            den = lam * den + (1.0 if ok else 0.0)
+            cnt += ok
+            if cnt >= min_periods and den > 0:
+                out[t, n] = np.sqrt(num / den)
+    return out
+
+
+def test_ewma_specific_vol_matches_loopy():
+    rng = np.random.default_rng(0)
+    T, N = 120, 9
+    u = 0.02 * rng.standard_normal((T, N))
+    u[rng.random((T, N)) < 0.15] = np.nan
+    u[:30, 0] = np.nan  # late listing
+    got = np.asarray(ewma_specific_vol(jnp.asarray(u), 42.0, 10))
+    exp = _loopy_ewma_vol(u, 42.0, 10)
+    np.testing.assert_allclose(got, exp, rtol=1e-10, atol=1e-14,
+                               equal_nan=True)
+
+
+def test_bayes_shrink_mask_full_equals_unmasked():
+    rng = np.random.default_rng(1)
+    N = 200
+    vol = np.abs(rng.normal(0.02, 0.01, N))
+    cap = np.exp(rng.normal(11, 1, N))
+    base = np.asarray(bayes_shrink(jnp.asarray(vol), jnp.asarray(cap)))
+    masked = np.asarray(bayes_shrink(jnp.asarray(vol), jnp.asarray(cap),
+                                     mask=jnp.ones(N, bool)))
+    np.testing.assert_allclose(masked, base, rtol=1e-12)
+
+
+def test_bayes_shrink_masked_equals_subset():
+    """Shrinking with a mask must equal shrinking the valid subset alone:
+    invalid stocks must not shift quantile edges, group means, or
+    dispersions."""
+    rng = np.random.default_rng(2)
+    N = 150
+    vol = np.abs(rng.normal(0.02, 0.01, N))
+    cap = np.exp(rng.normal(11, 1, N))
+    mask = rng.random(N) > 0.3
+    # poison the masked-out entries — they must have zero influence
+    vol_p, cap_p = vol.copy(), cap.copy()
+    vol_p[~mask] = 99.0
+    cap_p[~mask] = 1e12
+    got = np.asarray(bayes_shrink(jnp.asarray(vol_p), jnp.asarray(cap_p),
+                                  mask=jnp.asarray(mask)))
+    sub = np.asarray(bayes_shrink(jnp.asarray(vol[mask]),
+                                  jnp.asarray(cap[mask])))
+    np.testing.assert_allclose(got[mask], sub, rtol=1e-10)
+    assert np.isnan(got[~mask]).all()
+
+
+def test_specific_risk_by_time_shapes_and_nan_discipline():
+    rng = np.random.default_rng(3)
+    T, N = 90, 40
+    u = 0.02 * rng.standard_normal((T, N))
+    u[rng.random((T, N)) < 0.1] = np.nan
+    cap = np.exp(rng.normal(11, 1, (T, N)))
+    raw, shrunk = specific_risk_by_time(jnp.asarray(u), jnp.asarray(cap),
+                                        min_periods=10)
+    raw, shrunk = np.asarray(raw), np.asarray(shrunk)
+    assert raw.shape == shrunk.shape == (T, N)
+    # NaN wherever raw is NaN; finite (and positive) where raw is finite
+    np.testing.assert_array_equal(np.isnan(raw), np.isnan(shrunk))
+    m = np.isfinite(raw)
+    assert m[-1].all()  # everyone has >=10 obs by the end
+    assert (shrunk[m] > 0).all()
+    # shrinkage moves vol toward group means: dispersion must not increase
+    assert shrunk[-1].std() <= raw[-1].std() * 1.001
+
+
+def test_portfolio_risk_decomposition():
+    from mfm_tpu.config import PipelineConfig, RiskModelConfig
+    from mfm_tpu.data.synthetic import synthetic_barra_table
+    from mfm_tpu.pipeline import run_risk_pipeline
+
+    df, _ = synthetic_barra_table(T=100, N=40, P=5, Q=3, seed=4)
+    res = run_risk_pipeline(
+        barra_df=df,
+        config=PipelineConfig(risk=RiskModelConfig(eigen_n_sims=8),
+                              dtype="float64"))
+    a = res.arrays
+    valid = np.asarray(a.valid[-1])
+    w = np.where(valid, 1.0, 0.0)
+    w /= w.sum()
+    rep = res.portfolio_risk(w)
+    assert rep["total_vol"] > 0
+    assert rep["factor_var"] >= 0 and rep["specific_var"] >= 0
+    assert np.isclose(rep["total_vol"],
+                      np.sqrt(rep["factor_var"] + rep["specific_var"]))
+    # country exposure of a fully-invested portfolio is exactly 1
+    np.testing.assert_allclose(rep["factor_exposures"]["country"], 1.0,
+                               rtol=1e-9)
+    # manual cross-check of the factor part
+    x = rep["factor_exposures"].to_numpy()
+    F = np.asarray(res.outputs.vr_cov[-1], np.float64)
+    np.testing.assert_allclose(rep["factor_var"], x @ F @ x, rtol=1e-9)
+
+    # nonzero weight outside the universe is an error, not silence
+    bad = np.ones_like(w) / len(w)
+    if (~valid).any():
+        with pytest.raises(ValueError, match="universe"):
+            res.portfolio_risk(bad)
+
+    # specific_risk() DataFrames align with the panel
+    raw, shrunk = res.specific_risk()
+    assert raw.shape == (100, 40) and shrunk.shape == (100, 40)
+
+
+def test_portfolio_risk_error_paths():
+    from mfm_tpu.config import PipelineConfig, RiskModelConfig
+    from mfm_tpu.data.synthetic import synthetic_barra_table
+    from mfm_tpu.pipeline import run_risk_pipeline
+
+    df, _ = synthetic_barra_table(T=100, N=40, P=5, Q=3, seed=4)
+    res = run_risk_pipeline(
+        barra_df=df,
+        config=PipelineConfig(risk=RiskModelConfig(eigen_n_sims=8),
+                              dtype="float64"))
+    valid = np.asarray(res.arrays.valid[-1])
+    w = np.where(valid, 1.0, 0.0)
+    w /= w.sum()
+
+    # NaN weights (a pandas reindex artifact) must raise, not propagate
+    w_nan = w.copy()
+    w_nan[~valid] = np.nan
+    if (~valid).any():
+        with pytest.raises(ValueError, match="finite"):
+            res.portfolio_risk(w_nan)
+
+    # a held stock with no specific-vol estimate must raise, not be
+    # silently treated as zero idiosyncratic variance
+    sv = np.full(len(w), np.nan)
+    with pytest.raises(ValueError, match="no specific-vol estimate"):
+        res.portfolio_risk(w, specific_vol=sv)
+
+    # the cached panel honors non-default parameters (distinct cache keys)
+    rep_a = res.portfolio_risk(w)
+    rep_b = res.portfolio_risk(w, half_life=84.0, ngroup=5)
+    assert rep_a["specific_var"] != rep_b["specific_var"]
+    assert len(res._spec_cache) == 2
