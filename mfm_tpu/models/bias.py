@@ -172,6 +172,11 @@ def bayes_shrink(
         qs = jnp.quantile(capital, jnp.linspace(0.0, 1.0, ngroup + 1)[1:-1])
         mf = jnp.ones_like(volatility)
     else:
+        # sanitize masked-out entries FIRST: NaN vol/cap under the mask is
+        # the natural input, and 0 * NaN = NaN would otherwise poison every
+        # group mean through the zeroed one-hot matmuls
+        volatility = jnp.where(mask, volatility, 0.0)
+        capital = jnp.where(mask, capital, 1.0)
         # masked quantile, linear interpolation over the n valid caps (the
         # same convention jnp.quantile uses over a full array)
         mf = mask.astype(dtype)

@@ -79,8 +79,7 @@ def specific_risk_by_time(
     mask = jnp.isfinite(vol) & jnp.isfinite(cap) & (cap > 0)
 
     def one(v, c, m):
-        return bayes_shrink(jnp.where(m, v, 0.0), jnp.where(m, c, 1.0),
-                            ngroup=ngroup, q=q, mask=m)
+        return bayes_shrink(v, c, ngroup=ngroup, q=q, mask=m)
 
     shrunk = jax.vmap(one)(vol, cap, mask)
     return jnp.where(mask, vol, jnp.nan), jnp.where(mask, shrunk, jnp.nan)

@@ -101,6 +101,7 @@ def regression_design(
     return X, valid, capz
 
 
+@highest_matmul_precision
 def cross_section_regress(
     ret: jax.Array,
     cap: jax.Array,

@@ -160,27 +160,24 @@ class RiskPipelineResult:
         EWMA specific volatility Bayes-shrunk toward cap-group means
         (``utils.py:133-168``, the stage the reference defines but never
         wires)."""
-        from mfm_tpu.models.specific import specific_risk_by_time
-
-        raw, shrunk = specific_risk_by_time(
-            self.outputs.specific_ret, jnp.asarray(self.arrays.cap),
-            half_life=half_life, ngroup=ngroup, q=q,
-            min_periods=min_periods)
-        f = lambda x: pd.DataFrame(np.asarray(x), index=self.arrays.dates,
+        raw, shrunk = self._specific_panels(half_life, ngroup, q, min_periods)
+        f = lambda x: pd.DataFrame(x, index=self.arrays.dates,
                                    columns=self.arrays.stocks)
         return f(raw), f(shrunk)
 
-    def _shrunk_specific_vol(self, half_life, ngroup, q, min_periods):
-        """Cached (T, N) shrunk specific-vol panel per parameter set."""
+    def _specific_panels(self, half_life, ngroup, q, min_periods):
+        """Cached (raw, shrunk) (T, N) specific-vol panels per parameter
+        set — one EWMA scan + shrinkage shared by :meth:`specific_risk` and
+        :meth:`portfolio_risk`."""
         from mfm_tpu.models.specific import specific_risk_by_time
 
         key = (half_life, ngroup, q, min_periods)
         if key not in self._spec_cache:
-            _, shrunk = specific_risk_by_time(
+            raw, shrunk = specific_risk_by_time(
                 self.outputs.specific_ret, jnp.asarray(self.arrays.cap),
                 half_life=half_life, ngroup=ngroup, q=q,
                 min_periods=min_periods)
-            self._spec_cache[key] = np.asarray(shrunk)
+            self._spec_cache[key] = (np.asarray(raw), np.asarray(shrunk))
         return self._spec_cache[key]
 
     def portfolio_risk(self, weights, t: int = -1, specific_vol=None,
@@ -205,7 +202,12 @@ class RiskPipelineResult:
 
         a = self.arrays
         T = a.ret.shape[0]
-        t = int(t) % T
+        t = int(t)
+        if not -T <= t < T:
+            # no silent modulo wrap: t = T (the classic len(dates)
+            # off-by-one) must not quietly report date-0 risk
+            raise IndexError(f"date index {t} out of range for T={T}")
+        t %= T
         w = np.asarray(weights, np.float64)
         if not np.isfinite(w).all():
             raise ValueError("weights must be finite (reindex fills of NaN "
@@ -224,8 +226,8 @@ class RiskPipelineResult:
         x = X.T @ w
         factor_var = float(x @ F @ x)
         if specific_vol is None:
-            specific_vol = self._shrunk_specific_vol(
-                half_life, ngroup, q, min_periods)[t]
+            specific_vol = self._specific_panels(
+                half_life, ngroup, q, min_periods)[1][t]
         sv = np.asarray(specific_vol, np.float64)
         held = np.abs(w) > 0
         if np.isnan(sv[held]).any():

@@ -158,3 +158,41 @@ def test_portfolio_risk_error_paths():
     rep_b = res.portfolio_risk(w, half_life=84.0, ngroup=5)
     assert rep_a["specific_var"] != rep_b["specific_var"]
     assert len(res._spec_cache) == 2
+
+
+def test_bayes_shrink_mask_tolerates_nan_inputs():
+    """NaN vol/cap on masked-out stocks — the natural input for the mask
+    parameter — must not poison masked-in outputs (0 * NaN in the one-hot
+    matmuls)."""
+    rng = np.random.default_rng(5)
+    N = 80
+    vol = np.abs(rng.normal(0.02, 0.01, N))
+    cap = np.exp(rng.normal(11, 1, N))
+    mask = rng.random(N) > 0.25
+    vol_nan, cap_nan = vol.copy(), cap.copy()
+    vol_nan[~mask] = np.nan
+    cap_nan[~mask] = np.nan
+    got = np.asarray(bayes_shrink(jnp.asarray(vol_nan), jnp.asarray(cap_nan),
+                                  mask=jnp.asarray(mask)))
+    sub = np.asarray(bayes_shrink(jnp.asarray(vol[mask]),
+                                  jnp.asarray(cap[mask])))
+    np.testing.assert_allclose(got[mask], sub, rtol=1e-10)
+    assert np.isnan(got[~mask]).all()
+
+
+def test_portfolio_risk_rejects_out_of_range_date():
+    from mfm_tpu.config import PipelineConfig, RiskModelConfig
+    from mfm_tpu.data.synthetic import synthetic_barra_table
+    from mfm_tpu.pipeline import run_risk_pipeline
+
+    df, _ = synthetic_barra_table(T=60, N=30, P=4, Q=2, seed=6)
+    res = run_risk_pipeline(
+        barra_df=df,
+        config=PipelineConfig(risk=RiskModelConfig(eigen_n_sims=8),
+                              dtype="float64"))
+    valid = np.asarray(res.arrays.valid[-1])
+    w = np.where(valid, 1.0, 0.0)
+    w /= w.sum()
+    for bad_t in (60, 61, -61):  # len(dates) off-by-one and beyond
+        with pytest.raises(IndexError, match="out of range"):
+            res.portfolio_risk(w, t=bad_t)
