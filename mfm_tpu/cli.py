@@ -4,7 +4,10 @@ SURVEY.md §1 L7).
   python -m mfm_tpu.cli risk --barra barra_data.csv --out results/
   python -m mfm_tpu.cli factors --panel panel.parquet --industry ind.csv --out results/
   python -m mfm_tpu.cli demo --out results/          # synthetic end-to-end
+  python -m mfm_tpu.cli pipeline --store data/ --out results/  # store -> risk
+  python -m mfm_tpu.cli alpha --exprs alphas.txt --panel panel.csv
   python -m mfm_tpu.cli crosscheck --ours a.csv --external b.csv
+  python -m mfm_tpu.cli etl-update --store data/ --start 20200101
   python -m mfm_tpu.cli etl-verify --store data/     # verify_data.py path
   python -m mfm_tpu.cli etl-missing --store data/    # fill_missing_data.py path
 """
@@ -74,6 +77,14 @@ def _risk(args):
     }))
 
 
+def _read_long_table(path):
+    """csv/parquet long table with a parsed trade_date column."""
+    import pandas as pd
+
+    return (pd.read_parquet(path) if path.endswith(".parquet")
+            else pd.read_csv(path, parse_dates=["trade_date"]))
+
+
 def _factors(args):
     import numpy as np
     import pandas as pd
@@ -81,10 +92,8 @@ def _factors(args):
     from mfm_tpu.panel import Panel
     from mfm_tpu.pipeline import run_factor_pipeline
 
-    panel_df = (pd.read_parquet(args.panel) if args.panel.endswith(".parquet")
-                else pd.read_csv(args.panel, parse_dates=["trade_date"]))
-    index_df = (pd.read_parquet(args.index) if args.index.endswith(".parquet")
-                else pd.read_csv(args.index, parse_dates=["trade_date"]))
+    panel_df = _read_long_table(args.panel)
+    index_df = _read_long_table(args.index)
     ind_df = pd.read_csv(args.industry)
 
     p = Panel.from_long(panel_df)
@@ -235,6 +244,66 @@ def _pipeline(args):
         "wall_s": round(time.perf_counter() - t0, 3),
         "mean_r2": float(np.nanmean(np.asarray(res.outputs.r2))),
         "out": args.out,
+    }))
+
+
+def _alpha(args):
+    """Batch alpha evaluation + scorecard over a long panel (the BASELINE
+    config-5 workload as a driver): expressions from a text file, one per
+    line, scored against next-traded-day returns."""
+    import numpy as np
+    import pandas as pd
+    import jax.numpy as jnp
+    from mfm_tpu.alpha.dsl import compile_alpha, compile_alpha_batch
+    from mfm_tpu.alpha.metrics import alpha_summary
+    from mfm_tpu.panel import Panel
+    from mfm_tpu.pipeline import shift_ret_next_period
+
+    p = Panel.from_long(_read_long_table(args.panel))
+    fields = {k: jnp.asarray(v, jnp.float32) for k, v in p.fields.items()}
+    if args.fwd_field not in fields:
+        raise SystemExit(f"panel has no field {args.fwd_field!r} "
+                         f"(have: {sorted(fields)})")
+
+    exprs = []
+    with open(args.exprs) as fh:
+        for i, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                # surface syntax/vocabulary errors with a file:line; ast
+                # raises SyntaxError, the validator ValueError
+                e = compile_alpha(line)
+            except (ValueError, SyntaxError) as err:
+                raise SystemExit(f"{args.exprs}:{i}: {err}") from err
+            missing = [f for f in e.fields if f not in fields]
+            if missing:
+                raise SystemExit(
+                    f"{args.exprs}:{i}: panel has no field(s) {missing} "
+                    f"(have: {sorted(fields)})")
+            exprs.append(line)
+    if not exprs:
+        raise SystemExit(f"{args.exprs}: no expressions")
+    observed = np.isfinite(np.asarray(p.fields[args.fwd_field]))
+    fwd = jnp.asarray(shift_ret_next_period(
+        np.asarray(p.fields[args.fwd_field]), observed), jnp.float32)
+
+    t0 = time.perf_counter()
+    batch = compile_alpha_batch(exprs, chunk=args.chunk)
+    values = batch(fields)
+    summary = alpha_summary(values, fwd, spread_q=args.spread_q)
+    score = pd.DataFrame(
+        {k: np.asarray(v) for k, v in summary.items()},
+        index=pd.Index(exprs, name="expression"),
+    )
+    wall = time.perf_counter() - t0
+    score.to_csv(args.out)
+    print(json.dumps({
+        "n_exprs": len(exprs),
+        "dates": int(values.shape[1]), "stocks": int(values.shape[2]),
+        "wall_s": round(wall, 3), "out": args.out,
+        "best_mean_ic": float(np.nanmax(np.asarray(summary["mean_ic"]))),
     }))
 
 
@@ -393,6 +462,21 @@ def main(argv=None):
     pl.add_argument("--block", type=int, default=64,
                     help="rolling-kernel date-block size (16 at all-A scale)")
     pl.set_defaults(fn=_pipeline)
+
+    al = sub.add_parser("alpha",
+                        help="batch alpha-expression evaluation + scorecard "
+                             "(BASELINE config 5)")
+    al.add_argument("--exprs", required=True,
+                    help="text file, one expression per line (# = comment)")
+    al.add_argument("--panel", required=True,
+                    help="long csv/parquet with ts_code/trade_date + fields")
+    al.add_argument("--out", default="alpha_scores.csv")
+    al.add_argument("--fwd-field", default="ret",
+                    help="field whose next-traded-day value is the target")
+    al.add_argument("--spread-q", type=float, default=0.2)
+    al.add_argument("--chunk", type=int, default=1000,
+                    help="expressions per compiled sub-batch")
+    al.set_defaults(fn=_alpha)
 
     c = sub.add_parser("crosscheck",
                        help="compare factor tables vs an external source "

@@ -1,0 +1,83 @@
+"""Hermetic test for the ``alpha`` CLI driver (BASELINE config-5 surface)."""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from mfm_tpu.cli import main as cli_main
+
+
+@pytest.fixture()
+def panel_csv(tmp_path):
+    rng = np.random.default_rng(0)
+    T, N = 60, 10
+    dates = pd.bdate_range("2023-01-02", periods=T)
+    rows = []
+    for j in range(N):
+        close = np.exp(1 + np.cumsum(0.02 * rng.standard_normal(T)))
+        ret = np.concatenate([[np.nan], close[1:] / close[:-1] - 1])
+        vol = np.exp(rng.normal(10, 1, T))
+        for t in range(T):
+            if rng.random() < 0.05:
+                continue  # holes exercise the next-traded-day shift
+            rows.append({"ts_code": f"{600000+j}.SH", "trade_date": dates[t],
+                         "close": close[t], "ret": ret[t], "volume": vol[t]})
+    path = tmp_path / "panel.csv"
+    pd.DataFrame(rows).to_csv(path, index=False)
+    return str(path)
+
+
+def test_alpha_cli_scores_expressions(panel_csv, tmp_path, capsys):
+    exprs = tmp_path / "exprs.txt"
+    exprs.write_text(
+        "# candidate alphas\n"
+        "cs_rank(delta(close, 3))\n"
+        "\n"
+        "-ts_corr(close, volume, 10)\n"
+        "signed_power(cs_winsorize(ret, 2.5), 0.5)\n"
+    )
+    out = str(tmp_path / "scores.csv")
+    cli_main(["alpha", "--exprs", str(exprs), "--panel", panel_csv,
+              "--out", out])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["n_exprs"] == 3
+    assert rec["stocks"] == 10
+
+    score = pd.read_csv(out, index_col="expression")
+    assert len(score) == 3
+    for col in ("mean_ic", "ic_ir", "mean_rank_ic", "coverage",
+                "mean_turnover", "mean_spread"):
+        assert col in score.columns
+    assert (score["coverage"] > 0.5).all()
+
+
+def test_alpha_cli_reports_bad_expression_line(panel_csv, tmp_path):
+    exprs = tmp_path / "exprs.txt"
+    exprs.write_text("cs_rank(close)\n__import__('os')\n")
+    with pytest.raises(SystemExit, match="exprs.txt:2"):
+        cli_main(["alpha", "--exprs", str(exprs), "--panel", panel_csv])
+
+
+def test_alpha_cli_unknown_fwd_field(panel_csv, tmp_path):
+    exprs = tmp_path / "exprs.txt"
+    exprs.write_text("cs_rank(close)\n")
+    with pytest.raises(SystemExit, match="no field"):
+        cli_main(["alpha", "--exprs", str(exprs), "--panel", panel_csv,
+                  "--fwd-field", "nope"])
+
+
+def test_alpha_cli_syntax_error_and_missing_field_diagnostics(panel_csv,
+                                                              tmp_path):
+    # raw Python syntax error still gets the file:line diagnostic
+    exprs = tmp_path / "exprs.txt"
+    exprs.write_text("cs_rank(close)\nclose +\n")
+    with pytest.raises(SystemExit, match="exprs.txt:2"):
+        cli_main(["alpha", "--exprs", str(exprs), "--panel", panel_csv])
+
+    # a typo'd field fails up front with the line number, not a KeyError
+    # from inside jit tracing
+    exprs.write_text("cs_rank(vwap)\n")
+    with pytest.raises(SystemExit, match="exprs.txt:1.*vwap"):
+        cli_main(["alpha", "--exprs", str(exprs), "--panel", panel_csv])
