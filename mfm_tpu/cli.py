@@ -233,6 +233,9 @@ def _pipeline(args):
     res.specific_returns().to_csv(os.path.join(args.out, "specific_returns.csv"))
     res.final_covariance().to_csv(os.path.join(args.out, "final_covariance.csv"))
     res.lambda_series().to_csv(os.path.join(args.out, "lambda.csv"))
+    if args.specific_risk:
+        _, shrunk = res.specific_risk()
+        shrunk.to_csv(os.path.join(args.out, "specific_risk.csv"))
     save_risk_outputs(os.path.join(args.out, "risk_outputs.npz"), res.outputs,
                       meta={"source": args.store})
     print(json.dumps({
@@ -461,6 +464,9 @@ def main(argv=None):
     pl.add_argument("--dtype", default="float32")
     pl.add_argument("--block", type=int, default=64,
                     help="rolling-kernel date-block size (16 at all-A scale)")
+    pl.add_argument("--specific-risk", action="store_true",
+                    help="also write specific_risk.csv (shrunk EWMA "
+                         "specific vol per stock x date)")
     pl.set_defaults(fn=_pipeline)
 
     al = sub.add_parser("alpha",
