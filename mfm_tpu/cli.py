@@ -372,10 +372,33 @@ def _etl_verify(args):
 
 
 def _etl_missing(args):
-    from mfm_tpu.data.etl import PanelStore, find_missing_stocks
+    from mfm_tpu.data.etl import (
+        IncrementalUpdater, PanelStore, RateLimiter, find_missing_stocks,
+    )
 
-    missing = find_missing_stocks(PanelStore(args.store),
-                                  universe_name=args.universe,
+    store = PanelStore(args.store)
+    if args.fix:
+        # detect AND refetch (fill_missing_data.py:16-64).  The refill
+        # fetches daily_basic rows, so it only makes sense for the default
+        # price collection — custom --name/--code-col would insert
+        # wrong-schema rows
+        if args.name != "daily_prices" or args.code_col != "ts_code":
+            raise SystemExit("--fix only repairs the daily_prices "
+                             "collection (it refetches daily_basic rows); "
+                             "drop --name/--code-col")
+        from mfm_tpu.data.tushare_source import TushareSource
+
+        up = IncrementalUpdater(
+            store=store, source=TushareSource(token=args.token),
+            limiter=RateLimiter(args.calls_per_min))
+        rep = up.repair_missing_stocks(
+            args.start, args.end or time.strftime("%Y%m%d"),
+            universe_name=args.universe)
+        print(json.dumps({"n_missing": len(rep["missing"]),
+                          "missing": rep["missing"],
+                          "rows_inserted": rep["rows_inserted"]}))
+        return
+    missing = find_missing_stocks(store, universe_name=args.universe,
                                   data_name=args.name,
                                   code_col=args.code_col)
     print(json.dumps({"n_missing": len(missing), "missing": missing}))
@@ -536,6 +559,13 @@ def main(argv=None):
     em.add_argument("--universe", default="stock_info")
     em.add_argument("--name", default="daily_prices")
     em.add_argument("--code-col", default="ts_code")
+    em.add_argument("--fix", action="store_true",
+                    help="refetch the missing stocks' prices "
+                         "(fill_missing_data.py's repair step)")
+    em.add_argument("--start", default="20200101", help="repair range start")
+    em.add_argument("--end", default=None, help="repair range end (today)")
+    em.add_argument("--calls-per-min", type=int, default=480)
+    em.add_argument("--token", default=None)
     em.set_defaults(fn=_etl_missing)
 
     args = ap.parse_args(argv)

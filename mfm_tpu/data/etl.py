@@ -431,6 +431,27 @@ class IncrementalUpdater:
         self.store.replace_where(name, lambda c: np.ones(len(c), bool), df)
         return len(df)
 
+    def repair_missing_stocks(self, start_date, end_date,
+                              universe_name="stock_info") -> dict:
+        """Detect AND refetch stocks present in the universe but absent from
+        ``daily_prices`` (``fill_missing_data.py:16-64``: set difference,
+        then a per-stock ranged ``daily_basic`` fetch, duplicate-tolerant
+        insert).  The refill is daily-prices-specific by construction (it
+        fetches ``daily_basic`` rows), so collection/key are not
+        parameters — detection over other collections stays with
+        :func:`find_missing_stocks`."""
+        missing = find_missing_stocks(self.store, universe_name=universe_name,
+                                      data_name="daily_prices",
+                                      code_col="ts_code")
+        n = 0
+        for code in missing:
+            df = self._call(self.source.fetch_daily_prices_by_stock,
+                            ts_code=code, start_date=start_date,
+                            end_date=end_date)
+            n += self.store.insert("daily_prices", df,
+                                   unique=("ts_code", "trade_date"))
+        return {"missing": missing, "rows_inserted": n}
+
     def run_all(self, start_date, end_date,
                 index_codes: Sequence[str] = ("000300.SH", "000016.SH",
                                               "000903.SH"),

@@ -72,6 +72,13 @@ class TushareSource:
         return self.pro.daily_basic(trade_date=trade_date,
                                     fields=DAILY_BASIC_FIELDS)
 
+    def fetch_daily_prices_by_stock(self, ts_code, start_date=None,
+                                    end_date=None):
+        # the repair tool's per-stock variant (fill_missing_data.py:58)
+        return self.pro.daily_basic(ts_code=ts_code, start_date=start_date,
+                                    end_date=end_date,
+                                    fields=DAILY_BASIC_FIELDS)
+
     def fetch_trade_calendar(self, start_date, end_date):
         cal = self.pro.trade_cal(exchange="SSE", start_date=start_date,
                                  end_date=end_date, is_open="1")

@@ -199,3 +199,61 @@ def test_index_watermark_is_per_index(truth, tmp_path):
     # and now everything is a no-op
     assert up.update_daily_index_prices([meta["index_code"], "000016.SH"],
                                         end_date=end) == 0
+
+
+def test_repair_missing_stocks_refetches(truth, tmp_path, capsys,
+                                         monkeypatch):
+    """The repair tool must detect AND refill gaps (fill_missing_data.py:
+    16-64): per-stock ranged refetch, duplicate-tolerant insert."""
+    frames, meta = truth
+    daily = frames["daily_prices"]
+    gone = meta["stocks"][0]
+    src = FullFakeSource(dict(frames), list(meta["dates"]))
+
+    # per-stock fetch surface for the repair path
+    def by_stock(ts_code, start_date=None, end_date=None):
+        df = daily[daily["ts_code"] == ts_code]
+        if start_date is not None:
+            df = df[df["trade_date"] >= start_date]
+        if end_date is not None:
+            df = df[df["trade_date"] <= end_date]
+        return df.copy()
+
+    src.fetch_daily_prices_by_stock = by_stock
+
+    store = PanelStore(str(tmp_path / "store"))
+    store.insert("stock_info", frames["stock_info"], unique=("ts_code",))
+    store.insert("daily_prices", daily[daily["ts_code"] != gone],
+                 unique=("ts_code", "trade_date"))
+
+    up = IncrementalUpdater(store=store, source=src, sleep=lambda s: None)
+    rep = up.repair_missing_stocks(meta["dates"][0], meta["dates"][-1])
+    # the outsider stock (not in index, but in stock_info) is also refetched
+    assert gone in rep["missing"]
+    assert rep["rows_inserted"] == sum(
+        len(daily[daily["ts_code"] == c]) for c in rep["missing"])
+    got = store.read("daily_prices")
+    assert set(got["ts_code"]) == set(daily["ts_code"])
+    # idempotent: nothing left to repair
+    rep2 = up.repair_missing_stocks(meta["dates"][0], meta["dates"][-1])
+    assert rep2["missing"] == [] and rep2["rows_inserted"] == 0
+
+    # the CLI --fix path drives the same repair
+    import mfm_tpu.data.tushare_source as ts_mod
+    store2_dir = str(tmp_path / "store2")
+    store2 = PanelStore(store2_dir)
+    store2.insert("stock_info", frames["stock_info"], unique=("ts_code",))
+    store2.insert("daily_prices", daily[daily["ts_code"] != gone],
+                  unique=("ts_code", "trade_date"))
+    monkeypatch.setattr(ts_mod, "TushareSource", lambda token=None: src)
+    cli_main(["etl-missing", "--store", store2_dir, "--fix",
+              "--start", meta["dates"][0], "--end", meta["dates"][-1]])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["rows_inserted"] > 0
+    assert gone in rec["missing"]
+
+
+def test_etl_missing_fix_rejects_custom_collection(tmp_path):
+    with pytest.raises(SystemExit, match="daily_prices"):
+        cli_main(["etl-missing", "--store", str(tmp_path), "--fix",
+                  "--name", "balancesheet", "--start", "20200101"])
