@@ -7,6 +7,7 @@ SURVEY.md §1 L7).
   python -m mfm_tpu.cli pipeline --store data/ --out results/  # store -> risk
   python -m mfm_tpu.cli alpha --exprs alphas.txt --panel panel.csv
   python -m mfm_tpu.cli crosscheck --ours a.csv --external b.csv
+  python -m mfm_tpu.cli report --results results/ --plot report.png
   python -m mfm_tpu.cli etl-update --store data/ --start 20200101
   python -m mfm_tpu.cli etl-verify --store data/     # verify_data.py path
   python -m mfm_tpu.cli etl-missing --store data/    # fill_missing_data.py path
@@ -338,6 +339,29 @@ def _crosscheck(args):
     print(rep.to_json(orient="index"))
 
 
+def _report(args):
+    """Model-health report over a risk-run results directory — the
+    reference's notebook eyeballing (factor paths, R², λ, bias pictures;
+    SURVEY §4) as one driver.  Writes a JSON summary and, with --plot, a
+    small-multiples PNG."""
+    from mfm_tpu.utils.report import (
+        load_results, model_health_summary, plot_model_health,
+    )
+
+    res = load_results(args.results)
+    summary = model_health_summary(args.results, roll_window=args.roll_window,
+                                   res=res)
+    if args.plot:
+        plot_model_health(args.results, os.path.join(args.results, args.plot),
+                          top_k=args.top_k, roll_window=args.roll_window,
+                          res=res)
+        summary["plot"] = os.path.join(args.results, args.plot)
+    if args.json:
+        with open(os.path.join(args.results, args.json), "w") as fh:
+            json.dump(summary, fh, indent=1)
+    print(json.dumps(summary))
+
+
 def _etl_update(args):
     """Calendar-driven refresh of every collection — the reference's
     ``update_mongo_db.py:__main__`` chain (``:579-614``), against the
@@ -518,6 +542,23 @@ def main(argv=None):
     c.add_argument("--code-col", default="ts_code")
     c.add_argument("--out", default=None, help="write report CSV here")
     c.set_defaults(fn=_crosscheck)
+
+    rp = sub.add_parser("report",
+                        help="model-health summary + plots over a risk-run "
+                             "results dir (the notebooks' QC eyeballing, "
+                             "as a driver)")
+    rp.add_argument("--results", required=True,
+                    help="directory a risk/pipeline run wrote its tables to")
+    rp.add_argument("--plot", default=None, metavar="FILE.png",
+                    help="render the 2x2 health plot into RESULTS "
+                         "(needs matplotlib)")
+    rp.add_argument("--json", default=None, metavar="FILE.json",
+                    help="also write the summary JSON into RESULTS")
+    rp.add_argument("--top-k", type=int, default=6,
+                    help="factors direct-labelled in the cumulative panel")
+    rp.add_argument("--roll-window", type=int, default=63,
+                    help="rolling window (days) for the R² mean")
+    rp.set_defaults(fn=_report)
 
     eu = sub.add_parser("etl-update",
                         help="calendar-driven refresh of all collections "

@@ -1,0 +1,248 @@
+"""Model-health report over a risk results directory.
+
+The reference's quality control is notebook eyeballing: factor time-series
+plots (``beta.ipynb`` cell 17, ``data_pre.ipynb`` cell 9), the R² saved per
+date (``demo.py:70-72``), the λ multiplier series (``demo.py:90-94``), and
+the eigenfactor bias picture (``mfm/utils.py:116``).  This module turns that
+into a first-class driver: one JSON health summary plus one small-multiples
+PNG, computed from the result tables the ``risk``/``pipeline`` subcommands
+write (``factor_returns.csv``, ``r_squared.csv``, ``lambda.csv``, and — when
+present — ``specific_returns.csv`` and ``bias_stats.json``).
+
+Everything here is host-side pandas over small result tables; no JAX.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+
+# fixed-order categorical palette, assigned over the selected factors in the
+# result table's own column order (deterministic for a given results set);
+# factors beyond the palette fold into gray
+_PALETTE = ["#3b6ccc", "#e2862d", "#2e9e77", "#c4534f", "#8b67c9", "#937264"]
+_FOLD_COLOR = "#b8bcc4"
+_ACCENT = "#3b6ccc"
+_GRID = {"color": "#e4e6ea", "lw": 0.6}
+
+
+def _read_series_table(results_dir: str, name: str) -> pd.DataFrame | None:
+    path = os.path.join(results_dir, name)
+    if not os.path.exists(path):
+        return None
+    df = pd.read_csv(path, index_col=0)
+    df.index = pd.to_datetime(df.index.astype(str))
+    return df
+
+
+def load_results(results_dir: str) -> dict:
+    """Read whatever result tables exist under ``results_dir``.
+
+    Returns a dict with ``factor_returns`` / ``r_squared`` / ``lambda`` /
+    ``specific_returns`` DataFrames (absent keys omitted) and ``bias_stats``
+    (the parsed ``bias_stats.json``) when present.  ``factor_returns`` is
+    required — a results dir without it is not a risk-run output.
+    """
+    out = {}
+    for key, fname in (("factor_returns", "factor_returns.csv"),
+                       ("r_squared", "r_squared.csv"),
+                       ("lambda", "lambda.csv"),
+                       ("specific_returns", "specific_returns.csv")):
+        df = _read_series_table(results_dir, fname)
+        if df is not None:
+            out[key] = df
+    if "factor_returns" not in out:
+        raise FileNotFoundError(
+            f"{results_dir}/factor_returns.csv not found — run the `risk` or "
+            "`pipeline` subcommand into this directory first")
+    bias_path = os.path.join(results_dir, "bias_stats.json")
+    if os.path.exists(bias_path):
+        with open(bias_path) as fh:
+            out["bias_stats"] = json.load(fh)
+    return out
+
+
+def _num(x):
+    x = float(x)
+    return None if not np.isfinite(x) else round(x, 6)
+
+
+def _bias_scope(bias_stats: dict) -> tuple[str | None, dict]:
+    """Pick the scope to report from a ``bias_stats_summary`` dict: the
+    burn-in-excluded one when present (keys are ``after_burn_in_{n}``,
+    :func:`mfm_tpu.models.bias.bias_stats_summary`), else all valid dates."""
+    for key in bias_stats:
+        if key.startswith("after_burn_in"):
+            return key, bias_stats[key]
+    if "all_valid_dates" in bias_stats:
+        return "all_valid_dates", bias_stats["all_valid_dates"]
+    return None, {}
+
+
+def model_health_summary(results_dir: str, ann_factor: int = 252,
+                         roll_window: int = 63, res: dict | None = None) -> dict:
+    """The three model-health metrics the reference tracks (R² per date,
+    bias statistics, λ series; SURVEY §5 observability) plus per-factor
+    return/vol attribution, as one JSON-able dict.  ``res``: an already-
+    loaded :func:`load_results` dict, to avoid re-reading the tables."""
+    res = load_results(results_dir) if res is None else res
+    fr = res["factor_returns"]
+    valid = fr.dropna(how="all")
+    summary: dict = {
+        "results_dir": os.path.abspath(results_dir),
+        "dates": {"first": str(valid.index[0].date()),
+                  "last": str(valid.index[-1].date()),
+                  "count": int(len(valid))},
+    }
+
+    cum = valid.fillna(0.0).cumsum()
+    vol = valid.std(ddof=1) * np.sqrt(ann_factor)
+    per_factor = pd.DataFrame({
+        "cum_return": cum.iloc[-1],
+        "ann_vol": vol,
+    }).sort_values("cum_return", ascending=False)
+    summary["factors"] = {
+        name: {"cum_return": _num(row.cum_return), "ann_vol": _num(row.ann_vol)}
+        for name, row in per_factor.iterrows()
+    }
+
+    if "r_squared" in res:
+        r2 = res["r_squared"].iloc[:, 0].dropna()
+        recent = r2.tail(roll_window)
+        summary["r2"] = {
+            "mean": _num(r2.mean()), "median": _num(r2.median()),
+            "p10": _num(r2.quantile(0.10)), "p90": _num(r2.quantile(0.90)),
+            f"last_{roll_window}d_mean": _num(recent.mean()),
+        }
+    if "lambda" in res:
+        lam = res["lambda"].iloc[:, 0].dropna()
+        summary["lambda"] = {
+            "last": _num(lam.iloc[-1]) if len(lam) else None,
+            "mean": _num(lam.mean()), "min": _num(lam.min()),
+            "max": _num(lam.max()),
+        }
+    if "specific_returns" in res:
+        disp = res["specific_returns"].std(axis=1, ddof=1).dropna()
+        summary["specific_dispersion"] = {
+            "mean_xsec_std": _num(disp.mean()),
+            "last": _num(disp.iloc[-1]) if len(disp) else None,
+        }
+    if "bias_stats" in res:
+        scope_name, scope = _bias_scope(res["bias_stats"])
+        summary["bias"] = {
+            label: {"mean_abs_dev_from_1": d.get("mean_abs_dev_from_1")}
+            for label, d in scope.items() if isinstance(d, dict)
+        }
+        summary["bias"]["scope"] = scope_name
+    return summary
+
+
+def _style(ax, title):
+    ax.set_title(title, fontsize=9, loc="left")
+    ax.grid(True, **_GRID)
+    ax.set_axisbelow(True)
+    for s in ("top", "right"):
+        ax.spines[s].set_visible(False)
+    ax.tick_params(labelsize=7)
+
+
+def plot_model_health(results_dir: str, path: str, top_k: int = 6,
+                      roll_window: int = 63, res: dict | None = None) -> None:
+    """Render the health report as a 2×2 small-multiples PNG.
+
+    Panels: cumulative factor returns (top ``top_k`` by |cum return|,
+    direct-labelled; the rest folded as thin gray), the R² series with its
+    rolling mean, the λ multiplier series, and the bias statistic per
+    eigenfactor rank when ``bias_stats.json`` exists (per-factor annualized
+    vol bars otherwise).  Uses an explicit Agg canvas so the process-global
+    matplotlib backend is untouched (same idiom as
+    :func:`mfm_tpu.models.bias.plot_bias_stats`).
+    """
+    from matplotlib.backends.backend_agg import FigureCanvasAgg
+    from matplotlib.figure import Figure
+
+    res = load_results(results_dir) if res is None else res
+    fr = res["factor_returns"].dropna(how="all")
+    cum = fr.fillna(0.0).cumsum()
+
+    fig = Figure(figsize=(11, 7))
+    FigureCanvasAgg(fig)
+    axes = fig.subplots(2, 2)
+
+    # (a) cumulative factor returns — identity in fixed palette order over
+    # the selected factors, the rest folded into gray ("Other")
+    ax = axes[0][0]
+    order = cum.iloc[-1].abs().sort_values(ascending=False).index
+    # selected factors keep the table's own column order so the palette
+    # assignment is deterministic for a results set, not a rank artifact
+    top = [c for c in cum.columns if c in set(order[:max(top_k, 0)])]
+    for col in cum.columns:
+        if col not in top:
+            ax.plot(cum.index, cum[col], color=_FOLD_COLOR, lw=0.7, zorder=1)
+    span = (float(cum[top].to_numpy().max() - cum[top].to_numpy().min()) or 1.0
+            if top else 1.0)
+    labelled_ys: list[float] = []
+    for i, col in enumerate(top):
+        c = _PALETTE[i % len(_PALETTE)]
+        ax.plot(cum.index, cum[col], color=c, lw=1.6, zorder=2, label=col)
+        y = float(cum[col].iloc[-1])
+        # direct labels are selective: skip any that would collide with an
+        # already-placed one (the legend still carries identity)
+        if all(abs(y - y0) > 0.04 * span for y0 in labelled_ys):
+            ax.annotate(f" {col}", (cum.index[-1], y), fontsize=7, color=c,
+                        va="center")
+            labelled_ys.append(y)
+    if len(cum.columns) > len(top):
+        ax.plot([], [], color=_FOLD_COLOR, lw=0.7,
+                label=f"other ({len(cum.columns) - len(top)})")
+    ax.legend(fontsize=6, loc="upper left", frameon=False)
+    _style(ax, f"cumulative factor returns (top {len(top)} by |cum|)")
+
+    # (b) R² per date + rolling mean
+    ax = axes[0][1]
+    if "r_squared" in res:
+        r2 = res["r_squared"].iloc[:, 0]
+        ax.plot(r2.index, r2, color=_FOLD_COLOR, lw=0.6)
+        roll = r2.rolling(roll_window, min_periods=roll_window // 3).mean()
+        ax.plot(roll.index, roll, color=_ACCENT, lw=1.6,
+                label=f"{roll_window}d mean")
+        ax.legend(fontsize=6, loc="upper left", frameon=False)
+        ax.set_ylim(0, 1)
+    _style(ax, "cross-sectional regression R²")
+
+    # (c) λ multiplier series
+    ax = axes[1][0]
+    if "lambda" in res:
+        lam = res["lambda"].iloc[:, 0]
+        ax.plot(lam.index, lam, color=_ACCENT, lw=1.2)
+        ax.axhline(1.0, color="#888", lw=0.8, ls="--")
+    _style(ax, "vol-regime multiplier λ")
+
+    # (d) bias per eigen rank when available, else annualized factor vols
+    ax = axes[1][1]
+    if "bias_stats" in res:
+        scope_name, scope = _bias_scope(res["bias_stats"])
+        for i, (label, d) in enumerate(sorted(scope.items())):
+            if not isinstance(d, dict) or "bias" not in d:
+                continue
+            b = np.array([np.nan if v is None else v for v in d["bias"]])
+            ax.plot(1 + np.arange(b.shape[0]), b, marker="o", ms=2.5, lw=1,
+                    color=_PALETTE[i % len(_PALETTE)], label=label)
+        ax.axhline(1.0, color="#888", lw=0.8, ls="--")
+        from matplotlib.ticker import MaxNLocator
+        ax.xaxis.set_major_locator(MaxNLocator(integer=True))
+        ax.set_xlabel("eigenfactor rank", fontsize=7)
+        ax.legend(fontsize=6, frameon=False)
+        _style(ax, f"eigenfactor bias statistic by rank ({scope_name})")
+    else:
+        vol = (fr.std(ddof=1) * np.sqrt(252)).sort_values(ascending=False)[:10]
+        ax.barh(np.arange(len(vol))[::-1], vol.to_numpy(), height=0.62,
+                color=_ACCENT)
+        ax.set_yticks(np.arange(len(vol))[::-1], vol.index, fontsize=6)
+        _style(ax, "annualized factor vol (top 10)")
+
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)

@@ -1,0 +1,123 @@
+"""Model-health report (mfm_tpu/utils/report.py): summary math against
+hand-computed values on small result tables, plot rendering, and the CLI
+driver — the framework's version of the reference's notebook QC eyeballing
+(SURVEY §4: factor paths, R², λ, bias pictures)."""
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+def _write_results(tmp_path, with_bias=False, with_specific=False):
+    rng = np.random.default_rng(0)
+    dates = pd.bdate_range("2024-01-02", periods=120)
+    factors = ["country", "size", "beta", "momentum", "growth",
+               "leverage", "liquidity", "ind_a", "ind_b"]
+    fr = pd.DataFrame(0.01 * rng.standard_normal((120, len(factors))),
+                      index=dates, columns=factors)
+    fr.iloc[0] = np.nan  # a leading all-NaN date (pre-burn-in), must drop
+    fr.to_csv(tmp_path / "factor_returns.csv")
+    r2 = pd.DataFrame({"R2": np.clip(rng.normal(0.3, 0.1, 120), 0, 1)},
+                      index=dates)
+    r2.to_csv(tmp_path / "r_squared.csv")
+    lam = pd.DataFrame({"lambda": 1 + 0.1 * rng.standard_normal(120)},
+                       index=dates)
+    lam.to_csv(tmp_path / "lambda.csv")
+    if with_specific:
+        sp = pd.DataFrame(0.02 * rng.standard_normal((120, 5)), index=dates,
+                          columns=[f"s{i}" for i in range(5)])
+        sp.to_csv(tmp_path / "specific_returns.csv")
+    if with_bias:
+        # both scopes, as mfm_tpu.models.bias.bias_stats_summary writes them
+        # (keys "all_valid_dates" and "after_burn_in_{n}"): the report must
+        # prefer the burn-in-excluded one
+        bias = {
+            "all_valid_dates": {
+                "newey_west": {"bias": [34.5, 1.2, 1.1, None],
+                               "mean_abs_dev_from_1": 11.266},
+                "eigen_adjusted": {"bias": [20.1, 1.0, 0.98, None],
+                                   "mean_abs_dev_from_1": 6.373},
+            },
+            "after_burn_in_252": {
+                "newey_west": {"bias": [1.4, 1.2, 1.1, None],
+                               "mean_abs_dev_from_1": 0.2333},
+                "eigen_adjusted": {"bias": [1.05, 1.0, 0.98, None],
+                                   "mean_abs_dev_from_1": 0.0233},
+            },
+        }
+        (tmp_path / "bias_stats.json").write_text(json.dumps(bias))
+    return fr, r2, lam
+
+
+def test_summary_matches_hand_computed(tmp_path):
+    from mfm_tpu.utils.report import model_health_summary
+
+    fr, r2, lam = _write_results(tmp_path)
+    s = model_health_summary(str(tmp_path))
+
+    valid = fr.dropna(how="all")
+    assert s["dates"]["count"] == len(valid) == 119
+    assert s["dates"]["first"] == str(valid.index[0].date())
+    # per-factor cum return & annualized vol
+    np.testing.assert_allclose(
+        s["factors"]["size"]["cum_return"],
+        valid["size"].fillna(0).cumsum().iloc[-1], rtol=1e-5)
+    np.testing.assert_allclose(
+        s["factors"]["beta"]["ann_vol"],
+        valid["beta"].std(ddof=1) * np.sqrt(252), rtol=1e-5)
+    np.testing.assert_allclose(s["r2"]["mean"], r2["R2"].mean(), atol=1e-5)
+    np.testing.assert_allclose(s["lambda"]["last"], lam["lambda"].iloc[-1],
+                               atol=1e-5)
+    assert "bias" not in s and "specific_dispersion" not in s
+
+
+def test_summary_optional_sections(tmp_path):
+    from mfm_tpu.utils.report import model_health_summary
+
+    _write_results(tmp_path, with_bias=True, with_specific=True)
+    s = model_health_summary(str(tmp_path))
+    # burn-in-excluded scope preferred over all_valid_dates
+    assert s["bias"]["scope"] == "after_burn_in_252"
+    assert s["bias"]["eigen_adjusted"]["mean_abs_dev_from_1"] == 0.0233
+    sp = pd.read_csv(tmp_path / "specific_returns.csv", index_col=0)
+    np.testing.assert_allclose(s["specific_dispersion"]["mean_xsec_std"],
+                               sp.std(axis=1, ddof=1).mean(), atol=1e-5)
+
+
+def test_missing_factor_returns_raises(tmp_path):
+    from mfm_tpu.utils.report import model_health_summary
+
+    with pytest.raises(FileNotFoundError):
+        model_health_summary(str(tmp_path))
+
+
+def test_plot_writes_png_both_variants(tmp_path):
+    from mfm_tpu.utils.report import plot_model_health
+
+    _write_results(tmp_path, with_bias=True)
+    p1 = str(tmp_path / "health_bias.png")
+    plot_model_health(str(tmp_path), p1)
+    assert os.path.getsize(p1) > 5000
+    os.remove(tmp_path / "bias_stats.json")  # vol-bars fallback panel
+    p2 = str(tmp_path / "health_vols.png")
+    plot_model_health(str(tmp_path), p2)
+    assert os.path.getsize(p2) > 5000
+    p3 = str(tmp_path / "health_k0.png")  # --top-k 0: everything folds gray
+    plot_model_health(str(tmp_path), p3, top_k=0)
+    assert os.path.getsize(p3) > 5000
+
+
+def test_report_cli(tmp_path, capsys):
+    from mfm_tpu.cli import main
+
+    _write_results(tmp_path, with_bias=True)
+    main(["report", "--results", str(tmp_path), "--plot", "health.png",
+          "--json", "health.json"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["dates"]["count"] == 119
+    assert os.path.getsize(tmp_path / "health.png") > 5000
+    on_disk = json.loads((tmp_path / "health.json").read_text())
+    assert on_disk["r2"]["mean"] == out["r2"]["mean"]
