@@ -20,6 +20,12 @@ from mfm_tpu.alpha.metrics import (
     rank_ic,
     rank_turnover,
 )
+from mfm_tpu.alpha.select import (
+    greedy_select,
+    select_alphas,
+    series_correlation_matrix,
+    signal_series,
+)
 
 __all__ = [
     "AlphaExpr",
@@ -31,4 +37,8 @@ __all__ = [
     "rank_turnover",
     "quantile_spread",
     "alpha_summary",
+    "signal_series",
+    "series_correlation_matrix",
+    "greedy_select",
+    "select_alphas",
 ]

@@ -301,14 +301,41 @@ def _alpha(args):
         {k: np.asarray(v) for k, v in summary.items()},
         index=pd.Index(exprs, name="expression"),
     )
-    wall = time.perf_counter() - t0
-    score.to_csv(args.out)
-    print(json.dumps({
+    report: dict = {
         "n_exprs": len(exprs),
         "dates": int(values.shape[1]), "stocks": int(values.shape[2]),
+    }
+    if args.select is not None:
+        # greedy top-k under the PnL-correlation cap (alpha/select.py) —
+        # ranked by |mean IC| (reusing the scorecard's own, not recomputing
+        # the (E,T,N) IC); the scorecard gains selection columns and the
+        # chosen expressions land in --select-out, one per line
+        from mfm_tpu.alpha.select import select_alphas
+
+        sel = select_alphas(values, fwd, args.select,
+                            max_corr=args.max_corr, q=args.spread_q,
+                            scores=np.abs(np.asarray(summary["mean_ic"])))
+        score["selected"] = False
+        score["select_rank"] = -1
+        score["select_max_corr"] = np.nan
+        for rank, (i, c) in enumerate(
+                zip(sel["indices"], sel["max_corr_to_selected"])):
+            score.iloc[i, score.columns.get_loc("selected")] = True
+            score.iloc[i, score.columns.get_loc("select_rank")] = rank
+            score.iloc[i, score.columns.get_loc("select_max_corr")] = c
+        if args.select_out:
+            with open(args.select_out, "w") as fh:
+                fh.writelines(exprs[i] + "\n" for i in sel["indices"])
+            report["select_out"] = args.select_out
+        report["n_selected"] = len(sel["indices"])
+        report["n_rejected_by_corr"] = len(sel["rejected"])
+    wall = time.perf_counter() - t0
+    score.to_csv(args.out)
+    report.update({
         "wall_s": round(wall, 3), "out": args.out,
         "best_mean_ic": float(np.nanmax(np.asarray(summary["mean_ic"]))),
-    }))
+    })
+    print(json.dumps(report))
 
 
 def _crosscheck(args):
@@ -529,6 +556,20 @@ def main(argv=None):
     al.add_argument("--spread-q", type=float, default=0.2)
     al.add_argument("--chunk", type=int, default=1000,
                     help="expressions per compiled sub-batch")
+    def _positive_int(v):
+        iv = int(v)
+        if iv < 1:
+            raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
+        return iv
+
+    al.add_argument("--select", type=_positive_int, default=None, metavar="K",
+                    help="greedily pick the K best expressions (by |mean "
+                         "IC|) whose pairwise long-short-PnL correlation "
+                         "stays under --max-corr")
+    al.add_argument("--max-corr", type=float, default=0.7,
+                    help="redundancy cap for --select")
+    al.add_argument("--select-out", default=None, metavar="FILE.txt",
+                    help="write the selected expressions here, one per line")
     al.set_defaults(fn=_alpha)
 
     c = sub.add_parser("crosscheck",
@@ -610,6 +651,8 @@ def main(argv=None):
     em.set_defaults(fn=_etl_missing)
 
     args = ap.parse_args(argv)
+    if getattr(args, "select_out", None) and args.select is None:
+        ap.error("--select-out requires --select")
     if args.platform:
         import jax
 

@@ -1,0 +1,126 @@
+"""Alpha selection (mfm_tpu/alpha/select.py): pairwise-valid correlation
+matrix parity vs pandas, greedy cap semantics, end-to-end selection on a
+batch containing a near-duplicate, and the CLI driver."""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+def test_series_correlation_matches_pandas_pairwise():
+    from mfm_tpu.alpha.select import series_correlation_matrix
+
+    rng = np.random.default_rng(0)
+    E, T = 7, 60
+    s = rng.standard_normal((E, T))
+    s[rng.random((E, T)) < 0.25] = np.nan  # ragged validity per pair
+    s[5, :58] = np.nan  # only 2 dates valid -> below min_periods vs most
+
+    got = np.asarray(series_correlation_matrix(np.asarray(s, np.float32),
+                                               min_periods=3))
+    want = pd.DataFrame(s.T).corr(min_periods=3).to_numpy()
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_greedy_select_cap_and_order():
+    from mfm_tpu.alpha.select import greedy_select
+
+    scores = np.array([0.5, 0.4, 0.3, np.nan, 0.2])
+    corr = np.eye(5)
+    corr[0, 1] = corr[1, 0] = 0.9   # 1 is redundant with 0
+    corr[0, 2] = corr[2, 0] = 0.1
+    corr[2, 4] = corr[4, 2] = np.nan  # undefined must not block
+
+    out = greedy_select(scores, corr, k=3, max_corr=0.7)
+    assert out["indices"] == [0, 2, 4]
+    assert out["rejected"] == {1: 0}
+    assert out["scores"] == [0.5, 0.3, 0.2]
+    assert np.isnan(out["max_corr_to_selected"][0])  # first pick: no peers
+    assert out["max_corr_to_selected"][1] == pytest.approx(0.1)
+
+    # min_score fences out weak candidates even under k
+    out = greedy_select(scores, corr, k=5, max_corr=0.7, min_score=0.25)
+    assert out["indices"] == [0, 2]
+
+
+def test_select_alphas_drops_near_duplicate():
+    from mfm_tpu.alpha.select import select_alphas
+
+    rng = np.random.default_rng(1)
+    T, N = 120, 40
+    fwd = 0.02 * rng.standard_normal((T, N))
+    base = fwd + 0.05 * rng.standard_normal((T, N))   # informative
+    dup = base + 1e-3 * rng.standard_normal((T, N))   # its clone
+    indep = 0.05 * rng.standard_normal((T, N))        # uncorrelated noise
+    alphas = np.stack([base, dup, indep]).astype(np.float32)
+
+    out = select_alphas(alphas, np.asarray(fwd, np.float32), k=2,
+                        max_corr=0.7)
+    # the clones' PnL corr is ~1, so exactly one of {base, dup} survives
+    # (scores are near-ties — either may win) alongside the independent one
+    assert len(out["indices"]) == 2 and 2 in out["indices"]
+    assert len(set(out["indices"]) & {0, 1}) == 1
+    [(loser, winner)] = out["rejected"].items()
+    assert {loser, winner} == {0, 1}
+    assert abs(out["corr"][0, 1]) > 0.95
+
+
+def test_alpha_cli_select(tmp_path, capsys):
+    from mfm_tpu.cli import main
+
+    rng = np.random.default_rng(2)
+    T, N = 80, 25
+    dates = pd.bdate_range("2024-01-02", periods=T)
+    stocks = [f"s{i:03d}" for i in range(N)]
+    close = np.exp(np.cumsum(0.02 * rng.standard_normal((T, N)), axis=0))
+    long = pd.DataFrame({
+        "trade_date": np.repeat(dates, N),
+        "ts_code": np.tile(stocks, T),
+        "close": close.ravel(),
+        "ret": np.vstack([np.full((1, N), np.nan),
+                          close[1:] / close[:-1] - 1]).ravel(),
+    })
+    panel = str(tmp_path / "panel.csv")
+    long.to_csv(panel, index=False)
+    exprs = str(tmp_path / "exprs.txt")
+    # expr 2 is expr 1 scaled (PnL corr 1.0) -> must be rejected
+    (tmp_path / "exprs.txt").write_text(
+        "cs_rank(delta(close, 3))\n"
+        "2.0 * cs_rank(delta(close, 3))\n"
+        "-ts_mean(ret, 5)\n")
+    sel_out = str(tmp_path / "selected.txt")
+    main(["--platform", "cpu", "alpha", "--exprs", exprs, "--panel", panel,
+          "--out", str(tmp_path / "scores.csv"),
+          "--select", "2", "--select-out", sel_out])
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["n_selected"] == 2
+    assert rep["n_rejected_by_corr"] == 1
+    picked = (tmp_path / "selected.txt").read_text().splitlines()
+    assert len(picked) == 2
+    # exactly one of the two clones survives
+    clones = {"cs_rank(delta(close, 3))", "2.0 * cs_rank(delta(close, 3))"}
+    assert len(clones & set(picked)) == 1
+    score = pd.read_csv(tmp_path / "scores.csv", index_col=0)
+    assert int(score["selected"].sum()) == 2
+    assert set(score.columns) >= {"selected", "select_rank",
+                                  "select_max_corr"}
+    # the second pick records its realized corr to the first
+    second = score[score["select_rank"] == 1]
+    assert np.isfinite(second["select_max_corr"]).all()
+
+
+def test_alpha_cli_select_flag_validation(tmp_path, capsys):
+    from mfm_tpu.cli import main
+
+    # --select 0 / negative must be rejected at parse time, and
+    # --select-out without --select must error rather than silently no-op
+    with pytest.raises(SystemExit):
+        main(["alpha", "--exprs", "x", "--panel", "y", "--select", "0"])
+    with pytest.raises(SystemExit):
+        main(["alpha", "--exprs", "x", "--panel", "y", "--select", "-3"])
+    with pytest.raises(SystemExit):
+        main(["alpha", "--exprs", "x", "--panel", "y",
+              "--select-out", "sel.txt"])
+    capsys.readouterr()
