@@ -417,7 +417,25 @@ def _etl_update(args):
 def _etl_verify(args):
     from mfm_tpu.data.etl import PanelStore, verify_store
 
-    print(json.dumps(verify_store(PanelStore(args.store), name=args.name,
+    store = PanelStore(args.store)
+    if args.diagnose:
+        # per-stock statement QC (the reference's notebook bisection hunt
+        # for bad merge groups, try_1017.ipynb cells 9-12, vectorized)
+        from mfm_tpu.data.pit import diagnose_statements
+
+        try:
+            rep = diagnose_statements(store.read(args.name),
+                                      ann_col=args.ann_col,
+                                      end_col=args.end_col)
+        except ValueError as err:
+            # wrong-schema / empty / typo'd collection: a clean error, not a
+            # KeyError traceback (--name defaults to daily_prices, which has
+            # no announcement columns)
+            raise SystemExit(f"--diagnose {args.name}: {err}") from err
+        rep["collection"] = args.name
+        print(json.dumps(rep))
+        return
+    print(json.dumps(verify_store(store, name=args.name,
                                   code_col=args.code_col,
                                   date_col=args.date_col)))
 
@@ -632,6 +650,12 @@ def main(argv=None):
     ev.add_argument("--name", default="daily_prices")
     ev.add_argument("--code-col", default="ts_code")
     ev.add_argument("--date-col", default="trade_date")
+    ev.add_argument("--diagnose", action="store_true",
+                    help="per-stock statement QC on --name (missing/dup "
+                         "announcement keys, ann-before-period-end) — the "
+                         "notebooks' bad-group bisection, vectorized")
+    ev.add_argument("--ann-col", default="f_ann_date")
+    ev.add_argument("--end-col", default="end_date")
     ev.set_defaults(fn=_etl_verify)
 
     em = sub.add_parser("etl-missing",

@@ -85,6 +85,60 @@ def asof_join(left, right, *, left_on: str, right_on: str, by: str = "ts_code",
     return out
 
 
+def diagnose_statements(df, by: str = "ts_code", ann_col: str = "f_ann_date",
+                        end_col: str = "end_date") -> dict:
+    """Per-stock statement-table QC — the reference's bad-group hunt.
+
+    The reference debugged broken ``merge_asof`` groups by bisecting the
+    stock list in a notebook until the offending frames surfaced
+    (``try_1017.ipynb`` cells 9-12: null/dtype checks, monotonic-sort
+    assertions, per-stock isolation).  This does the whole hunt in one
+    vectorized pass and names the offenders directly.  Issues per stock:
+
+    - ``missing_ann`` / ``missing_end`` — NaT/NaN key dates (these rows
+      silently vanish from a PIT join's keying);
+    - ``dup_ann`` / ``dup_end`` — duplicate (stock, announcement) or
+      (stock, period-end) keys surviving in the input, counting EVERY row
+      in a duplicate group (each group of g rows contributes g, of which
+      :func:`dedup_statements` would keep one);
+    - ``ann_before_end`` — announcement dated before its own period end
+      (a statement cannot be public before the period closes; almost
+      always a data-entry error that shifts the PIT availability early).
+
+    Returns ``{"n_rows", "n_stocks", "issue_counts": {issue: row count},
+    "stocks": {ts_code: [issues]}}`` — clean input gives empty dicts.
+    """
+    if pd is None:  # pragma: no cover
+        raise ImportError("pandas required")
+    missing_cols = [c for c in (by, ann_col, end_col) if c not in df.columns]
+    if missing_cols:
+        raise ValueError(
+            f"not a statement table: missing column(s) {missing_cols} "
+            f"(have: {sorted(df.columns)})")
+    ann = pd.to_datetime(df[ann_col], errors="coerce")
+    end = pd.to_datetime(df[end_col], errors="coerce")
+    flags = {
+        "missing_ann": ann.isna(),
+        "missing_end": end.isna(),
+        "dup_ann": df.duplicated([by, ann_col], keep=False) & ann.notna(),
+        "dup_end": df.duplicated([by, end_col], keep=False) & end.notna(),
+        "ann_before_end": ann.notna() & end.notna() & (ann < end),
+    }
+    stocks: dict[str, list[str]] = {}
+    counts: dict[str, int] = {}
+    for issue, mask in flags.items():
+        n = int(mask.sum())
+        if not n:
+            continue
+        counts[issue] = n
+        for code in df.loc[mask, by].unique():
+            stocks.setdefault(code, []).append(issue)
+    return {"n_rows": int(len(df)),
+            "n_stocks": int(df[by].nunique()),
+            "issue_counts": counts,
+            "stocks": {k: stocks[k] for k in sorted(stocks)}}
+
+
 def fill_missing(df, cols: Sequence[str], by: str = "ts_code",
                  date_col: str = "trade_date", median_fill: bool = False):
     """Missing-value policy over the merged master frame

@@ -77,3 +77,87 @@ def test_fill_missing_ffill_then_zero():
     np.testing.assert_array_equal(
         out["x"].to_numpy(), [0.0, 1.0, 1.0, 2.0, 0.0, 0.0, 3.0, 3.0]
     )
+
+
+def test_diagnose_statements_clean_and_dirty():
+    from mfm_tpu.data.pit import diagnose_statements
+
+    clean = pd.DataFrame({
+        "ts_code": ["a", "a", "b"],
+        "f_ann_date": pd.to_datetime(["2024-04-25", "2024-08-20",
+                                      "2024-04-28"]),
+        "end_date": pd.to_datetime(["2024-03-31", "2024-06-30",
+                                    "2024-03-31"]),
+    })
+    rep = diagnose_statements(clean)
+    assert rep["issue_counts"] == {} and rep["stocks"] == {}
+    assert rep["n_rows"] == 3 and rep["n_stocks"] == 2
+
+    dirty = pd.DataFrame({
+        "ts_code": ["a", "a", "b", "c", "d", "d"],
+        "f_ann_date": pd.to_datetime([
+            "2024-04-25", "2024-04-25",   # a: duplicate announcement key
+            None,                         # b: missing announcement
+            "2024-03-01",                 # c: announced before period end
+            "2024-04-25", "2024-08-20",   # d: clean
+        ]),
+        "end_date": pd.to_datetime([
+            "2024-03-31", "2023-12-31",
+            "2024-03-31",
+            "2024-03-31",
+            "2024-03-31", "2024-06-30",
+        ]),
+    })
+    rep = diagnose_statements(dirty)
+    assert rep["issue_counts"] == {"missing_ann": 1, "dup_ann": 2,
+                                   "ann_before_end": 1}
+    assert rep["stocks"] == {"a": ["dup_ann"], "b": ["missing_ann"],
+                             "c": ["ann_before_end"]}
+
+
+def test_diagnose_flags_duplicate_period_end():
+    from mfm_tpu.data.pit import diagnose_statements
+
+    df = pd.DataFrame({
+        "ts_code": ["a", "a"],
+        "f_ann_date": pd.to_datetime(["2024-04-25", "2024-04-26"]),
+        "end_date": pd.to_datetime(["2024-03-31", "2024-03-31"]),
+    })
+    rep = diagnose_statements(df)
+    # every row of the duplicate group is counted (dedup would keep one)
+    assert rep["issue_counts"] == {"dup_end": 2}
+    assert rep["stocks"] == {"a": ["dup_end"]}
+
+
+def test_diagnose_rejects_non_statement_table():
+    import pytest
+
+    from mfm_tpu.data.pit import diagnose_statements
+
+    prices = pd.DataFrame({"ts_code": ["a"], "trade_date": ["20240102"],
+                           "close": [1.0]})
+    with pytest.raises(ValueError, match="f_ann_date"):
+        diagnose_statements(prices)
+    with pytest.raises(ValueError, match="missing column"):
+        diagnose_statements(pd.DataFrame())  # empty/typo'd collection
+
+
+def test_etl_verify_diagnose_cli(tmp_path, capsys):
+    import json
+
+    from mfm_tpu.cli import main
+    from mfm_tpu.data.etl import PanelStore
+
+    store = PanelStore(str(tmp_path / "store"))
+    store.insert("balancesheet", pd.DataFrame({
+        "ts_code": ["a", "a", "b"],
+        "f_ann_date": ["20240425", "20240425", "20240428"],
+        "end_date": ["20240331", "20231231", "20240331"],
+        "total_ncl": [1.0, 2.0, 3.0],
+    }))
+    main(["etl-verify", "--store", str(tmp_path / "store"),
+          "--name", "balancesheet", "--diagnose"])
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["collection"] == "balancesheet"
+    assert rep["issue_counts"] == {"dup_ann": 2}
+    assert rep["stocks"] == {"a": ["dup_ann"]}
