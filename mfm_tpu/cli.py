@@ -35,7 +35,30 @@ def _risk(args):
         ),
         dtype=args.dtype,
     )
-    arrays = load_barra_csv(args.barra, args.industry_info)
+    if args.barra_store:
+        # the demo.ipynb variant: barra table from the store's
+        # ``barra_factors`` collection (written by ``pipeline --to-store``,
+        # the reference's main.py:144-155 Mongo save) instead of a CSV
+        from mfm_tpu.data.barra import barra_frame_to_arrays
+        from mfm_tpu.data.etl import PanelStore
+
+        st = PanelStore(args.barra_store)
+        df = st.read("barra_factors")
+        if not len(df):
+            raise SystemExit(f"{args.barra_store}: no barra_factors "
+                             "collection (run `pipeline --to-store` first)")
+        if args.industry_info:
+            # an explicit file wins over the store's own collection (same
+            # role as on the CSV path: fix the one-hot code order)
+            import pandas as pd
+
+            codes = pd.read_csv(args.industry_info)["code"].to_numpy()
+        else:
+            info = st.read("sw_industry_info_for_factors")
+            codes = info["code"].to_numpy() if len(info) else None
+        arrays = barra_frame_to_arrays(df, industry_codes=codes)
+    else:
+        arrays = load_barra_csv(args.barra, args.industry_info)
     t0 = time.perf_counter()
     res = run_risk_pipeline(arrays=arrays, config=cfg)
     os.makedirs(args.out, exist_ok=True)
@@ -225,6 +248,16 @@ def _pipeline(args):
             "industry_names": info.get("l1_name", info["l1_code"]),
         }).sort_values("code").to_csv(industry_info_path, index=False)
     factor_wall = time.perf_counter() - t0
+
+    if args.to_store:
+        # the reference persists the factor table to Mongo collections
+        # ``barra_factors`` + ``sw_industry_info_for_factors``
+        # (main.py:144-155, full refresh); same here against a PanelStore,
+        # consumable by `risk --barra-store`
+        out_store = PanelStore(args.to_store)
+        out_store.replace("barra_factors", barra)
+        out_store.replace("sw_industry_info_for_factors",
+                          pd.read_csv(industry_info_path))
 
     codes = pd.read_csv(industry_info_path)["code"].to_numpy()
     res = run_risk_pipeline(barra_df=barra, config=cfg, industry_codes=codes)
@@ -425,6 +458,7 @@ def _etl_verify(args):
 
         try:
             rep = diagnose_statements(store.read(args.name),
+                                      by=args.code_col,
                                       ann_col=args.ann_col,
                                       end_col=args.end_col)
         except ValueError as err:
@@ -482,7 +516,12 @@ def main(argv=None):
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     r = sub.add_parser("risk", help="risk model over a barra-format CSV (demo.py path)")
-    r.add_argument("--barra", required=True)
+    rsrc = r.add_mutually_exclusive_group(required=True)
+    rsrc.add_argument("--barra", help="barra-format CSV (demo.py:22)")
+    rsrc.add_argument("--barra-store", metavar="STORE",
+                      help="read the barra_factors collection from this "
+                           "PanelStore instead (demo.ipynb's Mongo-sourced "
+                           "variant; written by `pipeline --to-store`)")
     r.add_argument("--industry-info", default=None)
     r.add_argument("--out", default="results")
     r.add_argument("--nw-lags", type=int, default=2)
@@ -547,6 +586,11 @@ def main(argv=None):
     pl.add_argument("--fin-start", default="20190101")
     pl.add_argument("--resume", action="store_true",
                     help="reuse the barra_data.csv stage artifact if present")
+    pl.add_argument("--to-store", default=None, metavar="STORE",
+                    help="also save barra_factors + "
+                         "sw_industry_info_for_factors collections into this "
+                         "PanelStore (main.py:144-155's Mongo save), "
+                         "readable by `risk --barra-store`")
     pl.add_argument("--nw-lags", type=int, default=2)
     pl.add_argument("--nw-half-life", type=float, default=252.0)
     pl.add_argument("--eigen-sims", type=int, default=100)

@@ -253,6 +253,13 @@ class PanelStore:
         out = pd.concat([cur, df], ignore_index=True) if len(cur) else df
         self._rewrite(name, out)
 
+    def replace(self, name: str, df):
+        """Full refresh: the collection's contents become exactly ``df``
+        (the reference's drop + ``insert_many`` pattern,
+        ``update_mongo_db.py:32-57``) — unlike an all-True ``replace_where``
+        this never reads the rows being discarded."""
+        self._rewrite(name, df)
+
     def compact(self, name: str):
         """Merge all parts into one (maintenance; reads stay correct
         either way)."""
@@ -375,7 +382,7 @@ class IncrementalUpdater:
         df = self._call(self.source.fetch_stock_info)
         if df is None or not len(df):
             return []
-        self.store.replace_where(name, lambda c: np.ones(len(c), bool), df)
+        self.store.replace(name, df)
         return list(df["ts_code"])
 
     @staticmethod
@@ -428,7 +435,7 @@ class IncrementalUpdater:
             raise ValueError("pass ts_codes or csv_path")
         if not len(df):
             return 0
-        self.store.replace_where(name, lambda c: np.ones(len(c), bool), df)
+        self.store.replace(name, df)
         return len(df)
 
     def repair_missing_stocks(self, start_date, end_date,

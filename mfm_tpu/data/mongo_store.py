@@ -4,8 +4,8 @@ The reference's actual storage layer is MongoDB (``Barra_database/database/
 update_mongo_db.py:579-614``: database ``barra_financial_data``, one
 collection per dataset, unique indexes + ``insert_many(ordered=False)`` for
 duplicate-tolerant idempotent loads).  This adapter exposes that backend
-through the same five methods the parquet :class:`PanelStore` offers —
-``insert`` / ``read`` / ``replace_where`` / ``last_date`` /
+through the same methods the parquet :class:`PanelStore` offers —
+``insert`` / ``read`` / ``replace_where`` / ``replace`` / ``last_date`` /
 ``distinct_count`` — so :class:`mfm_tpu.data.etl.IncrementalUpdater`,
 :func:`mfm_tpu.data.prepare.prepare_factor_inputs`, and the CLI drivers run
 unchanged against either.
@@ -130,6 +130,13 @@ class MongoPanelStore:
             # through insert() for ordered=False duplicate tolerance — a
             # unique index from an earlier keyed insert must not abort the
             # refresh mid-batch
+            self.insert(name, df)
+
+    def replace(self, name: str, df) -> None:
+        """Full refresh: one server-side wipe then insert (the reference's
+        drop + ``insert_many``, ``update_mongo_db.py:32-57``)."""
+        self.db[name].delete_many({})
+        if df is not None and len(df):
             self.insert(name, df)
 
     def compact(self, name: str) -> None:

@@ -79,3 +79,48 @@ def test_prepare_then_factors_chain(store_dir, tmp_path, capsys):
                 "beta", "momentum", "residual_volatility", "liquidity"):
         assert col in barra.columns, col
     assert barra["stocknames"].nunique() == 16
+
+
+def test_pipeline_to_store_risk_from_store_roundtrip(store_dir, tmp_path,
+                                                     capsys):
+    """pipeline --to-store persists barra_factors +
+    sw_industry_info_for_factors (main.py:144-155's Mongo save against the
+    PanelStore); risk --barra-store reproduces the CSV path's outputs from
+    those collections (demo.ipynb's Mongo-sourced variant)."""
+    out1 = str(tmp_path / "res_csv")
+    fstore = str(tmp_path / "factor_store")
+    cli_main(["pipeline", "--store", store_dir, "--out", out1,
+              "--eigen-sims", "8", "--start", "20200101",
+              "--to-store", fstore])
+    capsys.readouterr()
+
+    st = PanelStore(fstore)
+    barra = st.read("barra_factors")
+    info = st.read("sw_industry_info_for_factors")
+    assert len(barra) and len(info)
+    assert set(pd.read_csv(os.path.join(out1, "industry_info.csv"))["code"]) \
+        == set(info["code"])
+
+    out2 = str(tmp_path / "res_store")
+    cli_main(["risk", "--barra-store", fstore, "--out", out2,
+              "--eigen-sims", "8"])
+    capsys.readouterr()
+    for name in ("factor_returns.csv", "r_squared.csv", "lambda.csv"):
+        a = pd.read_csv(os.path.join(out1, name), index_col=0)
+        b = pd.read_csv(os.path.join(out2, name), index_col=0)
+        np.testing.assert_allclose(b.to_numpy(), a.to_numpy(),
+                                   rtol=2e-5, atol=1e-7, equal_nan=True)
+
+    # a second --to-store run is a full refresh, not an append
+    cli_main(["pipeline", "--store", store_dir, "--out", out1,
+              "--eigen-sims", "8", "--start", "20200101",
+              "--to-store", fstore, "--resume"])
+    capsys.readouterr()
+    assert len(st.read("barra_factors")) == len(barra)
+
+
+def test_risk_from_empty_store_errors(tmp_path, capsys):
+    with pytest.raises(SystemExit, match="barra_factors"):
+        cli_main(["risk", "--barra-store", str(tmp_path / "nothing"),
+                  "--out", str(tmp_path / "o")])
+    capsys.readouterr()

@@ -1,6 +1,6 @@
 """Shared storage contract test (VERDICT r3 missing #3): the same
-insert/read/replace_where/last_date/distinct_count semantics must hold for
-every PanelStore backend.  Runs against the parquet store unconditionally;
+insert/read/replace_where/replace/last_date/distinct_count semantics must
+hold for every PanelStore backend.  Runs against the parquet store unconditionally;
 against :class:`mfm_tpu.data.mongo_store.MongoPanelStore` when pymongo and a
 local server are available (skipped otherwise — pymongo is not in this
 image).
@@ -87,6 +87,18 @@ def test_replace_where_refresh(store):
     )
     got = store.read("comp")
     assert sorted(got["con_code"]) == ["w", "z"]
+
+
+def test_replace_full_refresh(store):
+    """replace(): contents become exactly df (drop + insert_many,
+    update_mongo_db.py:32-57) — including creating a fresh collection and
+    shrinking an existing one."""
+    store.replace("info", _frame(1, n=4))      # create
+    assert len(store.read("info")) == 4
+    store.replace("info", _frame(2, n=2))      # full refresh, smaller
+    got = store.read("info")
+    assert len(got) == 2
+    assert set(got["trade_date"]) == {"20240102"}
 
 
 def test_last_date_watermark(store):
