@@ -55,12 +55,31 @@ def _risk(args):
             codes = pd.read_csv(args.industry_info)["code"].to_numpy()
         else:
             info = st.read("sw_industry_info_for_factors")
-            codes = info["code"].to_numpy() if len(info) else None
+            if not len(info):
+                # as strict as the barra_factors check: a data-derived
+                # one-hot order would silently diverge from the pipeline's
+                raise SystemExit(
+                    f"{args.barra_store}: no sw_industry_info_for_factors "
+                    "collection — rerun `pipeline --to-store`, or pass the "
+                    "code list explicitly with --industry-info")
+            codes = info["code"].to_numpy()
         arrays = barra_frame_to_arrays(df, industry_codes=codes)
     else:
         arrays = load_barra_csv(args.barra, args.industry_info)
     t0 = time.perf_counter()
-    res = run_risk_pipeline(arrays=arrays, config=cfg)
+    import contextlib
+
+    if args.profile:
+        # capture a jax.profiler trace of the whole pipeline (viewable in
+        # TensorBoard / Perfetto; SURVEY §5's tracing subsystem).  Capture
+        # wraps the run; the reported wall_s includes the profiler overhead
+        import jax
+
+        ctx = jax.profiler.trace(args.profile)
+    else:
+        ctx = contextlib.nullcontext()
+    with ctx:
+        res = run_risk_pipeline(arrays=arrays, config=cfg)
     os.makedirs(args.out, exist_ok=True)
     res.factor_returns().to_csv(os.path.join(args.out, "factor_returns.csv"))
     res.r_squared().to_csv(os.path.join(args.out, "r_squared.csv"))
@@ -161,8 +180,15 @@ def _demo(args):
     os.makedirs(args.out, exist_ok=True)
     res.factor_returns().to_csv(os.path.join(args.out, "factor_returns.csv"))
     res.final_covariance().to_csv(os.path.join(args.out, "final_covariance.csv"))
-    print(json.dumps({"wall_s": round(time.perf_counter() - t0, 3),
-                      "out": args.out}))
+    rec = {"wall_s": round(time.perf_counter() - t0, 3), "out": args.out}
+    if args.check_determinism:
+        # the framework's sanitizer (SURVEY §5's race-detector analogue):
+        # same seed, same inputs -> bitwise-equal outputs, twice over
+        from mfm_tpu.utils.obs import determinism_check
+
+        rec["deterministic"] = determinism_check(
+            lambda: run_risk_pipeline(barra_df=df, config=cfg).outputs)
+    print(json.dumps(rec))
 
 
 def _prepare(args):
@@ -249,6 +275,7 @@ def _pipeline(args):
         }).sort_values("code").to_csv(industry_info_path, index=False)
     factor_wall = time.perf_counter() - t0
 
+    info_df = pd.read_csv(industry_info_path)
     if args.to_store:
         # the reference persists the factor table to Mongo collections
         # ``barra_factors`` + ``sw_industry_info_for_factors``
@@ -256,10 +283,9 @@ def _pipeline(args):
         # consumable by `risk --barra-store`
         out_store = PanelStore(args.to_store)
         out_store.replace("barra_factors", barra)
-        out_store.replace("sw_industry_info_for_factors",
-                          pd.read_csv(industry_info_path))
+        out_store.replace("sw_industry_info_for_factors", info_df)
 
-    codes = pd.read_csv(industry_info_path)["code"].to_numpy()
+    codes = info_df["code"].to_numpy()
     res = run_risk_pipeline(barra_df=barra, config=cfg, industry_codes=codes)
     # the five demo.py result tables (demo.py:60-94)
     res.factor_returns().to_csv(os.path.join(args.out, "factor_returns.csv"))
@@ -540,6 +566,9 @@ def main(argv=None):
     r.add_argument("--specific-risk", action="store_true",
                    help="also write specific_risk.csv (shrunk EWMA "
                         "specific vol per stock x date)")
+    r.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the pipeline run "
+                        "into DIR (TensorBoard/Perfetto-viewable)")
     r.set_defaults(fn=_risk)
 
     f = sub.add_parser("factors", help="style-factor production (main.py path)")
@@ -562,6 +591,10 @@ def main(argv=None):
     d.add_argument("--eigen-sims", type=int, default=16)
     d.add_argument("--out", default="results")
     d.add_argument("--dtype", default="float32")
+    d.add_argument("--check-determinism", action="store_true",
+                   help="run the pipeline twice more and report whether "
+                        "outputs are bitwise identical (the same-seed "
+                        "sanitizer)")
     d.set_defaults(fn=_demo)
 
     pp = sub.add_parser("prepare",

@@ -257,8 +257,9 @@ class PanelStore:
         """Full refresh: the collection's contents become exactly ``df``
         (the reference's drop + ``insert_many`` pattern,
         ``update_mongo_db.py:32-57``) — unlike an all-True ``replace_where``
-        this never reads the rows being discarded."""
-        self._rewrite(name, df)
+        this never reads the rows being discarded.  ``None`` wipes the
+        collection (the Mongo adapter's behavior — shared contract)."""
+        self._rewrite(name, df if df is not None else pd.DataFrame())
 
     def compact(self, name: str):
         """Merge all parts into one (maintenance; reads stay correct

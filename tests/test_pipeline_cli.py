@@ -124,3 +124,26 @@ def test_risk_from_empty_store_errors(tmp_path, capsys):
         cli_main(["risk", "--barra-store", str(tmp_path / "nothing"),
                   "--out", str(tmp_path / "o")])
     capsys.readouterr()
+
+
+def test_demo_check_determinism_cli(tmp_path, capsys):
+    cli_main(["demo", "--dates", "30", "--stocks", "12", "--industries", "3",
+              "--styles", "2", "--eigen-sims", "4",
+              "--out", str(tmp_path / "o"), "--check-determinism"])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["deterministic"] is True
+
+
+def test_risk_profile_writes_trace(tmp_path, capsys):
+    from mfm_tpu.data.synthetic import synthetic_barra_table
+
+    df, _ = synthetic_barra_table(T=30, N=12, P=3, Q=2, seed=3)
+    barra = str(tmp_path / "b.csv")
+    df.to_csv(barra, index=False)
+    prof = str(tmp_path / "trace")
+    cli_main(["risk", "--barra", barra, "--out", str(tmp_path / "o"),
+              "--eigen-sims", "4", "--profile", prof])
+    json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # jax.profiler.trace writes plugins/profile/<ts>/*.xplane.pb
+    hits = [f for _, _, fs in os.walk(prof) for f in fs]
+    assert any(f.endswith(".xplane.pb") for f in hits), hits

@@ -99,6 +99,8 @@ def test_replace_full_refresh(store):
     got = store.read("info")
     assert len(got) == 2
     assert set(got["trade_date"]) == {"20240102"}
+    store.replace("info", None)                # None wipes, both backends
+    assert len(store.read("info")) == 0
 
 
 def test_last_date_watermark(store):
