@@ -577,10 +577,10 @@ def main(argv=None):
     f.add_argument("--industry", required=True, help="ts_code -> l1_code csv")
     f.add_argument("--out", default="results")
     f.add_argument("--dtype", default="float32")
-    f.add_argument("--block", type=int, default=64,
+    f.add_argument("--block", type=int, default=None,
                    help="rolling-kernel date-block size (memory = block x "
-                        "window x stocks floats per input; use 16 at all-A "
-                        "5,000-stock scale)")
+                        "window x stocks floats per input); default: auto "
+                        "from the panel width (64 at CSI300, 16 at all-A)")
     f.set_defaults(fn=_factors)
 
     d = sub.add_parser("demo", help="synthetic end-to-end risk model")
@@ -631,8 +631,9 @@ def main(argv=None):
     pl.add_argument("--vr-half-life", type=float, default=42.0)
     pl.add_argument("--seed", type=int, default=0)
     pl.add_argument("--dtype", default="float32")
-    pl.add_argument("--block", type=int, default=64,
-                    help="rolling-kernel date-block size (16 at all-A scale)")
+    pl.add_argument("--block", type=int, default=None,
+                    help="rolling-kernel date-block size; default: auto "
+                         "from the panel width (64 at CSI300, 16 at all-A)")
     pl.add_argument("--specific-risk", action="store_true",
                     help="also write specific_risk.csv (shrunk EWMA "
                          "specific vol per stock x date)")

@@ -154,12 +154,17 @@ class PipelineConfig:
     risk: RiskModelConfig = dataclasses.field(default_factory=RiskModelConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     dtype: str = "float32"  # compute dtype on TPU; tests use float64 on CPU
-    #: rolling-kernel date-block size (memory = block x window x N floats per
-    #: input, ops/rolling.py:52-90).  64 suits CSI300-sized panels; 16
-    #: measures fastest at all-A 5,000-stock scale (BASELINE.md block sweep).
-    block: int = 64
+    #: rolling-kernel date-block size (memory = block x window x N elements
+    #: per input, ops/rolling.py::rolling_reduce).  None (default) = derive
+    #: from the panel width and dtype at run time (ops/rolling.py::auto_block:
+    #: 64 at CSI300's 300 stocks, 16 at all-A's 5,000 per the BASELINE.md
+    #: block sweep).
+    block: int | None = None
 
     def __post_init__(self):
+        if self.block is None:
+            return
         if not isinstance(self.block, int) or isinstance(self.block, bool) \
                 or self.block < 1:
-            raise ValueError(f"block must be a positive int, got {self.block!r}")
+            raise ValueError(f"block must be a positive int or None, "
+                             f"got {self.block!r}")

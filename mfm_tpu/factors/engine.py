@@ -26,6 +26,7 @@ import numpy as np
 
 from mfm_tpu.config import FactorConfig
 from mfm_tpu.factors import style
+from mfm_tpu.ops.rolling import auto_block
 from mfm_tpu.factors.post import apply_post_processing
 
 
@@ -78,7 +79,15 @@ class FactorEngine:
     fields: Dict[str, jax.Array]
     index_close: jax.Array
     config: FactorConfig = dataclasses.field(default_factory=FactorConfig)
-    block: int = 64
+    #: rolling date-block size; None = auto from the panel width
+    #: (ops/rolling.py::auto_block)
+    block: int | None = None
+
+    def __post_init__(self):
+        if self.block is None:
+            close = self.fields["close"]
+            self.block = auto_block(close.shape[1],
+                                    itemsize=close.dtype.itemsize)
 
     def run(self, factors=None, post_process: bool = True) -> Dict[str, jax.Array]:
         factors = tuple(factors or self.config.factors_to_run)

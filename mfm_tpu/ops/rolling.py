@@ -49,6 +49,26 @@ def ewma_tail_weights_from_mask(valid: jax.Array, decay, axis: int = -2) -> jax.
     return jnp.where(valid, decay ** after, 0.0)
 
 
+def auto_block(n_stocks: int, window: int = 504, budget_mb: int = 256,
+               lo: int = 8, hi: int = 64, itemsize: int = 4) -> int:
+    """Date-block size fitting the window buffer in a fixed HBM budget.
+
+    Each rolling kernel materializes ``block * window * n_stocks`` elements
+    per input (:func:`rolling_reduce`); this returns the largest power of
+    two in [lo, hi] keeping that under ``budget_mb``.  The 504 default is
+    the widest kernel's T = window + lag upper bound (RSTR rolls 483 dates
+    after its 21-day skip, FactorConfig) — conservative by the lag.
+    Reproduces the measured block sweep (BASELINE.md): 64 at CSI300's
+    300 stocks, 16 at all-A's 5,000 (where 32/64 lose to VMEM pressure).
+    """
+    per_date = window * max(int(n_stocks), 1) * itemsize
+    cap = max(lo, min(hi, budget_mb * 2**20 // per_date))
+    b = lo
+    while b * 2 <= cap:
+        b *= 2
+    return b
+
+
 def rolling_reduce(
     inputs: Sequence[jax.Array],
     window: int,

@@ -99,3 +99,40 @@ def test_rolling_sum_matches_pandas(series):
     for n in range(x.shape[1]):
         g = pd.Series(x[:, n]).rolling(21, min_periods=15).sum().to_numpy()
         np.testing.assert_allclose(got[:, n], g, rtol=1e-10, atol=1e-14, equal_nan=True)
+
+
+def test_auto_block_matches_measured_sweep():
+    from mfm_tpu.ops.rolling import auto_block
+
+    assert auto_block(300) == 64     # CSI300: largest block wins
+    assert auto_block(5000) == 16    # all-A: the measured optimum
+    assert auto_block(100_000) == 8  # floor: never below lo
+    assert auto_block(1) == 64       # cap: never above hi
+    # the budget is element-size aware: f64 halves the fitting block
+    assert auto_block(5000, itemsize=8) == 8
+
+
+def test_factor_engine_resolves_auto_block():
+    import jax.numpy as jnp
+    import pytest
+
+    from mfm_tpu.config import PipelineConfig
+    from mfm_tpu.factors.engine import FactorEngine
+
+    f32 = jnp.float32
+    eng = FactorEngine({"close": jnp.zeros((4, 300), f32)}, jnp.zeros(4, f32))
+    assert eng.block == 64
+    eng = FactorEngine({"close": jnp.zeros((4, 5000), f32)}, jnp.zeros(4, f32))
+    assert eng.block == 16
+    # the resolution is dtype-aware (f64 doubles the per-element cost)...
+    eng = FactorEngine({"close": jnp.zeros((4, 5000), jnp.float64)},
+                       jnp.zeros(4))
+    assert eng.block == 8
+    # ...and an explicit block always wins
+    eng = FactorEngine({"close": jnp.zeros((4, 5000), f32)}, jnp.zeros(4, f32),
+                       block=32)
+    assert eng.block == 32
+
+    assert PipelineConfig(block=None).block is None
+    with pytest.raises(ValueError):
+        PipelineConfig(block=0)
