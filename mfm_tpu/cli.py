@@ -388,6 +388,26 @@ def _alpha(args):
             report["select_out"] = args.select_out
         report["n_selected"] = len(sel["indices"])
         report["n_rejected_by_corr"] = len(sel["rejected"])
+    if args.values_out:
+        # evaluated alpha panels as a long table (trade_date, ts_code,
+        # one column per expression) — the bridge back into the factor
+        # pipeline: a selected alpha becomes a custom style factor.
+        # Restricted to the selection when --select ran (1,000 full panels
+        # would be E*T*N cells); all expressions otherwise.
+        keep = (list(sel["indices"]) if args.select is not None
+                else list(range(len(exprs))))
+        # gather the kept slices on device BEFORE the host transfer — with
+        # --select this moves k panels, not the full (E, T, N) batch
+        vals = np.asarray(values[jnp.asarray(keep)]) if keep \
+            else np.empty((0,) + values.shape[1:], np.float32)
+        out_panel = Panel(
+            dates=p.dates, stocks=p.stocks,
+            fields={f"alpha_{i:04d}": vals[j] for j, i in enumerate(keep)})
+        out_panel.to_long(dropna=False).to_parquet(args.values_out,
+                                                   index=False)
+        with open(args.values_out + ".exprs.txt", "w") as fh:
+            fh.writelines(f"alpha_{i:04d}\t{exprs[i]}\n" for i in keep)
+        report["values_out"] = args.values_out
     wall = time.perf_counter() - t0
     score.to_csv(args.out)
     report.update({
@@ -666,6 +686,11 @@ def main(argv=None):
                     help="redundancy cap for --select")
     al.add_argument("--select-out", default=None, metavar="FILE.txt",
                     help="write the selected expressions here, one per line")
+    al.add_argument("--values-out", default=None, metavar="FILE.parquet",
+                    help="write the evaluated alpha panels as a long table "
+                         "(selected expressions when --select ran, else "
+                         "all) + a FILE.exprs.txt column map — feedable "
+                         "back into the factors pipeline as custom styles")
     al.set_defaults(fn=_alpha)
 
     c = sub.add_parser("crosscheck",

@@ -86,7 +86,12 @@ class FactorEngine:
     def __post_init__(self):
         if self.block is None:
             close = self.fields["close"]
-            self.block = auto_block(close.shape[1],
+            cfg = self.config
+            # budget for THIS config's widest rolling kernel, not the
+            # default windows (rstr_total = window + lag, the upper bound)
+            widest = max(cfg.beta.window, cfg.rstr_total, cfg.dastd.window,
+                         cfg.cmra_window, cfg.stoa.window)
+            self.block = auto_block(close.shape[1], window=widest,
                                     itemsize=close.dtype.itemsize)
 
     def run(self, factors=None, post_process: bool = True) -> Dict[str, jax.Array]:

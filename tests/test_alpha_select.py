@@ -124,3 +124,50 @@ def test_alpha_cli_select_flag_validation(tmp_path, capsys):
         main(["alpha", "--exprs", "x", "--panel", "y",
               "--select-out", "sel.txt"])
     capsys.readouterr()
+
+
+def test_alpha_cli_values_out(tmp_path, capsys):
+    import jax.numpy as jnp
+
+    from mfm_tpu.alpha.dsl import compile_alpha
+    from mfm_tpu.cli import main
+    from mfm_tpu.panel import Panel
+
+    rng = np.random.default_rng(5)
+    T, N = 40, 10
+    dates = pd.bdate_range("2024-01-02", periods=T)
+    stocks = [f"s{i:02d}" for i in range(N)]
+    close = np.exp(np.cumsum(0.02 * rng.standard_normal((T, N)), axis=0))
+    long = pd.DataFrame({
+        "trade_date": np.repeat(dates, N),
+        "ts_code": np.tile(stocks, T),
+        "close": close.ravel(),
+        "ret": np.vstack([np.full((1, N), np.nan),
+                          close[1:] / close[:-1] - 1]).ravel(),
+    })
+    panel = str(tmp_path / "panel.csv")
+    long.to_csv(panel, index=False)
+    (tmp_path / "exprs.txt").write_text(
+        "cs_rank(delta(close, 2))\n-ts_mean(ret, 3)\n")
+    vout = str(tmp_path / "values.parquet")
+    main(["--platform", "cpu", "alpha", "--exprs", str(tmp_path / "exprs.txt"),
+          "--panel", panel, "--out", str(tmp_path / "scores.csv"),
+          "--values-out", vout])
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["values_out"] == vout
+
+    got = pd.read_parquet(vout)
+    assert list(got.columns) == ["trade_date", "ts_code",
+                                 "alpha_0000", "alpha_0001"]
+    assert len(got) == T * N
+    # values round-trip: the long column equals a direct DSL evaluation
+    p = Panel.from_long(long)
+    direct = compile_alpha("cs_rank(delta(close, 2))")(
+        {"close": jnp.asarray(p.fields["close"], jnp.float32)})
+    np.testing.assert_allclose(
+        got["alpha_0000"].to_numpy().reshape(T, N), np.asarray(direct),
+        rtol=1e-5, equal_nan=True)
+    # the column map names every exported expression
+    lines = (tmp_path / "values.parquet.exprs.txt").read_text().splitlines()
+    assert lines == ["alpha_0000\tcs_rank(delta(close, 2))",
+                     "alpha_0001\t-ts_mean(ret, 3)"]

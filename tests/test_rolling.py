@@ -136,3 +136,16 @@ def test_factor_engine_resolves_auto_block():
     assert PipelineConfig(block=None).block is None
     with pytest.raises(ValueError):
         PipelineConfig(block=0)
+
+
+def test_factor_engine_auto_block_respects_config_windows():
+    import jax.numpy as jnp
+
+    from mfm_tpu.config import FactorConfig, RollingSpec
+    from mfm_tpu.factors.engine import FactorEngine
+
+    wide = FactorConfig(beta=RollingSpec(window=1008, half_life=63,
+                                         min_periods=42))
+    eng = FactorEngine({"close": jnp.zeros((4, 5000), jnp.float32)},
+                       jnp.zeros(4, jnp.float32), config=wide)
+    assert eng.block == 8  # 2x window halves the fitting block (was 16)
