@@ -27,6 +27,9 @@ def _risk(args):
     from mfm_tpu.data.barra import load_barra_csv
     from mfm_tpu.pipeline import run_risk_pipeline
 
+    if args.bias_plot:
+        _require_matplotlib("--bias-plot")  # before the pipeline runs
+
     cfg = PipelineConfig(
         risk=RiskModelConfig(
             nw_lags=args.nw_lags, nw_half_life=args.nw_half_life,
@@ -445,6 +448,16 @@ def _crosscheck(args):
     print(rep.to_json(orient="index"))
 
 
+def _require_matplotlib(flag: str):
+    """Fail fast with the install hint instead of an ImportError traceback
+    (shared by every plotting flag)."""
+    try:
+        import matplotlib  # noqa: F401
+    except ImportError as err:
+        raise SystemExit(f"{flag} needs matplotlib "
+                         "(pip install 'mfm-tpu[plot]')") from err
+
+
 def _report(args):
     """Model-health report over a risk-run results directory — the
     reference's notebook eyeballing (factor paths, R², λ, bias pictures;
@@ -454,6 +467,8 @@ def _report(args):
         load_results, model_health_summary, plot_model_health,
     )
 
+    if args.plot:
+        _require_matplotlib("--plot")  # before any loading/summary work
     res = load_results(args.results)
     summary = model_health_summary(args.results, roll_window=args.roll_window,
                                    res=res)

@@ -171,3 +171,35 @@ def test_alpha_cli_values_out(tmp_path, capsys):
     lines = (tmp_path / "values.parquet.exprs.txt").read_text().splitlines()
     assert lines == ["alpha_0000\tcs_rank(delta(close, 2))",
                      "alpha_0001\t-ts_mean(ret, 3)"]
+
+
+def test_greedy_select_invariant_random():
+    """Property: on random inputs, every selected pair respects the cap and
+    every rejection names a genuinely-over-cap selected blocker."""
+    from mfm_tpu.alpha.select import greedy_select
+
+    rng = np.random.default_rng(11)
+    for trial in range(20):
+        E = int(rng.integers(2, 25))
+        scores = rng.standard_normal(E)
+        scores[rng.random(E) < 0.2] = np.nan
+        A = rng.standard_normal((E, E))
+        corr = np.clip((A + A.T) / 2, -1, 1)
+        np.fill_diagonal(corr, 1.0)
+        corr[rng.random((E, E)) < 0.1] = np.nan
+        corr = np.triu(corr) + np.triu(corr, 1).T  # keep symmetric with NaNs
+        cap = float(rng.uniform(0.2, 0.9))
+        k = int(rng.integers(1, E + 1))
+
+        out = greedy_select(scores, corr, k=k, max_corr=cap)
+        sel = out["indices"]
+        assert len(sel) <= k
+        for a in range(len(sel)):
+            for b in range(a + 1, len(sel)):
+                c = corr[sel[a], sel[b]]
+                assert not (np.isfinite(c) and abs(c) > cap), (trial, sel)
+        for loser, blocker in out["rejected"].items():
+            assert blocker in sel
+            assert abs(corr[loser, blocker]) > cap
+        for i in sel:
+            assert np.isfinite(scores[i])
