@@ -21,6 +21,21 @@ import os
 import time
 
 
+def _write_result_tables(res, out: str, specific_risk: bool) -> None:
+    """The five demo.py result tables (``demo.py:60-94``) plus, beyond the
+    reference, the USE4 specific-risk panel (EWMA vol, Bayes-shrunk;
+    models/specific.py) when asked."""
+    os.makedirs(out, exist_ok=True)
+    res.factor_returns().to_csv(os.path.join(out, "factor_returns.csv"))
+    res.r_squared().to_csv(os.path.join(out, "r_squared.csv"))
+    res.specific_returns().to_csv(os.path.join(out, "specific_returns.csv"))
+    res.final_covariance().to_csv(os.path.join(out, "final_covariance.csv"))
+    res.lambda_series().to_csv(os.path.join(out, "lambda.csv"))
+    if specific_risk:
+        _, shrunk = res.specific_risk()
+        shrunk.to_csv(os.path.join(out, "specific_risk.csv"))
+
+
 def _risk(args):
     import numpy as np
     from mfm_tpu.config import PipelineConfig, RiskModelConfig
@@ -83,17 +98,7 @@ def _risk(args):
         ctx = contextlib.nullcontext()
     with ctx:
         res = run_risk_pipeline(arrays=arrays, config=cfg)
-    os.makedirs(args.out, exist_ok=True)
-    res.factor_returns().to_csv(os.path.join(args.out, "factor_returns.csv"))
-    res.r_squared().to_csv(os.path.join(args.out, "r_squared.csv"))
-    res.specific_returns().to_csv(os.path.join(args.out, "specific_returns.csv"))
-    res.final_covariance().to_csv(os.path.join(args.out, "final_covariance.csv"))
-    res.lambda_series().to_csv(os.path.join(args.out, "lambda.csv"))
-    if args.specific_risk:
-        # beyond the reference's five tables: the USE4 specific-risk panel
-        # (EWMA vol, Bayes-shrunk; models/specific.py)
-        _, shrunk = res.specific_risk()
-        shrunk.to_csv(os.path.join(args.out, "specific_risk.csv"))
+    _write_result_tables(res, args.out, args.specific_risk)
     wall = time.perf_counter() - t0
     # plotting stays outside the timed region (matplotlib import + render
     # would otherwise pollute the reported pipeline wall-clock)
@@ -290,15 +295,7 @@ def _pipeline(args):
 
     codes = info_df["code"].to_numpy()
     res = run_risk_pipeline(barra_df=barra, config=cfg, industry_codes=codes)
-    # the five demo.py result tables (demo.py:60-94)
-    res.factor_returns().to_csv(os.path.join(args.out, "factor_returns.csv"))
-    res.r_squared().to_csv(os.path.join(args.out, "r_squared.csv"))
-    res.specific_returns().to_csv(os.path.join(args.out, "specific_returns.csv"))
-    res.final_covariance().to_csv(os.path.join(args.out, "final_covariance.csv"))
-    res.lambda_series().to_csv(os.path.join(args.out, "lambda.csv"))
-    if args.specific_risk:
-        _, shrunk = res.specific_risk()
-        shrunk.to_csv(os.path.join(args.out, "specific_risk.csv"))
+    _write_result_tables(res, args.out, args.specific_risk)
     save_risk_outputs(os.path.join(args.out, "risk_outputs.npz"), res.outputs,
                       meta={"source": args.store})
     print(json.dumps({

@@ -1,4 +1,10 @@
-"""Observability and misc utilities."""
+"""Observability and misc utilities.
+
+:mod:`mfm_tpu.utils.report` (model-health summary + plots) and
+:mod:`mfm_tpu.utils.crosscheck` (external factor comparison) are imported
+lazily by their CLI drivers — they need pandas/matplotlib, which stay
+optional for the pure-compute import path.
+"""
 
 from mfm_tpu.utils.obs import (
     StageTimer,
