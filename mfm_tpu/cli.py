@@ -21,6 +21,18 @@ import os
 import time
 
 
+def _profile_ctx(profile_dir):
+    """jax.profiler capture context, or a no-op when no dir was asked for
+    (shared by every --profile flag; SURVEY §5's tracing subsystem)."""
+    import contextlib
+
+    if not profile_dir:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.trace(profile_dir)
+
+
 def _write_result_tables(res, out: str, specific_risk: bool) -> None:
     """The five demo.py result tables (``demo.py:60-94``) plus, beyond the
     reference, the USE4 specific-risk panel (EWMA vol, Bayes-shrunk;
@@ -85,18 +97,8 @@ def _risk(args):
     else:
         arrays = load_barra_csv(args.barra, args.industry_info)
     t0 = time.perf_counter()
-    import contextlib
-
-    if args.profile:
-        # capture a jax.profiler trace of the whole pipeline (viewable in
-        # TensorBoard / Perfetto; SURVEY §5's tracing subsystem).  Capture
-        # wraps the run; the reported wall_s includes the profiler overhead
-        import jax
-
-        ctx = jax.profiler.trace(args.profile)
-    else:
-        ctx = contextlib.nullcontext()
-    with ctx:
+    # the reported wall_s includes the profiler overhead when --profile is on
+    with _profile_ctx(args.profile):
         res = run_risk_pipeline(arrays=arrays, config=cfg)
     _write_result_tables(res, args.out, args.specific_risk)
     wall = time.perf_counter() - t0
@@ -260,41 +262,46 @@ def _pipeline(args):
     industry_info_path = os.path.join(args.out, "industry_info.csv")
     t0 = time.perf_counter()
 
-    if args.resume and os.path.exists(barra_path) \
-            and os.path.exists(industry_info_path):
-        barra = pd.read_csv(barra_path)
-    else:
-        store = PanelStore(args.store)
-        prep = prepare_factor_inputs(
-            store, index_code=args.index_code, start_date=args.start,
-            end_date=args.end, fin_start_date=args.fin_start)
-        barra, _ = run_factor_pipeline(
-            prep.fields, prep.index_close, prep.industry_l1,
-            prep.dates, prep.stocks, cfg)
-        barra.to_csv(barra_path, index=False)  # stage artifact (main.py:144)
-        # industry_info: code list fixing the one-hot order (main.py:137-143)
-        sw = store.read("sw_industries")
-        info = (sw.drop_duplicates(subset=["l1_code"])
-                if len(sw) else pd.DataFrame({"l1_code": []}))
-        info = info[info["l1_code"].isin(set(barra["industry"].dropna()))]
-        pd.DataFrame({
-            "code": info["l1_code"],
-            "industry_names": info.get("l1_name", info["l1_code"]),
-        }).sort_values("code").to_csv(industry_info_path, index=False)
-    factor_wall = time.perf_counter() - t0
+    # profiler capture spans both compute stages (factors + risk); CSV
+    # writes stay outside the with-block, and an exception inside still
+    # stops the trace (no half-open profiler session)
+    with _profile_ctx(args.profile):
+        if args.resume and os.path.exists(barra_path) \
+                and os.path.exists(industry_info_path):
+            barra = pd.read_csv(barra_path)
+        else:
+            store = PanelStore(args.store)
+            prep = prepare_factor_inputs(
+                store, index_code=args.index_code, start_date=args.start,
+                end_date=args.end, fin_start_date=args.fin_start)
+            barra, _ = run_factor_pipeline(
+                prep.fields, prep.index_close, prep.industry_l1,
+                prep.dates, prep.stocks, cfg)
+            barra.to_csv(barra_path, index=False)  # stage artifact (main.py:144)
+            # industry_info: code list fixing the one-hot order (main.py:137-143)
+            sw = store.read("sw_industries")
+            info = (sw.drop_duplicates(subset=["l1_code"])
+                    if len(sw) else pd.DataFrame({"l1_code": []}))
+            info = info[info["l1_code"].isin(set(barra["industry"].dropna()))]
+            pd.DataFrame({
+                "code": info["l1_code"],
+                "industry_names": info.get("l1_name", info["l1_code"]),
+            }).sort_values("code").to_csv(industry_info_path, index=False)
+        factor_wall = time.perf_counter() - t0
 
-    info_df = pd.read_csv(industry_info_path)
-    if args.to_store:
-        # the reference persists the factor table to Mongo collections
-        # ``barra_factors`` + ``sw_industry_info_for_factors``
-        # (main.py:144-155, full refresh); same here against a PanelStore,
-        # consumable by `risk --barra-store`
-        out_store = PanelStore(args.to_store)
-        out_store.replace("barra_factors", barra)
-        out_store.replace("sw_industry_info_for_factors", info_df)
+        info_df = pd.read_csv(industry_info_path)
+        if args.to_store:
+            # the reference persists the factor table to Mongo collections
+            # ``barra_factors`` + ``sw_industry_info_for_factors``
+            # (main.py:144-155, full refresh); same here against a
+            # PanelStore, consumable by `risk --barra-store`
+            out_store = PanelStore(args.to_store)
+            out_store.replace("barra_factors", barra)
+            out_store.replace("sw_industry_info_for_factors", info_df)
 
-    codes = info_df["code"].to_numpy()
-    res = run_risk_pipeline(barra_df=barra, config=cfg, industry_codes=codes)
+        codes = info_df["code"].to_numpy()
+        res = run_risk_pipeline(barra_df=barra, config=cfg,
+                                industry_codes=codes)
     _write_result_tables(res, args.out, args.specific_risk)
     save_risk_outputs(os.path.join(args.out, "risk_outputs.npz"), res.outputs,
                       meta={"source": args.store})
@@ -669,6 +676,9 @@ def main(argv=None):
     pl.add_argument("--specific-risk", action="store_true",
                     help="also write specific_risk.csv (shrunk EWMA "
                          "specific vol per stock x date)")
+    pl.add_argument("--profile", default=None, metavar="DIR",
+                    help="capture a jax.profiler trace spanning the factor "
+                         "and risk stages into DIR")
     pl.set_defaults(fn=_pipeline)
 
     al = sub.add_parser("alpha",

@@ -147,3 +147,12 @@ def test_risk_profile_writes_trace(tmp_path, capsys):
     # jax.profiler.trace writes plugins/profile/<ts>/*.xplane.pb
     hits = [f for _, _, fs in os.walk(prof) for f in fs]
     assert any(f.endswith(".xplane.pb") for f in hits), hits
+
+
+def test_pipeline_profile_writes_trace(store_dir, tmp_path, capsys):
+    prof = str(tmp_path / "trace")
+    cli_main(["pipeline", "--store", store_dir, "--out", str(tmp_path / "o"),
+              "--eigen-sims", "4", "--start", "20200101", "--profile", prof])
+    json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    hits = [f for _, _, fs in os.walk(prof) for f in fs]
+    assert any(f.endswith(".xplane.pb") for f in hits), hits
