@@ -123,6 +123,13 @@ def _risk(args):
         summary["backend"] = jax.devices()[0].platform
         with open(os.path.join(args.out, "bias_stats.json"), "w") as fh:
             json.dump(summary, fh, indent=1)
+    if args.portfolio_bias:
+        # USE4's headline acceptance test (random test portfolios) — the
+        # reference only runs the eigen-portfolio variant
+        rep = res.portfolio_bias(n_portfolios=args.portfolio_bias,
+                                 burn_in=args.bias_burn_in)
+        with open(os.path.join(args.out, "portfolio_bias.json"), "w") as fh:
+            json.dump(rep, fh, indent=1)
     print(json.dumps({
         "dates": int(arrays.ret.shape[0]), "stocks": int(arrays.ret.shape[1]),
         "factors": len(arrays.factor_names()), "wall_s": round(wall, 3),
@@ -262,9 +269,10 @@ def _pipeline(args):
     industry_info_path = os.path.join(args.out, "industry_info.csv")
     t0 = time.perf_counter()
 
-    # profiler capture spans both compute stages (factors + risk); CSV
-    # writes stay outside the with-block, and an exception inside still
-    # stops the trace (no half-open profiler session)
+    # profiler capture spans both compute stages (factors + risk, plus the
+    # stage-artifact pandas IO between them); the result-table writes after
+    # the block stay out, and an exception inside still stops the trace
+    # (no half-open profiler session)
     with _profile_ctx(args.profile):
         if args.resume and os.path.exists(barra_path) \
                 and os.path.exists(industry_info_path):
@@ -608,6 +616,17 @@ def main(argv=None):
     r.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the pipeline run "
                         "into DIR (TensorBoard/Perfetto-viewable)")
+    def _positive_int(v):
+        iv = int(v)
+        if iv < 1:
+            raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
+        return iv
+
+    r.add_argument("--portfolio-bias", type=_positive_int, default=None,
+                   metavar="Q",
+                   help="also run the USE4 random-portfolio bias acceptance "
+                        "test with Q portfolios and write "
+                        "OUT/portfolio_bias.json")
     r.set_defaults(fn=_risk)
 
     f = sub.add_parser("factors", help="style-factor production (main.py path)")
@@ -694,12 +713,6 @@ def main(argv=None):
     al.add_argument("--spread-q", type=float, default=0.2)
     al.add_argument("--chunk", type=int, default=1000,
                     help="expressions per compiled sub-batch")
-    def _positive_int(v):
-        iv = int(v)
-        if iv < 1:
-            raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
-        return iv
-
     al.add_argument("--select", type=_positive_int, default=None, metavar="K",
                     help="greedily pick the K best expressions (by |mean "
                          "IC|) whose pairwise long-short-PnL correlation "

@@ -140,6 +140,83 @@ def plot_bias_stats(bias_by_label: dict, path: str) -> None:
 
 
 @highest_matmul_precision
+def portfolio_bias_stat(
+    X: jax.Array,
+    design_valid: jax.Array,
+    covs: jax.Array,
+    cov_valid: jax.Array,
+    spec_vol: jax.Array,
+    ret: jax.Array,
+    weights: jax.Array,
+):
+    """Bias statistic of arbitrary test portfolios — the USE4 acceptance
+    test in its headline form (random portfolios), which the reference
+    implements only for eigenfactor portfolios (``utils.py:97-117``).
+
+    For each base portfolio q and date t: weights are the q-th base vector
+    restricted to date t's support (regression universe with a specific-vol
+    estimate) and renormalized to sum 1; predicted variance is
+    ``x'F_t x + sum_i w_i^2 sigma_i^2`` with ``x = X_t' w`` (the same
+    decomposition as ``RiskPipelineResult.portfolio_risk``); the realized
+    return is the t+1-labelled period return ``ret[t+1]`` of the held
+    stocks (a holding with no t+1 observation contributes 0 — suspension),
+    matching :func:`eigenfactor_bias_stat`'s cov_i -> return_(i+1)
+    alignment.  The bias of portfolio q is the population std of
+    ``z_t = r_t / sigma_pred_t`` over its valid dates; a well-calibrated
+    model gives bias ~ 1.
+
+    Args: ``X`` (T, N, K) per-date regression designs; ``design_valid``
+    (T, N); ``covs`` (T, K, K) adjusted factor covariances; ``cov_valid``
+    (T,); ``spec_vol`` (T, N) per-stock vol (NaN = no estimate);
+    ``ret`` (T, N) t+1-labelled returns; ``weights`` (Q, N) nonnegative
+    base weights.  Returns ``(z (Q, T-1), mask (Q, T-1))`` — compute the
+    std under whatever date mask you need (full sample / burn-in-excluded)
+    with :func:`bias_std`.
+    """
+    dtype = X.dtype
+    K = X.shape[-1]
+    support = design_valid & jnp.isfinite(spec_vol)
+    sf = support.astype(dtype)
+    s = jnp.einsum("tn,qn->qt", sf, weights)                    # (Q, T)
+    s_safe = jnp.where(s > 0, s, 1.0)
+
+    Xs = jnp.where(support[:, :, None], X, 0.0)
+    x = jnp.einsum("tnk,qn->qtk", Xs, weights) / s_safe[..., None]
+    covs_safe = jnp.where(cov_valid[:, None, None], covs,
+                          jnp.eye(K, dtype=dtype))
+    fvar = jnp.einsum("qtk,tkl,qtl->qt", x, covs_safe, x)
+    sv = jnp.where(support, spec_vol, 0.0)
+    svar = jnp.einsum("tn,qn->qt", sv * sv, weights * weights) / (s_safe ** 2)
+    sigma = jnp.sqrt(fvar + svar)                               # (Q, T)
+
+    # realized at formation date t = the held stocks' t+1-labelled returns,
+    # with the formation-date weights (support is the FORMATION date's —
+    # it enters via w_next; a holding with no t+1 observation contributes 0)
+    ret0 = jnp.where(jnp.isfinite(ret), ret, 0.0)
+    w_next = jnp.where(support[:-1], jnp.broadcast_to(
+        weights[:, None, :], (weights.shape[0],) + support.shape)[:, :-1], 0.0)
+    r = jnp.einsum("qtn,tn->qt", w_next, ret0[1:]) / s_safe[:, :-1]
+
+    sig = sigma[:, :-1]
+    ok = (cov_valid[:-1][None, :] & (s[:, :-1] > 0) & (sig > 0)
+          & jnp.isfinite(sig))
+    z = jnp.where(ok, r / jnp.where(ok, sig, 1.0), jnp.nan)
+    return z, ok
+
+
+def bias_std(z: jax.Array, mask: jax.Array, axis: int = -1) -> jax.Array:
+    """Population std over masked entries (``np.std`` semantics, matching
+    the reference's bias statistic; NaN where fewer than 2 valid)."""
+    m = mask & jnp.isfinite(z)
+    n = jnp.sum(m, axis=axis)
+    zz = jnp.where(m, z, 0.0)
+    mu = jnp.sum(zz, axis=axis) / jnp.maximum(n, 1)
+    var = jnp.sum(jnp.where(m, (z - jnp.expand_dims(mu, axis)) ** 2, 0.0),
+                  axis=axis) / jnp.maximum(n, 1)
+    return jnp.where(n >= 2, jnp.sqrt(var), jnp.nan)
+
+
+@highest_matmul_precision
 def bayes_shrink(
     volatility: jax.Array,
     capital: jax.Array,

@@ -18,6 +18,7 @@ from typing import Dict, Mapping
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from mfm_tpu.config import PipelineConfig
@@ -179,6 +180,62 @@ class RiskPipelineResult:
                 min_periods=min_periods)
             self._spec_cache[key] = (np.asarray(raw), np.asarray(shrunk))
         return self._spec_cache[key]
+
+    def portfolio_bias(self, n_portfolios: int = 100, seed: int = 0,
+                       burn_in: int = 252, half_life: float = 42.0,
+                       ngroup: int = 10, q: float = 1.0,
+                       min_periods: int = 10) -> dict:
+        """Random-portfolio bias statistics — the USE4 acceptance test the
+        reference only runs on eigenfactor portfolios.  ``n_portfolios``
+        random long-only base portfolios (|N(0,1)| weights over all stocks,
+        restricted per date to the regression universe with a specific-vol
+        estimate and renormalized); predicted vol from the adjusted factor
+        covariance + shrunk specific risk; realized from the t+1-labelled
+        returns.  Returns a JSON-ready dict with the per-portfolio bias
+        list and aggregates, full-sample and burn-in-excluded
+        (:func:`mfm_tpu.models.bias.portfolio_bias_stat`)."""
+        from mfm_tpu.models.bias import bias_std, portfolio_bias_stat
+        from mfm_tpu.ops.xreg import regression_design
+
+        a = self.arrays
+        T = a.ret.shape[0]
+        X, dval, _ = jax.vmap(
+            lambda r, c, s, i, v: regression_design(
+                r, c, s, i, v, n_industries=a.n_industries)
+        )(jnp.asarray(a.ret), jnp.asarray(a.cap), jnp.asarray(a.styles),
+          jnp.asarray(a.industry), jnp.asarray(a.valid))
+        spec = jnp.asarray(
+            self._specific_panels(half_life, ngroup, q, min_periods)[1])
+        rng = np.random.default_rng(seed)
+        weights = jnp.asarray(
+            np.abs(rng.standard_normal((n_portfolios, a.ret.shape[1]))),
+            X.dtype)
+        # vr_cov's validity is the eigen stage's (the vol-regime stage only
+        # scales it by lambda^2)
+        z, ok = portfolio_bias_stat(
+            X, dval, jnp.asarray(self.outputs.vr_cov),
+            jnp.asarray(self.outputs.eigen_valid), spec,
+            jnp.asarray(a.ret), weights)
+
+        def agg(mask):
+            b = np.asarray(bias_std(z, mask))
+            fin = b[np.isfinite(b)]
+            dev = np.abs(fin - 1.0)
+            r = lambda x: round(float(x), 4)
+            return {
+                "bias": [r(v) if np.isfinite(v) else None for v in b],
+                "mean": r(fin.mean()) if fin.size else None,
+                "median": r(np.median(fin)) if fin.size else None,
+                "mean_abs_dev_from_1": r(dev.mean()) if fin.size else None,
+                "max_abs_dev_from_1": r(dev.max()) if fin.size else None,
+            }
+
+        out = {"n_portfolios": int(n_portfolios), "seed": int(seed),
+               "all_valid_dates": agg(ok)}
+        t_ok = jnp.arange(T - 1) >= burn_in
+        if bool(np.asarray(ok & t_ok[None, :]).any()):
+            out[f"after_burn_in_{burn_in}"] = agg(ok & t_ok[None, :])
+        return out
 
     def portfolio_risk(self, weights, t: int = -1, specific_vol=None,
                        half_life: float = 42.0, ngroup: int = 10,
