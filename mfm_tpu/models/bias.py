@@ -190,12 +190,12 @@ def portfolio_bias_stat(
     sigma = jnp.sqrt(fvar + svar)                               # (Q, T)
 
     # realized at formation date t = the held stocks' t+1-labelled returns,
-    # with the formation-date weights (support is the FORMATION date's —
-    # it enters via w_next; a holding with no t+1 observation contributes 0)
+    # with the formation-date weights (support is the FORMATION date's; a
+    # holding with no t+1 observation contributes 0).  The effective weight
+    # w[q,t,n] = weights[q,n] * support[t,n] is rank-1 in q, so the
+    # contraction stays O(TN + QT) — no (Q, T, N) intermediate
     ret0 = jnp.where(jnp.isfinite(ret), ret, 0.0)
-    w_next = jnp.where(support[:-1], jnp.broadcast_to(
-        weights[:, None, :], (weights.shape[0],) + support.shape)[:, :-1], 0.0)
-    r = jnp.einsum("qtn,tn->qt", w_next, ret0[1:]) / s_safe[:, :-1]
+    r = jnp.einsum("tn,qn->qt", sf[:-1] * ret0[1:], weights) / s_safe[:, :-1]
 
     sig = sigma[:, :-1]
     ok = (cov_valid[:-1][None, :] & (s[:, :-1] > 0) & (sig > 0)

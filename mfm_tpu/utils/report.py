@@ -7,7 +7,8 @@ the eigenfactor bias picture (``mfm/utils.py:116``).  This module turns that
 into a first-class driver: one JSON health summary plus one small-multiples
 PNG, computed from the result tables the ``risk``/``pipeline`` subcommands
 write (``factor_returns.csv``, ``r_squared.csv``, ``lambda.csv``, and — when
-present — ``specific_returns.csv`` and ``bias_stats.json``).
+present — ``specific_returns.csv``, ``bias_stats.json``, and
+``portfolio_bias.json``).
 
 Everything here is host-side pandas over small result tables; no JAX.
 """
@@ -42,8 +43,9 @@ def load_results(results_dir: str) -> dict:
     """Read whatever result tables exist under ``results_dir``.
 
     Returns a dict with ``factor_returns`` / ``r_squared`` / ``lambda`` /
-    ``specific_returns`` DataFrames (absent keys omitted) and ``bias_stats``
-    (the parsed ``bias_stats.json``) when present.  ``factor_returns`` is
+    ``specific_returns`` DataFrames (absent keys omitted) plus
+    ``bias_stats`` / ``portfolio_bias`` (the parsed ``bias_stats.json`` /
+    ``portfolio_bias.json``) when present.  ``factor_returns`` is
     required — a results dir without it is not a risk-run output.
     """
     out = {}
@@ -58,10 +60,12 @@ def load_results(results_dir: str) -> dict:
         raise FileNotFoundError(
             f"{results_dir}/factor_returns.csv not found — run the `risk` or "
             "`pipeline` subcommand into this directory first")
-    bias_path = os.path.join(results_dir, "bias_stats.json")
-    if os.path.exists(bias_path):
-        with open(bias_path) as fh:
-            out["bias_stats"] = json.load(fh)
+    for key, fname in (("bias_stats", "bias_stats.json"),
+                       ("portfolio_bias", "portfolio_bias.json")):
+        path = os.path.join(results_dir, fname)
+        if os.path.exists(path):
+            with open(path) as fh:
+                out[key] = json.load(fh)
     return out
 
 
@@ -137,6 +141,15 @@ def model_health_summary(results_dir: str, ann_factor: int = 252,
             for label, d in scope.items() if isinstance(d, dict)
         }
         summary["bias"]["scope"] = scope_name
+    if "portfolio_bias" in res:
+        scope_name, scope = _bias_scope(res["portfolio_bias"])
+        summary["portfolio_bias"] = {
+            "scope": scope_name,
+            "n_portfolios": res["portfolio_bias"].get("n_portfolios"),
+            "mean": scope.get("mean"),
+            "median": scope.get("median"),
+            "mean_abs_dev_from_1": scope.get("mean_abs_dev_from_1"),
+        }
     return summary
 
 

@@ -78,10 +78,20 @@ def test_summary_optional_sections(tmp_path):
     from mfm_tpu.utils.report import model_health_summary
 
     _write_results(tmp_path, with_bias=True, with_specific=True)
+    (tmp_path / "portfolio_bias.json").write_text(json.dumps({
+        "n_portfolios": 7,
+        "all_valid_dates": {"mean": 1.31, "median": 1.2,
+                            "mean_abs_dev_from_1": 0.31},
+        "after_burn_in_252": {"mean": 1.02, "median": 1.01,
+                              "mean_abs_dev_from_1": 0.05},
+    }))
     s = model_health_summary(str(tmp_path))
     # burn-in-excluded scope preferred over all_valid_dates
     assert s["bias"]["scope"] == "after_burn_in_252"
     assert s["bias"]["eigen_adjusted"]["mean_abs_dev_from_1"] == 0.0233
+    assert s["portfolio_bias"] == {
+        "scope": "after_burn_in_252", "n_portfolios": 7, "mean": 1.02,
+        "median": 1.01, "mean_abs_dev_from_1": 0.05}
     sp = pd.read_csv(tmp_path / "specific_returns.csv", index_col=0)
     np.testing.assert_allclose(s["specific_dispersion"]["mean_xsec_std"],
                                sp.std(axis=1, ddof=1).mean(), atol=1e-5)
