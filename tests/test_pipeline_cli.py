@@ -156,3 +156,14 @@ def test_pipeline_profile_writes_trace(store_dir, tmp_path, capsys):
     json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     hits = [f for _, _, fs in os.walk(prof) for f in fs]
     assert any(f.endswith(".xplane.pb") for f in hits), hits
+
+
+def test_pipeline_portfolio_bias_flag(store_dir, tmp_path, capsys):
+    out = str(tmp_path / "o")
+    cli_main(["pipeline", "--store", store_dir, "--out", out,
+              "--eigen-sims", "4", "--start", "20200101",
+              "--portfolio-bias", "5"])
+    json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    rec = json.load(open(os.path.join(out, "portfolio_bias.json")))
+    assert rec["n_portfolios"] == 5
+    assert len(rec["all_valid_dates"]["bias"]) == 5
