@@ -149,3 +149,39 @@ def test_factor_engine_stock_sharded_matches_single_device():
         # reduction-order drift to ~8e-9 relative even in f64
         np.testing.assert_allclose(out[k], base[k], rtol=1e-7, atol=1e-10,
                                    equal_nan=True, err_msg=k)
+
+
+def test_portfolio_bias_sharded_matches_single_device():
+    """portfolio_bias_stat under a date-sharded mesh == single device (the
+    einsums contract n and k; the t axis shards cleanly)."""
+    from mfm_tpu.models.bias import bias_std, portfolio_bias_stat
+
+    rng = np.random.default_rng(5)
+    T, N, K, Q = 64, 24, 6, 9
+    X = jnp.asarray(rng.standard_normal((T, N, K)))
+    dval = jnp.asarray(rng.random((T, N)) < 0.9)
+    A = rng.standard_normal((T, K, K))
+    covs = jnp.asarray(np.einsum("tik,tjk->tij", A, A) / K + np.eye(K) * 0.1)
+    cov_valid = jnp.asarray(rng.random(T) < 0.85)
+    spec = np.abs(rng.standard_normal((T, N))) * 0.02
+    spec[rng.random((T, N)) < 0.15] = np.nan
+    spec = jnp.asarray(spec)
+    ret = 0.02 * rng.standard_normal((T, N))
+    ret[rng.random((T, N)) < 0.1] = np.nan  # suspensions under sharding too
+    ret = jnp.asarray(ret)
+    weights = jnp.asarray(np.abs(rng.standard_normal((Q, N))))
+
+    bz, bok = portfolio_bias_stat(X, dval, covs, cov_valid, spec, ret, weights)
+    base = np.asarray(bias_std(bz, bok))
+
+    mesh = make_mesh(4, 2)
+    dsh = NamedSharding(mesh, P("date"))
+    sharded = [jax.device_put(v, dsh)
+               for v in (X, dval, covs, cov_valid, spec, ret)]
+
+    with jax.set_mesh(mesh):
+        z, ok = jax.jit(portfolio_bias_stat)(*sharded, weights)
+        got = np.asarray(bias_std(z, ok))
+
+    np.testing.assert_array_equal(np.asarray(ok), np.asarray(bok))
+    np.testing.assert_allclose(got, base, rtol=1e-9, equal_nan=True)
