@@ -145,6 +145,12 @@ def _risk(args):
     }))
 
 
+#: the three artifacts `prepare` writes and `factors --prepared` consumes
+PREPARED_PANEL = "panel.parquet"
+PREPARED_INDEX = "index_prices.csv"
+PREPARED_INDUSTRY = "industry_map.csv"
+
+
 def _read_long_table(path):
     """csv/parquet long table with a parsed trade_date column."""
     import pandas as pd
@@ -160,6 +166,25 @@ def _factors(args):
     from mfm_tpu.panel import Panel
     from mfm_tpu.pipeline import run_factor_pipeline
 
+    if args.prepared:
+        # consume a `prepare` output directory directly (its three
+        # artifacts have fixed names — no need to spell them out)
+        for flag, val in (("--panel", args.panel), ("--index", args.index),
+                          ("--industry", args.industry)):
+            if val:
+                raise SystemExit(f"--prepared already provides {flag}; "
+                                 "drop one of the two")
+        args.panel = os.path.join(args.prepared, PREPARED_PANEL)
+        args.index = os.path.join(args.prepared, PREPARED_INDEX)
+        args.industry = os.path.join(args.prepared, PREPARED_INDUSTRY)
+        missing = [p for p in (args.panel, args.index, args.industry)
+                   if not os.path.exists(p)]
+        if missing:
+            raise SystemExit(f"--prepared {args.prepared}: missing "
+                             f"artifact(s) {missing} (run `prepare` first)")
+    elif not (args.panel and args.index and args.industry):
+        raise SystemExit("pass either --prepared DIR or all of "
+                         "--panel/--index/--industry")
     panel_df = _read_long_table(args.panel)
     index_df = _read_long_table(args.index)
     ind_df = pd.read_csv(args.industry)
@@ -237,9 +262,9 @@ def _prepare(args):
         if c in out.columns:
             dtc = pd.to_datetime(out[c])
             out[c] = pd.to_numeric(dtc.dt.strftime("%Y%m%d"), errors="coerce")
-    panel_path = os.path.join(args.out, "panel.parquet")
-    index_path = os.path.join(args.out, "index_prices.csv")
-    industry_path = os.path.join(args.out, "industry_map.csv")
+    panel_path = os.path.join(args.out, PREPARED_PANEL)
+    index_path = os.path.join(args.out, PREPARED_INDEX)
+    industry_path = os.path.join(args.out, PREPARED_INDUSTRY)
     out.to_parquet(panel_path, index=False)
     index_px.to_csv(index_path, index=False)
     stocks = sorted(out["ts_code"].unique())
@@ -642,9 +667,12 @@ def main(argv=None):
     r.set_defaults(fn=_risk)
 
     f = sub.add_parser("factors", help="style-factor production (main.py path)")
-    f.add_argument("--panel", required=True, help="long csv/parquet of raw fields")
-    f.add_argument("--index", required=True, help="index daily prices csv/parquet")
-    f.add_argument("--industry", required=True, help="ts_code -> l1_code csv")
+    f.add_argument("--prepared", default=None, metavar="DIR",
+                   help="a `prepare` output directory (provides --panel/"
+                        "--index/--industry in one flag)")
+    f.add_argument("--panel", default=None, help="long csv/parquet of raw fields")
+    f.add_argument("--index", default=None, help="index daily prices csv/parquet")
+    f.add_argument("--industry", default=None, help="ts_code -> l1_code csv")
     f.add_argument("--out", default="results")
     f.add_argument("--dtype", default="float32")
     f.add_argument("--block", type=int, default=None,

@@ -80,6 +80,20 @@ def test_prepare_then_factors_chain(store_dir, tmp_path, capsys):
         assert col in barra.columns, col
     assert barra["stocknames"].nunique() == 16
 
+    # --prepared DIR is the same run in one flag
+    cli_main(["factors", "--prepared", prep_out,
+              "--out", str(tmp_path / "factors2")])
+    rec3 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    barra2 = pd.read_csv(rec3["out"])
+    pd.testing.assert_frame_equal(barra2, barra)
+
+    # conflicting / missing sources are rejected up front
+    with pytest.raises(SystemExit, match="--prepared already provides"):
+        cli_main(["factors", "--prepared", prep_out, "--panel", rec["panel"],
+                  "--out", fact_out])
+    with pytest.raises(SystemExit, match="pass either"):
+        cli_main(["factors", "--panel", rec["panel"], "--out", fact_out])
+
 
 def test_pipeline_to_store_risk_from_store_roundtrip(store_dir, tmp_path,
                                                      capsys):
@@ -167,3 +181,9 @@ def test_pipeline_portfolio_bias_flag(store_dir, tmp_path, capsys):
     rec = json.load(open(os.path.join(out, "portfolio_bias.json")))
     assert rec["n_portfolios"] == 5
     assert len(rec["all_valid_dates"]["bias"]) == 5
+
+
+def test_factors_prepared_missing_artifacts(tmp_path):
+    with pytest.raises(SystemExit, match="missing artifact"):
+        cli_main(["factors", "--prepared", str(tmp_path / "typo_dir"),
+                  "--out", str(tmp_path / "o")])
