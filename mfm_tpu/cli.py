@@ -537,6 +537,21 @@ def _etl_update(args):
     ``update_mongo_db.py:__main__`` chain (``:579-614``), against the
     parquet PanelStore with the same watermark/rate-limit/retry behavior."""
     from mfm_tpu.data.etl import IncrementalUpdater, PanelStore, RateLimiter
+
+    if args.dry_run:
+        # pre-flight plan from the store's watermarks alone: no token, no
+        # API call, no rate-limit budget spent
+        from mfm_tpu.data.etl import plan_update
+
+        print(json.dumps(plan_update(
+            PanelStore(args.store), args.start,
+            args.end or time.strftime("%Y%m%d"),
+            index_codes=[s.strip() for s in args.index_codes.split(",")],
+            statements=([s.strip() for s in args.statements.split(",")]
+                        if args.statements else ()),
+            components_date=args.components_date,
+            sw=not args.no_sw)))
+        return
     from mfm_tpu.data.tushare_source import TushareSource
 
     up = IncrementalUpdater(
@@ -827,6 +842,9 @@ def main(argv=None):
     eu.add_argument("--calls-per-min", type=int, default=480)
     eu.add_argument("--token", default=None,
                     help="tushare token (default: TUSHARE_TOKEN env)")
+    eu.add_argument("--dry-run", action="store_true",
+                    help="print the per-collection fetch plan (watermarks, "
+                         "ranges, call counts) without touching the API")
     eu.set_defaults(fn=_etl_update)
 
     ev = sub.add_parser("etl-verify",

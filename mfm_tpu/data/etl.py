@@ -495,6 +495,75 @@ class IncrementalUpdater:
         return summary
 
 
+def plan_update(store: PanelStore, start_date, end_date,
+                index_codes: Sequence[str] = ("000300.SH", "000016.SH",
+                                              "000903.SH"),
+                statements: Sequence[str] = ("balancesheet", "cashflow",
+                                             "income",
+                                             "financial_indicators"),
+                components_date=None, sw: bool = True) -> dict:
+    """Dry-run of :meth:`IncrementalUpdater.run_all`: what each step WOULD
+    fetch, derived from the store's watermarks alone — zero API calls.
+
+    The reference's updater spends a hard budget (480 calls/min, hours of
+    wall clock for a statement backfill, ``update_mongo_db.py:151-184``);
+    this is the pre-flight check before committing to it.  Mirrors
+    ``run_all``'s own step toggles (``components_date``/``sw``) so the plan
+    previews exactly the command it is a dry run of.  Returns, per
+    collection: the current watermark (or row count for full-refresh
+    collections), the planned fetch range, and whether it is already up to
+    date.
+    """
+    _next = IncrementalUpdater._next_day
+    start_s, end_s = str(start_date), str(end_date)
+
+    wm = store.last_date("daily_prices")
+    # run_all only walks the [start, end] trade calendar, so an old
+    # watermark never implies a pre-start backfill: clamp to start
+    daily_start = max(_next(wm), start_s) if wm is not None else start_s
+    n_codes = store.distinct_count("stock_info", "ts_code")
+    plan: dict = {
+        "range": [start_s, end_s],
+        "stock_info": {"rows": int(n_codes), "action": "full refresh"},
+        "daily_prices": {
+            "watermark": None if wm is None else str(wm),
+            "fetch_from": daily_start,
+            "up_to_date": daily_start > end_s,
+        },
+    }
+    plan["statements"] = {
+        k: {
+            # run_all refreshes stock_info FIRST, so an empty store means
+            # the universe (and the real call count) is unknown here, not 0
+            "per_stock_calls": int(n_codes) if n_codes else None,
+            **({} if n_codes else
+               {"note": "universe unknown until stock_info refreshes"}),
+            "range": [start_s, end_s],
+        }
+        for k in statements
+    }
+    have = store.read("index_daily_prices", columns=["ts_code", "trade_date"])
+    wms = (have.groupby("ts_code")["trade_date"].max().to_dict()
+           if len(have) else {})
+    idx = {}
+    for code in index_codes:
+        w = wms.get(code)
+        frm = _next(w) if w is not None else None
+        idx[code] = {"watermark": None if w is None else str(w),
+                     "fetch_from": frm,
+                     "up_to_date": frm is not None and str(frm) > end_s}
+    plan["index_daily_prices"] = idx
+    if components_date is not None:
+        plan["index_components"] = {
+            "date": str(components_date), "indexes": list(index_codes),
+            "action": "delete-then-insert refresh"}
+    if sw:
+        plan["sw_industries"] = {
+            "rows": int(store.distinct_count("sw_industries", "ts_code")),
+            "action": "full refresh"}
+    return plan
+
+
 def find_missing_stocks(store: PanelStore, universe_name="stock_info",
                         data_name="daily_prices", code_col="ts_code"):
     """Set-difference repair detection (``fill_missing_data.py:16-46``)."""

@@ -257,3 +257,72 @@ def test_etl_missing_fix_rejects_custom_collection(tmp_path):
     with pytest.raises(SystemExit, match="daily_prices"):
         cli_main(["etl-missing", "--store", str(tmp_path), "--fix",
                   "--name", "balancesheet", "--start", "20200101"])
+
+
+def test_plan_update_watermarks_and_dry_run_cli(tmp_path, capsys):
+    """plan_update reports watermark-derived fetch ranges with zero source
+    calls, and `etl-update --dry-run` needs no token at all."""
+    import json
+
+    import pandas as pd
+
+    from mfm_tpu.cli import main
+    from mfm_tpu.data.etl import PanelStore, plan_update
+
+    store = PanelStore(str(tmp_path / "store"))
+    store.insert("stock_info", pd.DataFrame({"ts_code": ["a", "b", "c"]}))
+    store.insert("daily_prices", pd.DataFrame({
+        "ts_code": ["a"], "trade_date": ["20240105"], "close": [1.0]}))
+    store.insert("index_daily_prices", pd.DataFrame({
+        "ts_code": ["000300.SH"], "trade_date": ["20240110"],
+        "close": [3000.0]}))
+
+    plan = plan_update(store, "20240101", "20240108",
+                       index_codes=["000300.SH", "000016.SH"])
+    assert plan["daily_prices"]["watermark"] == "20240105"
+    assert plan["daily_prices"]["fetch_from"] == "20240106"
+    assert plan["daily_prices"]["up_to_date"] is False
+    assert plan["statements"]["balancesheet"]["per_stock_calls"] == 3
+    idx = plan["index_daily_prices"]
+    assert idx["000300.SH"]["up_to_date"] is True   # wm past end_date
+    assert idx["000016.SH"]["watermark"] is None    # never fetched
+    assert plan["stock_info"] == {"rows": 3, "action": "full refresh"}
+
+    main(["etl-update", "--store", str(tmp_path / "store"),
+          "--start", "20240101", "--end", "20240108", "--dry-run"])
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["daily_prices"]["fetch_from"] == "20240106"
+
+
+def test_plan_update_clamps_and_mirrors_toggles(tmp_path, capsys):
+    import json
+
+    import pandas as pd
+
+    from mfm_tpu.cli import main
+    from mfm_tpu.data.etl import PanelStore, plan_update
+
+    store = PanelStore(str(tmp_path / "s"))
+    # stale watermark far before start: the real run never backfills
+    # pre-start days, so the plan must clamp fetch_from to start
+    store.insert("daily_prices", pd.DataFrame({
+        "ts_code": ["a"], "trade_date": ["20230105"], "close": [1.0]}))
+    plan = plan_update(store, "20240101", "20240131")
+    assert plan["daily_prices"]["fetch_from"] == "20240101"
+
+    # empty store: statement call counts are unknown, not zero
+    assert plan["statements"]["income"]["per_stock_calls"] is None
+    assert "universe unknown" in plan["statements"]["income"]["note"]
+
+    # step toggles mirror run_all's
+    assert "index_components" not in plan and "sw_industries" in plan
+    plan2 = plan_update(store, "20240101", "20240131",
+                        components_date="20240131", sw=False)
+    assert plan2["index_components"]["date"] == "20240131"
+    assert "sw_industries" not in plan2
+
+    main(["etl-update", "--store", str(tmp_path / "s"),
+          "--start", "20240101", "--end", "20240131", "--no-sw",
+          "--components-date", "20240131", "--dry-run"])
+    rec = json.loads(capsys.readouterr().out)
+    assert "sw_industries" not in rec and "index_components" in rec
