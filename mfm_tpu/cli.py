@@ -380,8 +380,15 @@ def _alpha(args):
         raise SystemExit(f"panel has no field {args.fwd_field!r} "
                          f"(have: {sorted(fields)})")
 
+    import contextlib
+    import sys
+
     exprs = []
-    with open(args.exprs) as fh:
+    # `--exprs -` reads stdin: the LLM-pipe workflow the title promises
+    # (generator | mfm-tpu alpha --exprs - --panel ...)
+    src = (contextlib.nullcontext(sys.stdin) if args.exprs == "-"
+           else open(args.exprs))
+    with src as fh:
         for i, line in enumerate(fh, 1):
             line = line.strip()
             if not line or line.startswith("#"):
@@ -495,6 +502,26 @@ def _crosscheck(args):
     if args.out:
         rep.to_csv(args.out)
     print(rep.to_json(orient="index"))
+    if args.gate is not None:
+        # CI-style agreement gate: any factor whose max |diff| over the
+        # overlap exceeds the gate (or that has NO overlap at all) fails
+        # the run with a named verdict on stderr.  An EMPTY comparison
+        # (no shared numeric factor columns) is also a failure — a gate
+        # that compared nothing must not pass
+        import sys
+
+        if not len(rep):
+            print("GATE FAIL: no shared numeric factor columns to compare",
+                  file=sys.stderr)
+            raise SystemExit(1)
+        bad = rep[(rep["n_overlap"] == 0)
+                  | ~(rep["max_abs_diff"] <= args.gate)]
+        if len(bad):
+            for name, row in bad.iterrows():
+                print(f"GATE FAIL {name}: n_overlap={int(row.n_overlap)} "
+                      f"max_abs_diff={row.max_abs_diff!r} > {args.gate}",
+                      file=sys.stderr)
+            raise SystemExit(1)
 
 
 def _require_matplotlib(flag: str):
@@ -766,7 +793,8 @@ def main(argv=None):
                         help="batch alpha-expression evaluation + scorecard "
                              "(BASELINE config 5)")
     al.add_argument("--exprs", required=True,
-                    help="text file, one expression per line (# = comment)")
+                    help="text file, one expression per line (# = comment); "
+                         "'-' reads stdin (pipe an LLM's output straight in)")
     al.add_argument("--panel", required=True,
                     help="long csv/parquet with ts_code/trade_date + fields")
     al.add_argument("--out", default="alpha_scores.csv")
@@ -800,6 +828,9 @@ def main(argv=None):
     c.add_argument("--date-col", default="trade_date")
     c.add_argument("--code-col", default="ts_code")
     c.add_argument("--out", default=None, help="write report CSV here")
+    c.add_argument("--gate", type=float, default=None, metavar="TOL",
+                   help="exit 1 if any factor's max |diff| over the overlap "
+                        "exceeds TOL or has no overlap (CI parity gate)")
     c.set_defaults(fn=_crosscheck)
 
     rp = sub.add_parser("report",

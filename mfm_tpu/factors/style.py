@@ -81,12 +81,28 @@ def compute_cmra(log_ret, cfg: FactorConfig = FactorConfig(), *, block=64):
 
 def compute_nlsize(size: jax.Array, valid=None) -> jax.Array:
     """NLSIZE: minus the residual of the per-date cross-sectional OLS of
-    SIZE^3 on SIZE (``factor_calculator.py:237-293``); needs >= 2 valid."""
-    def one(s, v):
-        return -masked_ols_residuals(s**3, s[:, None], v, min_valid=2)
+    SIZE^3 on SIZE (``factor_calculator.py:237-293``); needs >= 2 valid.
 
-    if valid is None:
-        valid = jnp.isfinite(size)
+    Computed in the centered basis: with m the cross-sectional mean and
+    z = SIZE - m, the 3*m^2*z + m^3 part of SIZE^3 lies in span{1, SIZE},
+    so resid(SIZE^3) = resid(z^3 + 3*m*z^2) — algebraically identical
+    (the golden parity test pins it), but the regressed magnitudes drop
+    from O(m^3) ~ 1e3 to O(1), which removes the catastrophic f32
+    cancellation of the raw form (measured ~0.19 absolute TPU-vs-CPU
+    drift on a 16-stock cross-section; centered ~1e-5).
+    """
+    def one(s, v):
+        n = jnp.sum(v)
+        m = jnp.sum(jnp.where(v, s, 0.0)) / jnp.maximum(n, 1)
+        z = jnp.where(v, s - m, 0.0)
+        y = z**3 + 3.0 * m * z**2
+        return -masked_ols_residuals(y, z[:, None], v, min_valid=2)
+
+    # intersect with finiteness so a caller mask that marks a NaN size as
+    # valid drops that row (as the raw form's internal isfinite did) rather
+    # than NaN-poisoning the whole date through the mean
+    valid = (jnp.isfinite(size) if valid is None
+             else valid & jnp.isfinite(size))
     return jax.vmap(one)(size, valid)
 
 

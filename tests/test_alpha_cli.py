@@ -81,3 +81,33 @@ def test_alpha_cli_syntax_error_and_missing_field_diagnostics(panel_csv,
     exprs.write_text("cs_rank(vwap)\n")
     with pytest.raises(SystemExit, match="exprs.txt:1.*vwap"):
         cli_main(["alpha", "--exprs", str(exprs), "--panel", panel_csv])
+
+
+def test_alpha_exprs_from_stdin(tmp_path, capsys, monkeypatch):
+    import io
+
+    from mfm_tpu.cli import main
+
+    rng = np.random.default_rng(8)
+    T, N = 30, 8
+    dates = pd.bdate_range("2024-01-02", periods=T)
+    stocks = [f"s{i}" for i in range(N)]
+    close = np.exp(np.cumsum(0.02 * rng.standard_normal((T, N)), axis=0))
+    pd.DataFrame({
+        "trade_date": np.repeat(dates, N),
+        "ts_code": np.tile(stocks, T),
+        "close": close.ravel(),
+        "ret": np.vstack([np.full((1, N), np.nan),
+                          close[1:] / close[:-1] - 1]).ravel(),
+    }).to_csv(tmp_path / "panel.csv", index=False)
+
+    monkeypatch.setattr("sys.stdin",
+                        io.StringIO("cs_rank(delta(close, 2))\n"
+                                    "# a comment\n"
+                                    "-ts_mean(ret, 3)\n"))
+    main(["--platform", "cpu", "alpha", "--exprs", "-",
+          "--panel", str(tmp_path / "panel.csv"),
+          "--out", str(tmp_path / "scores.csv")])
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["n_exprs"] == 2
+    assert len(pd.read_csv(tmp_path / "scores.csv")) == 2

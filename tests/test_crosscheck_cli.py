@@ -159,3 +159,52 @@ def test_etl_cli_verify_and_missing(tmp_path, capsys):
     main(["etl-missing", "--store", str(tmp_path / "store")])
     rep = json.loads(capsys.readouterr().out)
     assert rep == {"n_missing": 1, "missing": ["c"]}
+
+
+def test_crosscheck_gate(tmp_path, capsys):
+    from mfm_tpu.cli import main
+
+    a = pd.DataFrame({"trade_date": [20240102, 20240103],
+                      "ts_code": ["x", "x"],
+                      "size": [1.0, 2.0], "beta": [0.5, 0.6]})
+    b = a.copy()
+    b["beta"] = [0.5, 0.7]  # 0.1 off
+    pa, pb = str(tmp_path / "a.csv"), str(tmp_path / "b.csv")
+    a.to_csv(pa, index=False)
+    b.to_csv(pb, index=False)
+
+    # within tolerance: clean exit
+    main(["crosscheck", "--ours", pa, "--external", pb, "--gate", "0.2"])
+    capsys.readouterr()
+    # beyond tolerance: exit 1 naming the factor
+    with pytest.raises(SystemExit) as ei:
+        main(["crosscheck", "--ours", pa, "--external", pb, "--gate", "0.05"])
+    assert ei.value.code == 1
+    err = capsys.readouterr().err
+    assert "GATE FAIL beta" in err and "GATE FAIL size" not in err
+    # a factor with zero overlap must fail, not silently pass (NaN diff)
+    b2 = b.copy()
+    b2["size"] = np.nan
+    b2.to_csv(pb, index=False)
+    with pytest.raises(SystemExit):
+        main(["crosscheck", "--ours", pa, "--external", pb, "--gate", "1.0"])
+    assert "GATE FAIL size" in capsys.readouterr().err
+
+
+def test_crosscheck_gate_empty_comparison_fails(tmp_path, capsys):
+    from mfm_tpu.cli import main
+
+    a = pd.DataFrame({"trade_date": [20240102], "ts_code": ["x"],
+                      "size": [1.0]})
+    b = a.rename(columns={"size": "size_f"})
+    pa, pb = str(tmp_path / "a.csv"), str(tmp_path / "b.csv")
+    a.to_csv(pa, index=False)
+    b.to_csv(pb, index=False)
+    # without --gate: reports an empty comparison and exits 0
+    main(["crosscheck", "--ours", pa, "--external", pb])
+    capsys.readouterr()
+    # with --gate: a comparison of nothing must FAIL, not silently pass
+    with pytest.raises(SystemExit) as ei:
+        main(["crosscheck", "--ours", pa, "--external", pb, "--gate", "1.0"])
+    assert ei.value.code == 1
+    assert "no shared numeric factor columns" in capsys.readouterr().err

@@ -162,3 +162,23 @@ def test_cetop_ttm_semantics(setup):
         expect = np.where((mv > 0) & (expect_ttm > 0), expect_ttm / mv, np.nan)
         np.testing.assert_allclose(out["CETOP"][np.nonzero(sel)[0], n], expect,
                                    rtol=1e-9, atol=1e-12, equal_nan=True)
+
+
+def test_nlsize_caller_mask_with_nan_size_drops_row_only():
+    """A caller `valid` mask that marks a NaN size as valid must drop that
+    row (the raw form's internal isfinite behavior), not NaN-poison the
+    whole date through the centered-basis mean."""
+    import jax.numpy as jnp
+
+    from mfm_tpu.factors.style import compute_nlsize
+
+    rng = np.random.default_rng(0)
+    size = rng.normal(10.0, 1.0, (3, 8))
+    size[1, 2] = np.nan
+    sloppy_valid = jnp.ones((3, 8), bool)  # claims everything is valid
+
+    out = np.asarray(compute_nlsize(jnp.asarray(size), sloppy_valid))
+    clean = np.asarray(compute_nlsize(jnp.asarray(size)))  # derived mask
+    np.testing.assert_allclose(out, clean, rtol=1e-10, equal_nan=True)
+    assert np.isnan(out[1, 2])
+    assert np.isfinite(out[1, [0, 1, 3, 4, 5, 6, 7]]).all()
