@@ -1,0 +1,65 @@
+"""tools/tpu_parity.py: the hardware-parity gate tool itself, run hermetically
+on CPU at tiny shapes.  Same-backend captures must agree bitwise (the
+determinism half of the gate); the verdict must still flag the identical
+platforms so a mis-pinned run can never masquerade as hardware parity."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def tool():
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "tpu_parity.py")
+    spec = importlib.util.spec_from_file_location("tpu_parity_tool", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.parametrize("stage", ["risk", "factors"])
+def test_same_backend_capture_is_deterministic(tool, stage, tmp_path, capsys):
+    shape = ["--dates", "40", "--stocks", "12", "--industries", "3",
+             "--styles", "2", "--sims", "4", "--stage", stage,
+             "--platform", "cpu"]
+    a, b = str(tmp_path / "a.npz"), str(tmp_path / "b.npz")
+    tool.main(["run", "--out", a, *shape])
+    tool.main(["run", "--out", b, *shape])
+    capsys.readouterr()
+
+    with pytest.raises(SystemExit) as ei:
+        tool.main(["compare", a, b, "--gate", "1e-5"])
+    assert ei.value.code == 1  # identical platforms must fail the verdict
+    lines = [json.loads(x) for x in capsys.readouterr().out.splitlines()]
+    verdict = lines[-1]
+    assert verdict["failed"] == ["platforms:identical"]
+    assert verdict["platforms"] == ["cpu", "cpu"]
+    per_stage = {r["stage"]: r for r in lines[:-1]}
+    assert len(per_stage) >= 6  # both halves capture a real stage set
+    for name, rec in per_stage.items():
+        assert rec["max_rel"] == 0.0, (name, rec)  # bitwise same backend
+
+
+def test_incomparable_captures_rejected(tool, tmp_path, capsys):
+    shape = ["--dates", "30", "--stocks", "10", "--industries", "3",
+             "--styles", "2", "--sims", "4", "--platform", "cpu"]
+    a, b = str(tmp_path / "risk.npz"), str(tmp_path / "fac.npz")
+    tool.main(["run", "--out", a, *shape, "--stage", "risk"])
+    tool.main(["run", "--out", b, *shape, "--stage", "factors"])
+    capsys.readouterr()
+    with pytest.raises(SystemExit, match="incomparable"):
+        tool.main(["compare", a, b])
+
+
+def test_empty_stage_set_rejected(tool, tmp_path):
+    import numpy as np
+
+    a, b = str(tmp_path / "a.npz"), str(tmp_path / "b.npz")
+    np.savez(a, platform=np.array("tpu"))
+    np.savez(b, platform=np.array("cpu"))
+    with pytest.raises(SystemExit, match="nothing compared"):
+        tool.main(["compare", a, b])

@@ -10,6 +10,10 @@ then compare:
     python tools/tpu_parity.py run --platform cpu --out /tmp/parity_cpu.npz
     python tools/tpu_parity.py compare /tmp/parity_tpu.npz /tmp/parity_cpu.npz
 
+``run --stage factors`` captures the other half of the workload (the
+16-factor pipeline + post-processing on a synthetic market panel) with the
+same compare/gate machinery.
+
 (use ``--platform cpu``, not ``JAX_PLATFORMS=cpu``: a site hook that
 pre-registers the TPU plugin wins over the env var, and the compare would
 silently diff TPU against itself — the verdict line's ``platforms`` field is
@@ -46,14 +50,38 @@ def _run(args):
         # while f32 runs measure the fast path's precision drift
         jax.config.update("jax_enable_x64", True)
     import jax.numpy as jnp
+
+    T, N, P, Q, M = args.dates, args.stocks, args.industries, args.styles, args.sims
+    K = 1 + P + Q
+    dtype = jnp.float64 if args.x64 else jnp.float32
+
+    if args.stage == "factors":
+        # the OTHER half of the workload: the 16-factor pipeline + post
+        # (rolling kernels, row-space packing, cross-sectional post ops)
+        from mfm_tpu.config import FactorConfig
+        from mfm_tpu.data.synthetic import synthetic_market_panel
+        from mfm_tpu.factors.engine import FactorEngine
+
+        data = synthetic_market_panel(T=T, N=N, n_industries=P, seed=0)
+        fields = {k: jnp.asarray(v, dtype) for k, v in data.items()
+                  if k not in ("dates", "stocks", "industry", "index_close",
+                               "observed", "end_date_code")}
+        fields["end_date_code"] = jnp.asarray(data["end_date_code"])
+        eng = FactorEngine(fields, jnp.asarray(data["index_close"], dtype),
+                           config=FactorConfig())
+        out = eng.run()
+        np.savez_compressed(
+            args.out, platform=np.array(jax.devices()[0].platform),
+            **{k: np.asarray(v) for k, v in out.items()})
+        print(json.dumps({"platform": str(jax.devices()[0].platform),
+                          "stage": "factors", "out": args.out}))
+        return
+
     from mfm_tpu.config import RiskModelConfig
     from mfm_tpu.models.eigen import simulated_eigen_covs
     from mfm_tpu.models.risk_model import RiskModel
     from __graft_entry__ import _synthetic_risk_inputs
 
-    T, N, P, Q, M = args.dates, args.stocks, args.industries, args.styles, args.sims
-    K = 1 + P + Q
-    dtype = jnp.float64 if args.x64 else jnp.float32
     inputs = _synthetic_risk_inputs(T, N, P, Q, dtype=dtype, seed=0)
     cfg = RiskModelConfig(eigen_n_sims=M, eigen_sim_length=T)
     # identical draws on both backends: jax.random is backend-deterministic
@@ -82,7 +110,16 @@ def _run(args):
 
 def _compare(args):
     a, b = np.load(args.a), np.load(args.b)
-    stages = ["factor_ret", "r2", "nw_cov", "eigen_cov", "vr_cov", "lamb"]
+    # stage-agnostic: every saved array is a stage (validity masks are
+    # exact-matched below) — the same compare serves risk and factor runs
+    stages = sorted(k for k in a.files
+                    if k != "platform" and not k.endswith("_valid"))
+    if sorted(a.files) != sorted(b.files):
+        raise SystemExit(f"incomparable captures: {sorted(a.files)} vs "
+                         f"{sorted(b.files)}")
+    if not stages:
+        # a gate that compared nothing must not pass
+        raise SystemExit("no stage arrays in the captures — nothing compared")
     failed = []
     for name in stages:
         x, y = a[name], b[name]
@@ -99,7 +136,7 @@ def _compare(args):
         if rec["max_rel"] > args.gate:
             failed.append(name)
         print(json.dumps(rec))
-    for name in ("nw_valid", "eigen_valid"):
+    for name in (k for k in a.files if k.endswith("_valid")):
         if not (a[name] == b[name]).all():
             failed.append(name)
     plats = [str(a["platform"]), str(b["platform"])]
@@ -122,6 +159,10 @@ def main(argv=None):
     r.add_argument("--industries", type=int, default=31)
     r.add_argument("--styles", type=int, default=10)
     r.add_argument("--sims", type=int, default=40)
+    r.add_argument("--stage", choices=["risk", "factors"], default="risk",
+                   help="which half of the workload to capture: the risk "
+                        "covariance stack (default) or the 16-factor "
+                        "pipeline + post-processing")
     r.add_argument("--platform", default=None, metavar="cpu|tpu",
                    help="pin the JAX platform via the config API (the env "
                         "var loses to site hooks that pre-register a plugin)")
