@@ -193,14 +193,13 @@ def bench_beta(T=1390, N=300, label="csi300_beta_hsigma_wall"):
 def bench_factors():
     import jax.numpy as jnp
     from mfm_tpu.config import FactorConfig
-    from mfm_tpu.data.synthetic import synthetic_market_panel
+    from mfm_tpu.data.synthetic import (
+        panel_to_engine_fields, synthetic_market_panel,
+    )
     from mfm_tpu.factors.engine import FactorEngine
 
     data = synthetic_market_panel(T=1390, N=300, n_industries=31, seed=0)
-    fields = {k: jnp.asarray(v, jnp.float32) for k, v in data.items()
-              if k not in ("dates", "stocks", "industry", "index_close",
-                           "observed", "end_date_code")}
-    fields["end_date_code"] = jnp.asarray(data["end_date_code"])
+    fields = panel_to_engine_fields(data, jnp.float32)
     eng = FactorEngine(fields, jnp.asarray(data["index_close"], jnp.float32),
                        config=FactorConfig(), block=32)
 
@@ -229,7 +228,9 @@ def bench_alla():
     import jax
     import jax.numpy as jnp
     from mfm_tpu.config import FactorConfig, RiskModelConfig
-    from mfm_tpu.data.synthetic import synthetic_market_panel
+    from mfm_tpu.data.synthetic import (
+        panel_to_engine_fields, synthetic_market_panel,
+    )
     from mfm_tpu.factors.engine import (
         FactorEngine, rowspace_index, gather_rows, scatter_rows)
     from mfm_tpu.models.eigen import simulated_eigen_covs
@@ -239,10 +240,7 @@ def bench_alla():
     T, N, P, Q, M = 2500, 5000, 31, 10, 100
     K = 1 + P + Q
     data = synthetic_market_panel(T=T, N=N, n_industries=P, seed=1)
-    fields = {k: jnp.asarray(v, jnp.float32) for k, v in data.items()
-              if k not in ("dates", "stocks", "industry", "index_close",
-                           "observed", "end_date_code")}
-    fields["end_date_code"] = jnp.asarray(data["end_date_code"])
+    fields = panel_to_engine_fields(data, jnp.float32)
     index_close = jnp.asarray(data["index_close"], jnp.float32)
     industry = jnp.broadcast_to(
         jnp.asarray(data["industry"], jnp.int32)[None, :], (T, N))

@@ -431,7 +431,8 @@ def _alpha(args):
         from mfm_tpu.alpha.select import select_alphas
 
         sel = select_alphas(values, fwd, args.select,
-                            max_corr=args.max_corr, q=args.spread_q,
+                            max_corr=args.max_corr, min_score=args.min_ic,
+                            q=args.spread_q,
                             scores=np.abs(np.asarray(summary["mean_ic"])))
         score["selected"] = False
         score["select_rank"] = -1
@@ -809,6 +810,9 @@ def main(argv=None):
                          "stays under --max-corr")
     al.add_argument("--max-corr", type=float, default=0.7,
                     help="redundancy cap for --select")
+    al.add_argument("--min-ic", type=float, default=0.0,
+                    help="--select floor: candidates with |mean IC| below "
+                         "this never join, even under k")
     al.add_argument("--select-out", default=None, metavar="FILE.txt",
                     help="write the selected expressions here, one per line")
     al.add_argument("--values-out", default=None, metavar="FILE.parquet",

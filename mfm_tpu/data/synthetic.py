@@ -133,6 +133,25 @@ def synthetic_market_panel(
     }
 
 
+#: non-field metadata keys in a :func:`synthetic_market_panel` result
+PANEL_META_KEYS = ("dates", "stocks", "industry", "index_close", "observed",
+                   "end_date_code")
+
+
+def panel_to_engine_fields(data: Dict, dtype) -> Dict:
+    """The :class:`mfm_tpu.factors.engine.FactorEngine` field dict for a
+    :func:`synthetic_market_panel` result: float fields cast to ``dtype``,
+    the integer report id passed through untouched (one shared builder —
+    bench, the parity tool, and the tests must not each hand-maintain the
+    metadata exclusion list)."""
+    import jax.numpy as jnp
+
+    fields = {k: jnp.asarray(v, dtype) for k, v in data.items()
+              if k not in PANEL_META_KEYS}
+    fields["end_date_code"] = jnp.asarray(data["end_date_code"])
+    return fields
+
+
 def synthetic_collections(
     store,
     T: int = 120,

@@ -203,3 +203,29 @@ def test_greedy_select_invariant_random():
             assert abs(corr[loser, blocker]) > cap
         for i in sel:
             assert np.isfinite(scores[i])
+
+
+def test_alpha_cli_min_ic_fence(tmp_path, capsys):
+    from mfm_tpu.cli import main
+
+    rng = np.random.default_rng(6)
+    T, N = 60, 15
+    dates = pd.bdate_range("2024-01-02", periods=T)
+    stocks = [f"s{i}" for i in range(N)]
+    close = np.exp(np.cumsum(0.02 * rng.standard_normal((T, N)), axis=0))
+    pd.DataFrame({
+        "trade_date": np.repeat(dates, N),
+        "ts_code": np.tile(stocks, T),
+        "close": close.ravel(),
+        "ret": np.vstack([np.full((1, N), np.nan),
+                          close[1:] / close[:-1] - 1]).ravel(),
+    }).to_csv(tmp_path / "panel.csv", index=False)
+    (tmp_path / "e.txt").write_text("cs_rank(delta(close, 2))\n"
+                                    "-ts_mean(ret, 3)\n")
+    # an impossible floor selects nothing, even with k available
+    main(["--platform", "cpu", "alpha", "--exprs", str(tmp_path / "e.txt"),
+          "--panel", str(tmp_path / "panel.csv"),
+          "--out", str(tmp_path / "s.csv"), "--select", "2",
+          "--min-ic", "0.99"])
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["n_selected"] == 0

@@ -31,13 +31,10 @@ CFG = FactorConfig(
 def setup():
     data = synthetic_market_panel(T=130, N=25, n_industries=5, seed=3,
                                   missing=0.03, listing_gap=0.3)
-    fields = {
-        k: jnp.asarray(v)
-        for k, v in data.items()
-        if k not in ("dates", "stocks", "industry", "index_close", "observed",
-                     "end_date_code")
-    }
-    fields["end_date_code"] = jnp.asarray(data["end_date_code"])
+    from mfm_tpu.data.synthetic import panel_to_engine_fields
+
+    # default float dtype (f64 under the test conftest's x64 switch)
+    fields = panel_to_engine_fields(data, jnp.asarray(0.0).dtype)
     eng = FactorEngine(fields, jnp.asarray(data["index_close"]), config=CFG)
     out = {k: np.asarray(v) for k, v in eng.run(post_process=False).items()}
     return data, out

@@ -126,10 +126,9 @@ def test_factor_engine_stock_sharded_matches_single_device():
     # float64: sharding changes the reduction order of the cross-sectional
     # sums (NLSIZE's per-date OLS especially), which in f32 drifts ~1e-5 —
     # an arithmetic artifact, not a layout bug; f64 pins it to ~1e-13
-    fields = {k: jnp.asarray(v, jnp.float64) for k, v in data.items()
-              if k not in ("dates", "stocks", "industry", "index_close",
-                           "observed", "end_date_code")}
-    fields["end_date_code"] = jnp.asarray(data["end_date_code"])
+    from mfm_tpu.data.synthetic import panel_to_engine_fields
+
+    fields = panel_to_engine_fields(data, jnp.float64)
     idx_close = jnp.asarray(data["index_close"], jnp.float64)
 
     eng = FactorEngine(fields, idx_close, config=FactorConfig(), block=16)

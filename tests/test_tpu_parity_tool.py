@@ -55,11 +55,45 @@ def test_incomparable_captures_rejected(tool, tmp_path, capsys):
         tool.main(["compare", a, b])
 
 
-def test_empty_stage_set_rejected(tool, tmp_path):
+def test_truncated_capture_rejected(tool, tmp_path):
+    """A capture missing required stages must fail loudly — two truncated
+    files agreeing with each other is not parity."""
     import numpy as np
 
     a, b = str(tmp_path / "a.npz"), str(tmp_path / "b.npz")
+    # stage-less legacy files are risk captures by construction
     np.savez(a, platform=np.array("tpu"))
     np.savez(b, platform=np.array("cpu"))
-    with pytest.raises(SystemExit, match="nothing compared"):
+    with pytest.raises(SystemExit, match="missing stage"):
         tool.main(["compare", a, b])
+    # a subset capture (only factor_ret) must also fail, not gate 1 stage
+    np.savez(a, platform=np.array("tpu"), stage=np.array("risk"),
+             factor_ret=np.zeros((4, 3)))
+    np.savez(b, platform=np.array("cpu"), stage=np.array("risk"),
+             factor_ret=np.zeros((4, 3)))
+    with pytest.raises(SystemExit, match="missing stage"):
+        tool.main(["compare", a, b])
+
+
+def test_legacy_capture_compares_against_fresh_one(tool, tmp_path, capsys):
+    """A pre-marker (legacy) risk capture stays comparable with a fresh one
+    that carries the stage key; only genuinely different stages or data
+    sets are incomparable."""
+    import numpy as np
+
+    shape = ["--dates", "30", "--stocks", "10", "--industries", "3",
+             "--styles", "2", "--sims", "4", "--platform", "cpu"]
+    fresh, legacy = str(tmp_path / "fresh.npz"), str(tmp_path / "legacy.npz")
+    tool.main(["run", "--out", fresh, *shape])
+    with np.load(fresh) as f:
+        legacy_data = {k: f[k] for k in f.files if k != "stage"}
+    np.savez(legacy, **legacy_data)
+    capsys.readouterr()
+    with pytest.raises(SystemExit) as ei:
+        tool.main(["compare", fresh, legacy, "--gate", "1e-5"])
+    out = capsys.readouterr().out
+    import json
+    verdict = json.loads(out.splitlines()[-1])
+    # all stages compared (bitwise-equal data); only the same-platform
+    # tripwire fails — NOT an "incomparable captures" rejection
+    assert verdict["failed"] == ["platforms:identical"]

@@ -59,19 +59,19 @@ def _run(args):
         # the OTHER half of the workload: the 16-factor pipeline + post
         # (rolling kernels, row-space packing, cross-sectional post ops)
         from mfm_tpu.config import FactorConfig
-        from mfm_tpu.data.synthetic import synthetic_market_panel
+        from mfm_tpu.data.synthetic import (
+            panel_to_engine_fields, synthetic_market_panel,
+        )
         from mfm_tpu.factors.engine import FactorEngine
 
         data = synthetic_market_panel(T=T, N=N, n_industries=P, seed=0)
-        fields = {k: jnp.asarray(v, dtype) for k, v in data.items()
-                  if k not in ("dates", "stocks", "industry", "index_close",
-                               "observed", "end_date_code")}
-        fields["end_date_code"] = jnp.asarray(data["end_date_code"])
+        fields = panel_to_engine_fields(data, dtype)
         eng = FactorEngine(fields, jnp.asarray(data["index_close"], dtype),
                            config=FactorConfig())
         out = eng.run()
         np.savez_compressed(
             args.out, platform=np.array(jax.devices()[0].platform),
+            stage=np.array("factors"),
             **{k: np.asarray(v) for k, v in out.items()})
         print(json.dumps({"platform": str(jax.devices()[0].platform),
                           "stage": "factors", "out": args.out}))
@@ -95,6 +95,7 @@ def _run(args):
     np.savez_compressed(
         args.out,
         platform=np.array(jax.devices()[0].platform),
+        stage=np.array("risk"),
         factor_ret=np.asarray(out.factor_ret),
         r2=np.asarray(out.r2),
         nw_cov=np.asarray(out.nw_cov),
@@ -108,18 +109,50 @@ def _run(args):
                       "out": args.out}))
 
 
+#: every capture kind's full stage checklist — a capture missing any of
+#: these must fail loudly; a truncated pair agreeing with itself is not
+#: parity (risk keys: RiskModelOutputs; factors keys: FactorEngine.run()
+#: with the default FactorConfig)
+REQUIRED_STAGES = {
+    "risk": {"factor_ret", "r2", "nw_cov", "nw_valid", "eigen_cov",
+             "eigen_valid", "vr_cov", "lamb"},
+    "factors": {"SIZE", "BETA", "HSIGMA", "RSTR", "DASTD", "CMRA", "NLSIZE",
+                "BP", "STOM", "STOQ", "STOA", "CETOP", "ETOP", "YOYProfit",
+                "YOYSales", "MLEV", "DTOA", "BLEV", "volatility",
+                "liquidity", "earnings", "growth", "leverage", "ret",
+                "log_ret"},
+}
+
+
 def _compare(args):
     a, b = np.load(args.a), np.load(args.b)
-    # stage-agnostic: every saved array is a stage (validity masks are
+
+    def _kind(f):
+        # pre-marker captures are risk-stage by construction
+        return str(f["stage"]) if "stage" in f.files else "risk"
+
+    def _data_files(f):
+        # the marker is metadata, not a stage: a legacy capture (no marker)
+        # must stay comparable against a fresh one of the same kind
+        return sorted(k for k in f.files if k != "stage")
+
+    if _kind(a) != _kind(b):
+        raise SystemExit(f"incomparable captures: stage {_kind(a)} vs "
+                         f"{_kind(b)}")
+    if _data_files(a) != _data_files(b):
+        raise SystemExit(f"incomparable captures: {_data_files(a)} vs "
+                         f"{_data_files(b)}")
+    kind = _kind(a)
+    missing = REQUIRED_STAGES[kind] - set(a.files)
+    if missing:
+        # a gate over a truncated capture must not pass
+        raise SystemExit(f"{kind} capture is missing stage(s) "
+                         f"{sorted(missing)} — nothing gated")
+    # stage-agnostic diff: every saved array is a stage (validity masks are
     # exact-matched below) — the same compare serves risk and factor runs
     stages = sorted(k for k in a.files
-                    if k != "platform" and not k.endswith("_valid"))
-    if sorted(a.files) != sorted(b.files):
-        raise SystemExit(f"incomparable captures: {sorted(a.files)} vs "
-                         f"{sorted(b.files)}")
-    if not stages:
-        # a gate that compared nothing must not pass
-        raise SystemExit("no stage arrays in the captures — nothing compared")
+                    if k not in ("platform", "stage")
+                    and not k.endswith("_valid"))
     failed = []
     for name in stages:
         x, y = a[name], b[name]
