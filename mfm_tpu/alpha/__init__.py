@@ -4,7 +4,9 @@ The reference's title promises LLM-driven factors but contains none
 (SURVEY.md preamble); ``BASELINE.json`` config 5 makes batch evaluation of
 LLM-generated alpha expressions an explicit workload: parse candidate
 expressions into panel ops, evaluate them fused under one jit over the
-(T, N) panel, and score them (IC / rank-IC) against forward returns.
+(T, N) panel, score them (IC / rank-IC / turnover / quantile spread)
+against forward returns, and greedily select the top-k under a pairwise
+long-short-PnL correlation cap (:mod:`mfm_tpu.alpha.select`).
 """
 
 from mfm_tpu.alpha.dsl import (

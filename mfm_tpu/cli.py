@@ -2,7 +2,7 @@
 SURVEY.md §1 L7).
 
   python -m mfm_tpu.cli risk --barra barra_data.csv --out results/
-  python -m mfm_tpu.cli factors --panel panel.parquet --industry ind.csv --out results/
+  python -m mfm_tpu.cli factors --prepared prepared/ --out results/
   python -m mfm_tpu.cli demo --out results/          # synthetic end-to-end
   python -m mfm_tpu.cli pipeline --store data/ --out results/  # store -> risk
   python -m mfm_tpu.cli alpha --exprs alphas.txt --panel panel.csv
