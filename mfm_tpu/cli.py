@@ -344,8 +344,15 @@ def _pipeline(args):
         res = run_risk_pipeline(barra_df=barra, config=cfg,
                                 industry_codes=codes)
     _write_result_tables(res, args.out, args.specific_risk)
-    save_risk_outputs(os.path.join(args.out, "risk_outputs.npz"), res.outputs,
-                      meta={"source": args.store})
+    from mfm_tpu.pipeline import date_stamp
+
+    save_risk_outputs(
+        os.path.join(args.out, "risk_outputs.npz"), res.outputs,
+        meta={"source": args.store,
+              # identity stamp for load_risk_pipeline_result's cross-check
+              "dates": [date_stamp(res.arrays.dates[0]),
+                        date_stamp(res.arrays.dates[-1])],
+              "n_stocks": int(res.arrays.ret.shape[1])})
     wall = time.perf_counter() - t0
     # acceptance-test compute stays OUT of the reported wall (same policy
     # as _risk's bias block)

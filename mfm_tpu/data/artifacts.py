@@ -45,6 +45,24 @@ def save_risk_outputs(path: str, outputs, meta: dict | None = None):
     save_artifact(path, arrays, meta)
 
 
+def load_risk_outputs(path: str):
+    """Rehydrate a :func:`save_risk_outputs` artifact.
+
+    Returns ``(RiskModelOutputs, meta)`` — the inverse, so post-hoc
+    analytics (specific risk, portfolio risk, bias acceptance tests) can
+    run off a finished pipeline's artifact without recomputing the model.
+    """
+    from mfm_tpu.models.risk_model import RiskModelOutputs
+
+    arrays, meta = load_artifact(path)
+    missing = set(RiskModelOutputs._fields) - set(arrays)
+    if missing:
+        raise ValueError(f"{path}: not a risk-outputs artifact — missing "
+                         f"field(s) {sorted(missing)}")
+    return RiskModelOutputs(**{f: arrays[f]
+                               for f in RiskModelOutputs._fields}), meta
+
+
 def enable_compilation_cache(cache_dir: str | None = None):
     """Persist jitted executables across processes (the reference's analogue
     is nothing — every run recompiles pandas ops; here a second run of the

@@ -124,7 +124,10 @@ def run_factor_pipeline(
 class RiskPipelineResult:
     outputs: RiskModelOutputs
     arrays: BarraArrays
-    model: RiskModel
+    #: the fitted model, when this result came from a live run; None when
+    #: rehydrated from artifacts (:func:`load_risk_pipeline_result`) — every
+    #: result method works off outputs+arrays alone
+    model: RiskModel | None = None
     #: (half_life, ngroup, q, min_periods) -> (T, N) shrunk specific vol
     _spec_cache: dict = dataclasses.field(default_factory=dict, repr=False)
 
@@ -332,3 +335,59 @@ def run_risk_pipeline(
     )
     out = rm.run(sim_covs=sim_covs, sim_length=sim_length)
     return RiskPipelineResult(outputs=out, arrays=arrays, model=rm)
+
+
+def date_stamp(d) -> str:
+    """Calendar-day form of a date value, for artifact identity stamps
+    (normalizes datetime64-precision / CSV-string representation drift)."""
+    try:
+        return str(pd.Timestamp(d).date())
+    except (ValueError, TypeError):
+        return str(d)
+
+
+def load_risk_pipeline_result(out_dir: str,
+                              barra_csv: str = "barra_data.csv",
+                              npz: str = "risk_outputs.npz",
+                              industry_info: str = "industry_info.csv"):
+    """Rehydrate a finished ``pipeline`` output directory.
+
+    Reads the stage artifacts the ``pipeline`` subcommand writes (the barra
+    table, the one-hot code list, and the ``risk_outputs.npz``) back into a
+    :class:`RiskPipelineResult`, so post-hoc analytics — result tables,
+    :meth:`~RiskPipelineResult.specific_risk`,
+    :meth:`~RiskPipelineResult.portfolio_risk`,
+    :meth:`~RiskPipelineResult.portfolio_bias` — run without recomputing
+    the model (the reference's analogue is re-reading its result CSVs).
+    ``model`` is None on a rehydrated result.
+    """
+    import os
+
+    from mfm_tpu.data.artifacts import load_risk_outputs
+    from mfm_tpu.data.barra import load_barra_csv
+
+    outputs, meta = load_risk_outputs(os.path.join(out_dir, npz))
+    info_path = os.path.join(out_dir, industry_info)
+    arrays = load_barra_csv(
+        os.path.join(out_dir, barra_csv),
+        info_path if os.path.exists(info_path) else None)
+    if arrays.ret.shape != np.asarray(outputs.specific_ret).shape:
+        raise ValueError(
+            f"{out_dir}: barra table shape {arrays.ret.shape} does not match "
+            f"the artifact's {np.asarray(outputs.specific_ret).shape} — "
+            "mixed outputs from different runs?")
+    if np.asarray(outputs.factor_ret).shape[1] != len(arrays.factor_names()):
+        raise ValueError(
+            f"{out_dir}: the barra table implies "
+            f"{len(arrays.factor_names())} factors but the artifact holds "
+            f"{np.asarray(outputs.factor_ret).shape[1]} — industry_info.csv "
+            "missing or from a different run?")
+    # exact-identity stamp when the artifact carries one (cli.py writes
+    # first/last dates) — catches same-shape mixes the heuristics can't
+    stamp = meta.get("dates")
+    if stamp is not None:
+        have = [date_stamp(arrays.dates[0]), date_stamp(arrays.dates[-1])]
+        if have != [date_stamp(s) for s in stamp]:
+            raise ValueError(f"{out_dir}: barra table covers {have} but the "
+                             f"artifact was saved for {stamp}")
+    return RiskPipelineResult(outputs=outputs, arrays=arrays)

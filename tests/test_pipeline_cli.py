@@ -187,3 +187,43 @@ def test_factors_prepared_missing_artifacts(tmp_path):
     with pytest.raises(SystemExit, match="missing artifact"):
         cli_main(["factors", "--prepared", str(tmp_path / "typo_dir"),
                   "--out", str(tmp_path / "o")])
+
+
+def test_load_risk_pipeline_result_roundtrip(store_dir, tmp_path, capsys):
+    """A finished pipeline out dir rehydrates into a working result: same
+    tables, and the post-hoc acceptance tests run without the model."""
+    from mfm_tpu.pipeline import load_risk_pipeline_result
+
+    out = str(tmp_path / "res")
+    cli_main(["pipeline", "--store", store_dir, "--out", out,
+              "--eigen-sims", "8", "--start", "20200101"])
+    capsys.readouterr()
+
+    res = load_risk_pipeline_result(out)
+    assert res.model is None
+    fr_live = pd.read_csv(os.path.join(out, "factor_returns.csv"),
+                          index_col=0)
+    np.testing.assert_allclose(res.factor_returns().to_numpy(),
+                               fr_live.to_numpy(), rtol=2e-5, atol=1e-7,
+                               equal_nan=True)
+    # post-hoc analytics off the artifact alone
+    rep = res.portfolio_bias(n_portfolios=4, burn_in=20, min_periods=5)
+    assert len(rep["all_valid_dates"]["bias"]) == 4
+    raw, shrunk = res.specific_risk(min_periods=5)
+    assert shrunk.shape == res.specific_returns().shape
+
+
+def test_load_risk_pipeline_result_rejects_mismatched_dir(store_dir,
+                                                          tmp_path, capsys):
+    from mfm_tpu.pipeline import load_risk_pipeline_result
+
+    out = str(tmp_path / "res")
+    cli_main(["pipeline", "--store", store_dir, "--out", out,
+              "--eigen-sims", "4", "--start", "20200101"])
+    capsys.readouterr()
+    # swap in a barra table with a different universe
+    df = pd.read_csv(os.path.join(out, "barra_data.csv"))
+    df[df["stocknames"] != df["stocknames"].iloc[0]].to_csv(
+        os.path.join(out, "barra_data.csv"), index=False)
+    with pytest.raises(ValueError, match="does not match"):
+        load_risk_pipeline_result(out)
