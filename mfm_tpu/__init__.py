@@ -13,9 +13,13 @@ Layout
 - :mod:`mfm_tpu.factors`   — the 16 Barra sub-factors + post-processing + FactorEngine
 - :mod:`mfm_tpu.models`    — the risk model (cross-sectional WLS, Newey-West,
                              eigenfactor adjustment, vol-regime adjustment, bias stats)
-- :mod:`mfm_tpu.parallel`  — mesh construction and sharding specs
+- :mod:`mfm_tpu.parallel`  — mesh construction, sharding specs, multi-host helpers
 - :mod:`mfm_tpu.data`      — host-side IO: CSV/parquet loaders, point-in-time joins,
-                             synthetic data, optional Tushare/Mongo adapters
+                             synthetic data, incremental ETL, artifacts,
+                             optional Tushare/Mongo adapters
+- :mod:`mfm_tpu.alpha`     — alpha-expression DSL, batch evaluation, scoring,
+                             correlation-capped selection
+- :mod:`mfm_tpu.utils`     — observability, crosscheck, model-health report
 """
 
 from mfm_tpu.config import (
