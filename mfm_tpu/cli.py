@@ -45,6 +45,22 @@ def _maybe_portfolio_bias(res, args) -> None:
         json.dump(rep, fh, indent=1)
 
 
+def _save_outputs_npz(res, out: str, source) -> None:
+    """Persist every stage output (incl. the full covariance series) as one
+    identity-stamped artifact — one schema shared by ``risk`` and
+    ``pipeline`` so ``load_risk_pipeline_result``'s cross-check always sees
+    the same stamp keys."""
+    from mfm_tpu.data.artifacts import save_risk_outputs
+    from mfm_tpu.pipeline import date_stamp
+
+    save_risk_outputs(
+        os.path.join(out, "risk_outputs.npz"), res.outputs,
+        meta={"source": source,
+              "dates": [date_stamp(res.arrays.dates[0]),
+                        date_stamp(res.arrays.dates[-1])],
+              "n_stocks": int(res.arrays.ret.shape[1])})
+
+
 def _write_result_tables(res, out: str, specific_risk: bool) -> None:
     """The five demo.py result tables (``demo.py:60-94``) plus, beyond the
     reference, the USE4 specific-risk panel (EWMA vol, Bayes-shrunk;
@@ -113,6 +129,11 @@ def _risk(args):
     with _profile_ctx(args.profile):
         res = run_risk_pipeline(arrays=arrays, config=cfg)
     _write_result_tables(res, args.out, args.specific_risk)
+    if args.save_outputs:
+        # the full (T, K, K) covariance series + every stage output as one
+        # artifact (the CSV tables only carry the last date's covariance,
+        # demo.py:84-88) — same format the pipeline subcommand writes
+        _save_outputs_npz(res, args.out, args.barra or args.barra_store)
     wall = time.perf_counter() - t0
     # plotting stays outside the timed region (matplotlib import + render
     # would otherwise pollute the reported pipeline wall-clock)
@@ -283,7 +304,6 @@ def _pipeline(args):
     import numpy as np
     import pandas as pd
     from mfm_tpu.config import PipelineConfig, RiskModelConfig
-    from mfm_tpu.data.artifacts import save_risk_outputs
     from mfm_tpu.data.etl import PanelStore
     from mfm_tpu.data.prepare import prepare_factor_inputs
     from mfm_tpu.pipeline import run_factor_pipeline, run_risk_pipeline
@@ -344,15 +364,7 @@ def _pipeline(args):
         res = run_risk_pipeline(barra_df=barra, config=cfg,
                                 industry_codes=codes)
     _write_result_tables(res, args.out, args.specific_risk)
-    from mfm_tpu.pipeline import date_stamp
-
-    save_risk_outputs(
-        os.path.join(args.out, "risk_outputs.npz"), res.outputs,
-        meta={"source": args.store,
-              # identity stamp for load_risk_pipeline_result's cross-check
-              "dates": [date_stamp(res.arrays.dates[0]),
-                        date_stamp(res.arrays.dates[-1])],
-              "n_stocks": int(res.arrays.ret.shape[1])})
+    _save_outputs_npz(res, args.out, args.store)
     wall = time.perf_counter() - t0
     # acceptance-test compute stays OUT of the reported wall (same policy
     # as _risk's bias block)
@@ -709,6 +721,10 @@ def main(argv=None):
             raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
         return iv
 
+    r.add_argument("--save-outputs", action="store_true",
+                   help="also write OUT/risk_outputs.npz (every stage "
+                        "output incl. the full covariance series — the "
+                        "CSVs carry only the last date's)")
     r.add_argument("--portfolio-bias", type=_positive_int, default=None,
                    metavar="Q",
                    help="also run the USE4 random-portfolio bias acceptance "
