@@ -48,8 +48,10 @@ def _maybe_portfolio_bias(res, args) -> None:
 def _save_outputs_npz(res, out: str, source) -> None:
     """Persist every stage output (incl. the full covariance series) as one
     identity-stamped artifact — one schema shared by ``risk`` and
-    ``pipeline`` so ``load_risk_pipeline_result``'s cross-check always sees
-    the same stamp keys."""
+    ``pipeline`` so the stamp keys never drift between the two.  Load with
+    ``load_risk_outputs``; the full-result rehydration
+    (``load_risk_pipeline_result``) additionally needs the barra-table
+    stage artifacts only the ``pipeline`` subcommand writes."""
     from mfm_tpu.data.artifacts import save_risk_outputs
     from mfm_tpu.pipeline import date_stamp
 
@@ -129,12 +131,13 @@ def _risk(args):
     with _profile_ctx(args.profile):
         res = run_risk_pipeline(arrays=arrays, config=cfg)
     _write_result_tables(res, args.out, args.specific_risk)
+    wall = time.perf_counter() - t0
     if args.save_outputs:
         # the full (T, K, K) covariance series + every stage output as one
         # artifact (the CSV tables only carry the last date's covariance,
-        # demo.py:84-88) — same format the pipeline subcommand writes
+        # demo.py:84-88) — same format the pipeline subcommand writes.
+        # Outside the timed region, like the plotting below
         _save_outputs_npz(res, args.out, args.barra or args.barra_store)
-    wall = time.perf_counter() - t0
     # plotting stays outside the timed region (matplotlib import + render
     # would otherwise pollute the reported pipeline wall-clock)
     if args.bias_plot:
@@ -248,9 +251,8 @@ def _demo(args):
                          dtype=args.dtype)
     t0 = time.perf_counter()
     res = run_risk_pipeline(barra_df=df, config=cfg)
-    os.makedirs(args.out, exist_ok=True)
-    res.factor_returns().to_csv(os.path.join(args.out, "factor_returns.csv"))
-    res.final_covariance().to_csv(os.path.join(args.out, "final_covariance.csv"))
+    # all five demo.py result tables, like the risk/pipeline subcommands
+    _write_result_tables(res, args.out, specific_risk=False)
     rec = {"wall_s": round(time.perf_counter() - t0, 3), "out": args.out}
     if args.check_determinism:
         # the framework's sanitizer (SURVEY §5's race-detector analogue):
@@ -364,8 +366,8 @@ def _pipeline(args):
         res = run_risk_pipeline(barra_df=barra, config=cfg,
                                 industry_codes=codes)
     _write_result_tables(res, args.out, args.specific_risk)
-    _save_outputs_npz(res, args.out, args.store)
     wall = time.perf_counter() - t0
+    _save_outputs_npz(res, args.out, args.store)  # outside the timed region
     # acceptance-test compute stays OUT of the reported wall (same policy
     # as _risk's bias block)
     _maybe_portfolio_bias(res, args)

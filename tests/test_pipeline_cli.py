@@ -227,3 +227,20 @@ def test_load_risk_pipeline_result_rejects_mismatched_dir(store_dir,
         os.path.join(out, "barra_data.csv"), index=False)
     with pytest.raises(ValueError, match="does not match"):
         load_risk_pipeline_result(out)
+
+
+def test_risk_save_outputs_flag(tmp_path, capsys):
+    from mfm_tpu.data.artifacts import load_risk_outputs
+    from mfm_tpu.data.synthetic import synthetic_barra_table
+
+    df, _ = synthetic_barra_table(T=40, N=16, P=3, Q=2, seed=5)
+    barra = str(tmp_path / "b.csv")
+    df.to_csv(barra, index=False)
+    out = str(tmp_path / "res")
+    cli_main(["risk", "--barra", barra, "--out", out, "--eigen-sims", "4",
+          "--save-outputs"])
+    capsys.readouterr()
+    outputs, meta = load_risk_outputs(os.path.join(out, "risk_outputs.npz"))
+    assert outputs.vr_cov.shape[0] == 40  # FULL covariance series
+    assert meta["source"] == barra
+    assert len(meta["dates"]) == 2 and meta["n_stocks"] == 16
