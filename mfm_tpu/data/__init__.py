@@ -5,8 +5,16 @@
   and benches generate data with the same shape/missingness instead).
 - :mod:`mfm_tpu.data.barra` — load/save the reference's barra-format table
   (``result/barra_data_csi.csv`` schema) into dense risk-model arrays.
-- :mod:`mfm_tpu.data.pit` — statement dedup + point-in-time as-of joins
-  (``Barra_factor_cal/load_data.py`` contracts).
+- :mod:`mfm_tpu.data.pit` — statement dedup + point-in-time as-of joins +
+  per-stock statement QC (``Barra_factor_cal/load_data.py`` contracts).
+- :mod:`mfm_tpu.data.etl` — partitioned-parquet ``PanelStore`` + the
+  incremental updater surface (watermarks, rate limits, retries, plans).
+- :mod:`mfm_tpu.data.prepare` — store -> master factor-input panel
+  (``load_and_prepare_data`` path).
+- :mod:`mfm_tpu.data.artifacts` — stage-artifact checkpointing (npz +
+  schema stamp) and the compilation cache.
+- :mod:`mfm_tpu.data.mongo_store` — pymongo adapter with the PanelStore
+  interface (import-guarded).
 """
 
 from mfm_tpu.data.synthetic import synthetic_market_panel, synthetic_barra_table
