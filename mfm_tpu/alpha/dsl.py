@@ -11,8 +11,9 @@ op vocabulary in the WorldQuant-alpha style:
       cs_winsorize(x, k), cs_neutralize(x, group_field)
   time-series (per stock, trailing window):
       delay(x, d), delta(x, d), ts_mean(x, w), ts_std(x, w), ts_sum(x, w),
-      ts_min(x, w), ts_max(x, w), ts_rank(x, w), ts_corr(x, y, w),
-      ts_cov(x, y, w), ts_argmax(x, w), ts_argmin(x, w), decay_linear(x, w)
+      ts_product(x, w), ts_min(x, w), ts_max(x, w), ts_rank(x, w),
+      ts_corr(x, y, w), ts_cov(x, y, w), ts_argmax(x, w), ts_argmin(x, w),
+      decay_linear(x, w)
 
 All ops are NaN-masked (missing stays missing; windows require full validity
 for corr/rank, count>=1 elsewhere), static-shaped, and jit/vmap-friendly —
@@ -136,6 +137,13 @@ def ts_min(x, w):
 
 def ts_max(x, w):
     return _ts_reduce(x, w, lambda win, m: jnp.max(jnp.where(m, win, -jnp.inf), axis=1))
+
+
+def ts_product(x, w):
+    """Trailing-window product over valid entries (count >= 1 like ts_sum;
+    a cumprod-ratio formulation would 0/0 on zero values, so the window is
+    materialized like ts_min/ts_max)."""
+    return _ts_reduce(x, w, lambda win, m: jnp.prod(jnp.where(m, win, 1.0), axis=1))
 
 
 def ts_rank(x, w):
@@ -282,6 +290,7 @@ _OPS: Dict[str, Callable] = {
     "ts_sum": ts_sum,
     "ts_min": ts_min,
     "ts_max": ts_max,
+    "ts_product": ts_product,
     "ts_rank": ts_rank,
     "ts_corr": ts_corr,
     "ts_cov": ts_cov,

@@ -15,6 +15,8 @@
   schema stamp) and the compilation cache.
 - :mod:`mfm_tpu.data.mongo_store` — pymongo adapter with the PanelStore
   interface (import-guarded).
+- :mod:`mfm_tpu.data.tushare_source` — the Tushare Pro fetcher surface
+  (same 10 endpoints as the reference, token from env, injectable client).
 """
 
 from mfm_tpu.data.synthetic import synthetic_market_panel, synthetic_barra_table

@@ -47,7 +47,7 @@ def test_ts_ops_match_pandas(panel):
     close = np.asarray(panel["close"])
     out = evaluate_alphas(
         ["ts_mean(close, 5)", "ts_std(close, 5)", "delay(close, 3)",
-         "delta(close, 3)", "ts_sum(close, 5)"],
+         "delta(close, 3)", "ts_sum(close, 5)", "ts_product(ret + 1.0, 5)"],
         panel, jit=False,
     )
     df = pd.DataFrame(close)
@@ -64,6 +64,11 @@ def test_ts_ops_match_pandas(panel):
     np.testing.assert_allclose(np.asarray(out[4]),
                                df.rolling(5, min_periods=1).sum().to_numpy(),
                                rtol=1e-9, atol=1e-12, equal_nan=True)
+    grw = pd.DataFrame(np.asarray(panel["ret"]) + 1.0)
+    np.testing.assert_allclose(
+        np.asarray(out[5]),
+        grw.rolling(5, min_periods=1).apply(np.nanprod, raw=True).to_numpy(),
+        rtol=1e-9, atol=1e-12, equal_nan=True)
 
 
 def test_cs_rank_matches_pandas(panel):
