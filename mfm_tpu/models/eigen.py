@@ -40,7 +40,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from mfm_tpu.ops.eigh import _sweeps_for, batched_eigh
+from mfm_tpu.ops.eigh import (
+    _sweeps_for,
+    batched_eigh,
+    batched_eigh_weighted_diag,
+)
 
 from mfm_tpu.utils.prec import highest_matmul_precision
 
@@ -143,22 +147,23 @@ def eigen_risk_adjust_by_time(
     s = jnp.sqrt(jnp.maximum(D0, 0.0))
 
     # simulated covariances in F0's eigenbasis: G = diag(s) C_m diag(s), an
-    # elementwise scaling (module docstring, point 3).  The sim eighs never
-    # sort their eigenvector batch (sort=False skips a full HBM round trip
-    # over (T*M, K, K) on the Pallas path; the XLA fallback is ascending
-    # anyway and ignores the flag); pairing is restored below by sorting the
-    # scalar (Dm, Dm_hat) pairs.  Signs cancel in W*W.
-    G = s[:, None, :, None] * sim_covs[None] * s[:, None, None, :]
-    Dm, W = batched_eigh(G, prefer_pallas=prefer_pallas,
-                         canonical_signs=False, sort=False,
-                         sweeps=sim_sweeps)
+    # elementwise scaling (module docstring, point 3).  The sim eighs return
+    # only (eigenvalues, D0-weighted squared-eigenvector diagonals): the
+    # Pallas path reduces W against D0 inside the kernel, so the (T*M, K, K)
+    # eigenvector batch never round-trips HBM and no separate einsum pass
+    # reads it back; pairing is restored below by sorting the scalar
+    # (Dm, Dm_hat) pairs.  Signs square away in W*W.
     # D_hat = diag(U_m' F0 U_m) with U_m = U0 W  ->  sum_k W_ki^2 D0_k
-    Dm_hat = jnp.einsum("tmki,tk->tmi", W * W, D0)
+    G = s[:, None, :, None] * sim_covs[None] * s[:, None, None, :]
+    Dm, Dm_hat = batched_eigh_weighted_diag(
+        G, D0[:, None, :], prefer_pallas=prefer_pallas, sweeps=sim_sweeps)
     # rank pairing, order-invariant across backends: i-th smallest sim
-    # eigenvalue pairs with the i-th smallest D0 (D0 is already ascending)
-    order = jnp.argsort(Dm, axis=-1)
-    Dm = jnp.take_along_axis(Dm, order, axis=-1)
-    Dm_hat = jnp.take_along_axis(Dm_hat, order, axis=-1)
+    # eigenvalue pairs with the i-th smallest D0 (D0 is already ascending).
+    # One variadic key-value sort: ~3x cheaper on TPU than argsort + two
+    # take_along_axis gathers over the same (T, M, K) tensors (measured
+    # 0.15 s at CSI300 scale); is_stable matches jnp.argsort's tie order.
+    Dm, Dm_hat = jax.lax.sort((Dm, Dm_hat), dimension=-1, num_keys=1,
+                              is_stable=True)
     # A numerically-zero sim eigenvalue (rank-deficient covariance: D0_k = 0
     # zeroes G's k-th row/column, and LAPACK/Jacobi may emit 0 or -eps there)
     # would make the ratio 0/0 or a huge spurious value — substitute ratio 1

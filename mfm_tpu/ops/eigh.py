@@ -179,6 +179,22 @@ def eigh_small(A, *, use_jacobi: bool | None = None, canonical_signs=True):
     return w, V
 
 
+def _resolve_prefer_pallas(A, prefer_pallas: bool | None) -> bool:
+    """Shared backend dispatch for the batched eigh entry points.
+
+    Mosaic has no 64-bit support, so f64 (x64 parity runs,
+    ``tools/tpu_parity.py --x64``) always takes XLA's emulated-f64 eigh;
+    otherwise the Pallas kernel is preferred on TPU for even n <= 128.
+    """
+    n = A.shape[-1]
+    if A.dtype == jnp.float64:
+        return False
+    if prefer_pallas is None:
+        platform = jax.devices()[0].platform
+        return platform in ("tpu", "axon") and n % 2 == 0 and n <= 128
+    return prefer_pallas
+
+
 def batched_eigh(A, *, prefer_pallas: bool | None = None,
                  canonical_signs: bool = True, sort: bool = True,
                  sweeps: int | None = None):
@@ -194,15 +210,7 @@ def batched_eigh(A, *, prefer_pallas: bool | None = None,
     XLA/LAPACK fallback (CPU, or odd/large n) always solves to full
     precision and silently ignores it.
     """
-    n = A.shape[-1]
-    if A.dtype == jnp.float64:
-        # Mosaic has no 64-bit support; x64 parity runs (tools/tpu_parity.py
-        # --x64) take XLA's emulated-f64 eigh on TPU instead
-        prefer_pallas = False
-    if prefer_pallas is None:
-        platform = jax.devices()[0].platform
-        prefer_pallas = platform in ("tpu", "axon") and n % 2 == 0 and n <= 128
-    if prefer_pallas:
+    if _resolve_prefer_pallas(A, prefer_pallas):
         from mfm_tpu.ops.eigh_pallas import jacobi_eigh_tpu
 
         flat = A.reshape((-1,) + A.shape[-2:])
@@ -213,3 +221,33 @@ def batched_eigh(A, *, prefer_pallas: bool | None = None,
     if canonical_signs:
         return canonicalize_signs(w, V)
     return w, V
+
+
+def batched_eigh_weighted_diag(A, d0, *, prefer_pallas: bool | None = None,
+                               sweeps: int | None = None):
+    """Eigenvalues plus D0-weighted squared-eigenvector diagonal, batched.
+
+    Returns ``(w, h)`` with ``h_i = sum_k V_ki^2 d0_k`` for symmetric
+    ``A`` (..., n, n) and weights ``d0`` (..., n) — the eigenfactor
+    Monte-Carlo's consumer shape (``D_hat = diag(U_m' F0 U_m)``,
+    ``Barra-master/mfm/utils.py:83``, collapsed into the eigenbasis).
+
+    On the TPU Pallas path the reduction is fused into the Jacobi kernel, so
+    the (..., n, n) eigenvector batch never round-trips HBM; elsewhere it is
+    ``eigh`` + einsum.  Slot order differs between the paths (original-index
+    vs ascending) exactly as for ``batched_eigh(sort=False)`` — (w_i, h_i)
+    pairing is consistent either way, and callers rank-pair by sorting the
+    two small outputs.
+    """
+    n = A.shape[-1]
+    if _resolve_prefer_pallas(A, prefer_pallas):
+        from mfm_tpu.ops.eigh_pallas import jacobi_eigh_weighted_diag_tpu
+
+        flat = A.reshape((-1,) + A.shape[-2:])
+        dflat = jnp.broadcast_to(d0, A.shape[:-1]).reshape(-1, n)
+        w, h = jacobi_eigh_weighted_diag_tpu(flat, dflat, sweeps=sweeps)
+        return w.reshape(A.shape[:-1]), h.reshape(A.shape[:-1])
+    w, V = jnp.linalg.eigh(A)
+    h = jnp.einsum("...ki,...k->...i", V * V,
+                   jnp.broadcast_to(d0, A.shape[:-1]))
+    return w, h

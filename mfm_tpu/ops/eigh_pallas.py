@@ -101,7 +101,7 @@ def _make_kernel(n: int, sweeps: int, dtype):
         v = jnp.stack(vcols, axis=1)
         return (x, v)
 
-    def kernel(a_ref, w_ref, v_ref):
+    def _decompose(a_ref):
         x = a_ref[0]                          # (n, n, L)
         i3 = jax.lax.broadcasted_iota(jnp.int32, (n, n, LANES), 0)
         j3 = jax.lax.broadcasted_iota(jnp.int32, (n, n, LANES), 1)
@@ -109,14 +109,43 @@ def _make_kernel(n: int, sweeps: int, dtype):
         # move into the interleaved basis
         x = perm_cols(perm_rows(x, b0), b0)
         v = perm_cols(v, b0)
+        return jax.lax.fori_loop(0, sweeps * (n - 1), one_round, (x, v))
 
-        x, v = jax.lax.fori_loop(0, sweeps * (n - 1), one_round, (x, v))
-
+    def kernel(a_ref, w_ref, v_ref):
+        x, v = _decompose(a_ref)
         # emit in original index order (see inv above)
         w_ref[0] = jnp.stack([x[inv[i], inv[i]] for i in range(n)])  # (n, L)
         v_ref[0] = jnp.stack([v[:, inv[i]] for i in range(n)], axis=1)
 
-    return kernel
+    def weighted_kernel(a_ref, d_ref, w_ref, h_ref):
+        # Same decomposition, but instead of writing the (n, n, L) eigenvector
+        # block back to HBM, reduce it against the per-matrix weight vector d
+        # in VMEM: h_i = sum_k V_ki^2 d_k.  v's ROWS stay in original index
+        # order throughout (only columns rotate/permute), so d — supplied in
+        # original order — broadcasts directly; column slot j is mapped back
+        # to original index order through inv, exactly like w.
+        x, v = _decompose(a_ref)
+        d = d_ref[0]                          # (n, L), original index order
+        hsum = jnp.sum(v * v * d[:, None, :], axis=0)   # (n, L) per slot
+        w_ref[0] = jnp.stack([x[inv[i], inv[i]] for i in range(n)])
+        h_ref[0] = jnp.stack([hsum[inv[i]] for i in range(n)])
+
+    return kernel, weighted_kernel
+
+
+def _pack_lanes(x: jax.Array):
+    """(B, ...) -> ((nb, ..., LANES) with batch in the lane dim, nb)."""
+    B = x.shape[0]
+    nb = -(-B // LANES)
+    xp = jnp.pad(x, ((0, nb * LANES - B),) + ((0, 0),) * (x.ndim - 1))
+    xp = xp.reshape((nb, LANES) + x.shape[1:])
+    return jnp.moveaxis(xp, 1, -1), nb
+
+
+def _unpack_lanes(x: jax.Array, B: int):
+    """Inverse of :func:`_pack_lanes` for a (nb, ..., LANES) output."""
+    xp = jnp.moveaxis(x, -1, 1)
+    return xp.reshape((-1,) + xp.shape[2:])[:B]
 
 
 @functools.partial(jax.jit, static_argnames=("sweeps", "canonical_signs",
@@ -143,13 +172,9 @@ def jacobi_eigh_tpu(A: jax.Array, sweeps: int | None = None,
     dtype = A.dtype
     if sweeps is None:
         sweeps = _sweeps_for(n, dtype)
-    nb = -(-B // LANES)
-    pad = nb * LANES - B
-    Ap = jnp.pad(A, ((0, pad), (0, 0), (0, 0)))
-    # (nb*L, n, n) -> (nb, n, n, L): batch into lanes
-    Ax = Ap.reshape(nb, LANES, n, n).transpose(0, 2, 3, 1)
+    Ax, nb = _pack_lanes(A)  # (nb, n, n, L): batch into lanes
 
-    kernel = _make_kernel(n, sweeps, dtype)
+    kernel, _ = _make_kernel(n, sweeps, dtype)
     w, V = pl.pallas_call(
         kernel,
         grid=(nb,),
@@ -168,8 +193,8 @@ def jacobi_eigh_tpu(A: jax.Array, sweeps: int | None = None,
         interpret=interpret,
     )(Ax)
 
-    w = w.transpose(0, 2, 1).reshape(nb * LANES, n)[:B]
-    V = V.transpose(0, 3, 1, 2).reshape(nb * LANES, n, n)[:B]
+    w = _unpack_lanes(w, B)
+    V = _unpack_lanes(V, B)
     if sort:
         order = jnp.argsort(w, axis=-1)
         w = jnp.take_along_axis(w, order, axis=-1)
@@ -177,3 +202,57 @@ def jacobi_eigh_tpu(A: jax.Array, sweeps: int | None = None,
     if canonical_signs:
         w, V = canonicalize_signs(w, V)
     return w, V
+
+
+@functools.partial(jax.jit, static_argnames=("sweeps", "interpret"))
+def jacobi_eigh_weighted_diag_tpu(A: jax.Array, d0: jax.Array,
+                                  sweeps: int | None = None,
+                                  interpret: bool = False):
+    """Fused eigenvalues + weighted eigenvector diagonal: (w, h) with
+    ``h_i = sum_k V_ki^2 d0_k`` for symmetric (B, n, n) ``A`` and per-matrix
+    weights ``d0`` (B, n).
+
+    This is the eigenfactor Monte-Carlo's consumer shape (models/eigen.py):
+    the bias statistic needs only the simulated eigenvalues and the
+    D0-weighted squared eigenvector columns (``D_hat = diag(U_m' F0 U_m)``,
+    ``Barra-master/mfm/utils.py:83``), never the eigenvectors themselves.
+    Reducing V against d0 inside the kernel skips the (B, n, n) eigenvector
+    HBM writeout and the separate XLA einsum pass over it entirely.
+
+    Slot order follows the matrix's ORIGINAL index order (same contract as
+    ``jacobi_eigh_tpu(sort=False)``); (w_i, h_i) pairing is always
+    consistent, so rank-based callers sort the two (B, n) outputs only.
+    """
+    B, n, _ = A.shape
+    assert n % 2 == 0, "pallas path requires even n"
+    assert d0.shape == (B, n), (d0.shape, (B, n))  # one weight vector per matrix
+    dtype = A.dtype
+    if sweeps is None:
+        sweeps = _sweeps_for(n, dtype)
+    Ax, nb = _pack_lanes(A)
+    dx, _ = _pack_lanes(d0)                                 # (nb, n, L)
+
+    _, kernel = _make_kernel(n, sweeps, dtype)
+    w, h = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((1, n, n, LANES), lambda b: (b, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, LANES), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n, LANES), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, LANES), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nb, n, LANES), dtype),
+            jax.ShapeDtypeStruct((nb, n, LANES), dtype),
+        ],
+        interpret=interpret,
+    )(Ax, dx)
+
+    return _unpack_lanes(w, B), _unpack_lanes(h, B)

@@ -202,3 +202,51 @@ def test_production_sim_sweeps_deep_tier_accuracy():
     wf = np.sort(np.asarray(wf), axis=-1)
     w7 = np.sort(np.asarray(w7), axis=-1)
     assert np.abs(wf - w7).max() <= 1e-5 * np.abs(w7).max()
+
+
+def test_weighted_diag_kernel_matches_full_kernel_plus_einsum():
+    """The fused (w, h) kernel must reproduce the unfused path exactly: same
+    rotations, h computed from the same in-VMEM V that jacobi_eigh_tpu would
+    have written out (models/eigen.py's Dm_hat consumer)."""
+    from mfm_tpu.ops.eigh_pallas import (
+        jacobi_eigh_tpu,
+        jacobi_eigh_weighted_diag_tpu,
+    )
+
+    rng = np.random.default_rng(11)
+    n, B = 8, 5
+    X = rng.standard_normal((B, 16, n)).astype(np.float32)
+    A = jnp.asarray(np.einsum("bnk,bnl->bkl", X, X) / 16)
+    d0 = jnp.asarray(np.abs(rng.standard_normal((B, n))).astype(np.float32))
+
+    w_ref, V_ref = jacobi_eigh_tpu(A, canonical_signs=False, sort=False,
+                                   interpret=True)
+    h_ref = jnp.einsum("bki,bk->bi", V_ref * V_ref, d0)
+    w, h = jacobi_eigh_weighted_diag_tpu(A, d0, interpret=True)
+    np.testing.assert_array_equal(np.asarray(w), np.asarray(w_ref))
+    np.testing.assert_allclose(np.asarray(h), np.asarray(h_ref),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_batched_eigh_weighted_diag_fallback_matches_loopy():
+    """The non-Pallas dispatcher path (CPU / f64): eigh + einsum vs a loopy
+    per-matrix NumPy computation, including batch-broadcast d0."""
+    from mfm_tpu.ops.eigh import batched_eigh_weighted_diag
+
+    rng = np.random.default_rng(12)
+    T, M, n = 3, 4, 6
+    X = rng.standard_normal((T, M, 12, n))
+    A = np.einsum("tmnk,tmnl->tmkl", X, X) / 12
+    d0 = np.abs(rng.standard_normal((T, n)))
+
+    w, h = batched_eigh_weighted_diag(
+        jnp.asarray(A), jnp.asarray(d0)[:, None, :], prefer_pallas=False)
+    for t in range(T):
+        for m in range(M):
+            wr, Vr = np.linalg.eigh(A[t, m])
+            hr = (Vr**2 * d0[t][:, None]).sum(axis=0)
+            order = np.argsort(np.asarray(w[t, m]))
+            np.testing.assert_allclose(np.asarray(w[t, m])[order], wr,
+                                       rtol=1e-10, atol=1e-12)
+            np.testing.assert_allclose(np.asarray(h[t, m])[order], hr,
+                                       rtol=1e-8, atol=1e-10)

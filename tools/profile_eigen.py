@@ -1,0 +1,75 @@
+"""Split the eigen stage's wall into its internal parts on the current backend."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from mfm_tpu.models.eigen import simulated_eigen_covs, sim_sweeps_for
+from mfm_tpu.ops.eigh import batched_eigh, batched_eigh_weighted_diag, _sweeps_for
+
+T, N, K, M = 1390, 300, 42, 100
+dtype = jnp.float32
+key = jax.random.key(0)
+X = jax.random.normal(key, (T, 200, K), dtype)
+covs = jnp.einsum("tnk,tnl->tkl", X, X) / 200
+valid = jnp.ones((T,), bool)
+sim_covs = simulated_eigen_covs(jax.random.key(1), K, T, M, dtype)
+sweeps = sim_sweeps_for(K, dtype, T)
+print("sim sweeps:", sweeps, "full:", _sweeps_for(K, dtype))
+
+
+def force(x):
+    return float(np.asarray(jnp.sum(jnp.where(jnp.isfinite(x), x, 0.0))))
+
+
+def t3(fn, *args):
+    force(fn(*args))
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        force(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+@jax.jit
+def f0_eigh(c):
+    D0, U0 = batched_eigh(c)
+    return jnp.sum(D0) + jnp.sum(U0)
+
+
+@jax.jit
+def g_form(c, sc):
+    D0, U0 = batched_eigh(c)
+    s = jnp.sqrt(jnp.maximum(D0, 0.0))
+    G = s[:, None, :, None] * sc[None] * s[:, None, None, :]
+    return jnp.sum(G)
+
+
+@jax.jit
+def sim_eigh(c, sc):
+    # the production consumer shape: fused (Dm, Dm_hat), no W materialized
+    D0, U0 = batched_eigh(c)
+    s = jnp.sqrt(jnp.maximum(D0, 0.0))
+    G = s[:, None, :, None] * sc[None] * s[:, None, None, :]
+    Dm, Dm_hat = batched_eigh_weighted_diag(G, D0[:, None, :], sweeps=sweeps)
+    return jnp.sum(Dm) + jnp.sum(Dm_hat)
+
+
+@jax.jit
+def full(c, v, sc):
+    from mfm_tpu.models.eigen import eigen_risk_adjust_by_time
+    out, ok = eigen_risk_adjust_by_time(c, v, sc, sim_length=T)
+    return jnp.sum(jnp.where(jnp.isfinite(out), out, 0.0))
+
+
+print("f0_eigh        :", round(t3(f0_eigh, covs), 4))
+print("  +G_form      :", round(t3(g_form, covs, sim_covs), 4))
+print("  +sim_eigh    :", round(t3(sim_eigh, covs, sim_covs), 4))
+print("full stage     :", round(t3(full, covs, valid, sim_covs), 4))
