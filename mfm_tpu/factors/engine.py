@@ -80,8 +80,12 @@ class FactorEngine:
     index_close: jax.Array
     config: FactorConfig = dataclasses.field(default_factory=FactorConfig)
     #: rolling date-block size; None = auto from the panel width
-    #: (ops/rolling.py::auto_block)
+    #: (ops/rolling.py::auto_block).  Only used by the "block" impl.
     block: int | None = None
+    #: rolling-kernel implementation: "scan" (O(T*N) two-level chunked
+    #: scans, the default) or "block" (windowed gathers, the reference
+    #: formulation; memory bounded by ``block``)
+    rolling_impl: str = "scan"
 
     def __post_init__(self):
         if self.block is None:
@@ -98,13 +102,15 @@ class FactorEngine:
         factors = tuple(factors or self.config.factors_to_run)
         fn = partial(
             _run_jit, config=self.config, block=self.block,
-            factors=factors, post_process=post_process,
+            impl=self.rolling_impl, factors=factors,
+            post_process=post_process,
         )
         return fn(self.fields, self.index_close)
 
 
-@partial(jax.jit, static_argnames=("config", "block", "factors", "post_process"))
-def _run_jit(fields, index_close, *, config, block, factors, post_process):
+@partial(jax.jit, static_argnames=("config", "block", "impl", "factors",
+                                   "post_process"))
+def _run_jit(fields, index_close, *, config, block, impl, factors, post_process):
     f = fields
     cfg = config
     close = f["close"]
@@ -133,21 +139,22 @@ def _run_jit(fields, index_close, *, config, block, factors, post_process):
             out["SIZE"] = style.compute_size(f["total_mv"])
         elif name == "BETA":
             beta, hsigma = style.compute_beta_hsigma(
-                rs_ret, rs_market, cfg, block=block
+                rs_ret, rs_market, cfg, block=block, impl=impl
             )
             out["BETA"] = scatter_rows(beta, idx)
             out["HSIGMA"] = scatter_rows(hsigma, idx)
         elif name == "RSTR":
             out["RSTR"] = scatter_rows(
-                style.compute_rstr(rs_logret, cfg, block=block), idx
+                style.compute_rstr(rs_logret, cfg, block=block, impl=impl), idx
             )
         elif name == "DASTD":
             out["DASTD"] = scatter_rows(
-                style.compute_dastd(rs_ret, rs_market, cfg, block=block), idx
+                style.compute_dastd(rs_ret, rs_market, cfg, block=block,
+                                    impl=impl), idx
             )
         elif name == "CMRA":
             out["CMRA"] = scatter_rows(
-                style.compute_cmra(rs_logret, cfg, block=block), idx
+                style.compute_cmra(rs_logret, cfg, block=block, impl=impl), idx
             )
         elif name == "NLSIZE":
             out["NLSIZE"] = style.compute_nlsize(jnp.log(f["total_mv"]))
@@ -155,7 +162,8 @@ def _run_jit(fields, index_close, *, config, block, factors, post_process):
             out["BP"] = style.compute_bp(f["pb"])
         elif name == "LIQUIDITY":
             rs_turn = gather_rows(f["turnover_rate"], idx)
-            for k, v in style.compute_liquidity(rs_turn, cfg, block=block).items():
+            for k, v in style.compute_liquidity(rs_turn, cfg, block=block,
+                                                 impl=impl).items():
                 out[k] = scatter_rows(v, idx)
         elif name == "EARNINGS":
             rs_cash = gather_rows(f["n_cashflow_act"], idx)

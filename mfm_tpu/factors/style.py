@@ -32,18 +32,20 @@ def compute_size(total_mv: jax.Array) -> jax.Array:
     return jnp.log(total_mv)
 
 
-def compute_beta_hsigma(ret, market_ret, cfg: FactorConfig = FactorConfig(), *, block=64):
+def compute_beta_hsigma(ret, market_ret, cfg: FactorConfig = FactorConfig(), *,
+                        block=64, impl="scan"):
     """BETA/HSIGMA: rolling WLS slope + residual std
     (``factor_calculator.py:79-125``)."""
     s = cfg.beta
     return rolling_beta_hsigma(
         ret, market_ret,
         window=s.window, half_life=s.half_life, min_periods=s.min_periods,
-        block=block,
+        block=block, impl=impl,
     )
 
 
-def compute_rstr(log_ret, cfg: FactorConfig = FactorConfig(), *, block=64):
+def compute_rstr(log_ret, cfg: FactorConfig = FactorConfig(), *,
+                 block=64, impl="scan"):
     """RSTR momentum: lagged, head-aligned decay-weighted mean of log returns
     (``factor_calculator.py:127-153``).  The L-day skip is a shift along the
     stock's own row sequence (``x.shift(L)``)."""
@@ -56,11 +58,12 @@ def compute_rstr(log_ret, cfg: FactorConfig = FactorConfig(), *, block=64):
     return rolling_decay_weighted_mean(
         shifted,
         window=window, half_life=cfg.rstr_half_life,
-        min_periods=cfg.rstr_min_periods, block=block,
+        min_periods=cfg.rstr_min_periods, block=block, impl=impl,
     )
 
 
-def compute_dastd(ret, market_ret, cfg: FactorConfig = FactorConfig(), *, block=64):
+def compute_dastd(ret, market_ret, cfg: FactorConfig = FactorConfig(), *,
+                  block=64, impl="scan"):
     """DASTD: exp-weighted std of excess returns
     (``factor_calculator.py:155-196``)."""
     if market_ret.ndim == 1:
@@ -69,14 +72,16 @@ def compute_dastd(ret, market_ret, cfg: FactorConfig = FactorConfig(), *, block=
     return rolling_weighted_std(
         ret - market_ret,
         window=s.window, half_life=s.half_life, min_periods=s.min_periods,
-        block=block,
+        block=block, impl=impl,
     )
 
 
-def compute_cmra(log_ret, cfg: FactorConfig = FactorConfig(), *, block=64):
+def compute_cmra(log_ret, cfg: FactorConfig = FactorConfig(), *,
+                 block=64, impl="scan"):
     """CMRA: cumulative-return range over a fully-observed window
     (``factor_calculator.py:199-234``)."""
-    return rolling_cmra(log_ret, window=cfg.cmra_window, block=block)
+    return rolling_cmra(log_ret, window=cfg.cmra_window, block=block,
+                        impl=impl)
 
 
 def compute_nlsize(size: jax.Array, valid=None) -> jax.Array:
@@ -111,14 +116,16 @@ def compute_bp(pb: jax.Array) -> jax.Array:
     return jnp.where(pb > 0, 1.0 / pb, jnp.nan)
 
 
-def compute_liquidity(turnover_rate, cfg: FactorConfig = FactorConfig(), *, block=64):
+def compute_liquidity(turnover_rate, cfg: FactorConfig = FactorConfig(), *,
+                      block=64, impl="scan"):
     """STOM/STOQ/STOA: log rolling sums of daily turnover (percent/100),
     zero sums -> NaN before the log (``factor_calculator.py:324-367``)."""
     dtv = turnover_rate / 100.0
     out = {}
     for name, spec in (("STOM", cfg.stom), ("STOQ", cfg.stoq), ("STOA", cfg.stoa)):
         base = rolling_sum(
-            dtv, window=spec.window, min_periods=spec.min_periods, block=block
+            dtv, window=spec.window, min_periods=spec.min_periods,
+            block=block, impl=impl,
         )
         out[name] = jnp.log(jnp.where(base == 0.0, jnp.nan, base))
     return out

@@ -111,6 +111,132 @@ def rolling_reduce(
 
 
 # ---------------------------------------------------------------------------
+# two-level (chunked prefix/suffix) windowed reductions — O(T*N) scans
+# ---------------------------------------------------------------------------
+#
+# The block path above materializes (block, window, N) gathers: O(T*W*N) work
+# and HBM traffic.  Every kernel's reduction is an associative sum/max over a
+# trailing window, optionally with geometric weights, so it has an exact
+# O(T*N) form: split the date axis into chunks of C = window rows; a trailing
+# window [t-W+1, t] then spans at most the chunk containing t and the one
+# before it, and
+#
+#     S_t = prefix(chunk q, ..r)  +  suffix(chunk q-1, r+1..)
+#
+# — two in-chunk scans (cumsum/cummax) plus an elementwise combine.  Geometric
+# weights stay exact because they are *separable*:
+#
+# - tail-aligned-after-dropna (BETA/DASTD): weight(j, t) = decay**(# valid in
+#   (j, t]) = decay**(v_t - v_j) with v the running valid count — separable in
+#   *event time*;
+# - head-aligned (RSTR): weight(j, t) ∝ (1/decay)**(t - j) up to a constant
+#   per-window factor that the renormalization cancels — separable in
+#   *calendar time*.
+#
+# Exponents are rebased per chunk (rel = expo - expo[chunk start]), so every
+# intermediate weight is bounded by decay**(-C): at the reference's
+# window/half-life pairs (252/63, 252/42, 483/126) that is at most ~2**6 —
+# no overflow, no catastrophic cancellation, and the accumulation spans at
+# most 2*W terms, the same precision regime as the block path.
+
+
+def _chunked(x: jax.Array, C: int):
+    """Pad the date axis to a multiple of C and reshape to (nc, C, ...)."""
+    T = x.shape[0]
+    nc = -(-T // C)
+    xp = jnp.pad(x, ((0, nc * C - T),) + ((0, 0),) * (x.ndim - 1))
+    return xp.reshape((nc, C) + x.shape[1:]), nc
+
+
+def _prev_chunk_suffix(B: jax.Array, fill=0.0):
+    """Map in-chunk suffix scans B[q, s] = reduce(chunk q rows s..) to
+    Bsh[q, r] = B[q-1, r+1] (the previous chunk's contribution to the window
+    ending at row r of chunk q), with the reduction's identity element
+    ``fill`` (0 for sums, -inf for max) at missing positions."""
+    Bprev = jnp.concatenate([jnp.full_like(B[:1], fill), B[:-1]], axis=0)
+    return jnp.concatenate(
+        [Bprev[:, 1:], jnp.full_like(Bprev[:, :1], fill)], axis=1
+    )
+
+
+def _check_impl(impl: str) -> bool:
+    """Validate the rolling-kernel impl switch; True for the scan path."""
+    if impl not in ("scan", "block"):
+        raise ValueError(f"impl must be 'scan' or 'block', got {impl!r}")
+    return impl == "scan"
+
+
+def windowed_sum_scan(term: jax.Array, window: int) -> jax.Array:
+    """Trailing-window sums of ``term`` (T, N; invalid entries pre-zeroed) in
+    O(T*N): exact two-level chunked prefix/suffix form."""
+    T = term.shape[0]
+    ch, _ = _chunked(term, window)
+    A = jax.lax.cumsum(ch, axis=1)
+    B = jax.lax.cumsum(ch, axis=1, reverse=True)
+    out = A + _prev_chunk_suffix(B)
+    return out.reshape((-1,) + term.shape[1:])[:T]
+
+
+def decay_windowed_sums_scan(
+    terms: Sequence[jax.Array],
+    window: int,
+    expo: jax.Array,
+    decay,
+) -> list[jax.Array]:
+    """Trailing-window geometric-weighted sums, O(T*N) per term.
+
+    Returns, for each (T, N) ``term`` (invalid entries pre-zeroed),
+    ``S_t = sum_{j in [t-window+1, t]} decay**(expo_t - expo_j) * term_j``.
+
+    ``expo`` is (T, N) or (T, 1), nondecreasing along the date axis: the
+    running valid count for event-time (tail-aligned) weights, or
+    ``arange(T)`` for calendar-time weights.  ``decay`` may exceed 1 (the
+    head-aligned case uses 1/decay).  Exponents are rebased per chunk, so
+    every power is bounded by the within-chunk expo range (<= window steps).
+    """
+    C = window
+    T = terms[0].shape[0]
+    dtype = terms[0].dtype
+    lam = jnp.asarray(decay, dtype)
+    # edge-pad expo (zero-padding would put huge rebased exponents in the
+    # padded tail rows; they are never consumed, but inf*0 NaNs would ride
+    # the reverse cumsum into real rows of the last chunk's suffix)
+    nc = -(-T // C)
+    ep = jnp.pad(expo.astype(dtype), ((0, nc * C - T),) + ((0, 0),) * (expo.ndim - 1),
+                 mode="edge")
+    ch_e = ep.reshape((nc, C) + expo.shape[1:])
+    e0 = ch_e[:, :1]                               # chunk-start expo
+    rel = ch_e - e0                                # >= 0, bounded by chunk range
+    # next chunk's start expo; the last chunk's suffix is never consumed, any
+    # finite value works there
+    e0n = jnp.concatenate([e0[1:], ch_e[-1:, -1:]], axis=0)
+    wdn = lam ** (-rel)                            # prefix weights
+    wup = lam ** (e0n - ch_e)                      # suffix weights (to next e0)
+    scale = lam ** rel
+    outs = []
+    for term in terms:
+        ch, _ = _chunked(term, C)
+        A = jax.lax.cumsum(wdn * ch, axis=1)
+        B = jax.lax.cumsum(wup * ch, axis=1, reverse=True)
+        S = scale * (A + _prev_chunk_suffix(B))
+        outs.append(S.reshape((-1,) + term.shape[1:])[:T])
+    return outs
+
+
+def windowed_max_scan(x: jax.Array, window: int) -> jax.Array:
+    """Trailing-window running max of ``x`` (T, N; invalid entries pre-set to
+    -inf) in O(T*N), two-level chunked cummax."""
+    T = x.shape[0]
+    # zero-padded tail rows only reach sliced-off prefix positions and the
+    # never-consumed last chunk's suffix, so they cannot win any real max
+    ch, _ = _chunked(x, window)
+    A = jax.lax.cummax(ch, axis=1)
+    B = jax.lax.cummax(ch, axis=1, reverse=True)
+    out = jnp.maximum(A, _prev_chunk_suffix(B, fill=-jnp.inf))
+    return out.reshape((-1,) + x.shape[1:])[:T]
+
+
+# ---------------------------------------------------------------------------
 # factor kernels
 # ---------------------------------------------------------------------------
 
@@ -123,6 +249,7 @@ def rolling_beta_hsigma(
     half_life: int = 63,
     min_periods: int = 42,
     block: int = 64,
+    impl: str = "scan",
 ):
     """Closed-form rolling WLS of stock returns on market returns.
 
@@ -132,12 +259,45 @@ def rolling_beta_hsigma(
     with the *unnormalized* tail-aligned weights (``factor_calculator.py:97-102``).
 
     ret: (T, N); market_ret: (T,) or (T, N).  Returns (beta, hsigma), (T, N).
+
+    ``impl="scan"`` (default) computes the six weighted moments with the
+    O(T*N) two-level event-time scans (weights are separable, module
+    comment); ``"block"`` is the windowed-gather reference path.  HSIGMA's
+    residual sum on the scan path uses the normal-equation identity
+    ``ssr = syy - alpha*sy - beta*sxy`` (exact for the WLS solution) instead
+    of materializing per-window residuals.  The identity cancels when
+    R^2 -> 1: measured float32 drift vs the f64 reference (pinned by
+    ``tests/test_rolling.py::test_scan_float32_drift``) is median ~3e-7 /
+    max ~2e-4 for BETA and HSIGMA, the max occurring only on an
+    index-tracker-like stock (R^2 ~ 0.999) whose HSIGMA is itself near
+    zero; the block path's explicit residuals stay ~6e-7 there.  The f64
+    parity contract is unaffected (both paths are ~1e-15 in f64).
     """
     T, N = ret.shape
     dtype = ret.dtype
     if market_ret.ndim == 1:
         market_ret = jnp.broadcast_to(market_ret[:, None], (T, N))
     lam = decay_rate(half_life, dtype)
+
+    if _check_impl(impl):
+        valid = jnp.isfinite(ret) & jnp.isfinite(market_ret)
+        m = valid.astype(dtype)
+        yz = jnp.where(valid, ret, 0.0)
+        xz = jnp.where(valid, market_ret, 0.0)
+        v = jnp.cumsum(m, axis=0)  # event-time: weight = lam**(v_t - v_j)
+        sw, sx, sy, sxx, sxy, syy = decay_windowed_sums_scan(
+            [m, xz * m, yz * m, xz * xz * m, xz * yz * m, yz * yz * m],
+            window, v, lam,
+        )
+        n = windowed_sum_scan(m, window)
+        denom = sw * sxx - sx * sx
+        beta = (sw * sxy - sx * sy) / denom
+        alpha = (sy - beta * sx) / sw
+        ssr = syy - alpha * sy - beta * sxy
+        scale = jnp.maximum(ssr, 0.0) / (n - 2)  # clamp moment-form rounding
+        ok = n >= min_periods
+        nan = jnp.asarray(jnp.nan, dtype)
+        return jnp.where(ok, beta, nan), jnp.where(ok, jnp.sqrt(scale), nan)
 
     def reducer(y, x):
         valid = jnp.isfinite(y) & jnp.isfinite(x)
@@ -173,12 +333,29 @@ def rolling_weighted_std(
     half_life: int = 42,
     min_periods: int = 42,
     block: int = 64,
+    impl: str = "scan",
 ):
     """DASTD kernel: exp-weighted std with tail-aligned renormalized weights
     (``factor_calculator.py:166-180``): weighted mean, then weighted central
-    second moment, sqrt."""
+    second moment, sqrt.
+
+    The scan path uses the moment identity ``var = s2/sw - mu**2`` (the
+    renormalization cancels, so unnormalized event-time sums suffice)."""
     dtype = x.dtype
     lam = decay_rate(half_life, dtype)
+
+    if _check_impl(impl):
+        valid = jnp.isfinite(x)
+        m = valid.astype(dtype)
+        xz = jnp.where(valid, x, 0.0)
+        v = jnp.cumsum(m, axis=0)
+        sw, s1, s2 = decay_windowed_sums_scan(
+            [m, xz * m, xz * xz * m], window, v, lam)
+        mu = s1 / sw
+        var = jnp.maximum(s2 / sw - mu * mu, 0.0)
+        n = windowed_sum_scan(m, window)
+        return jnp.where(n >= min_periods, jnp.sqrt(var),
+                         jnp.asarray(jnp.nan, dtype))
 
     def reducer(w):
         valid = jnp.isfinite(w)
@@ -200,13 +377,30 @@ def rolling_decay_weighted_mean(
     half_life: int,
     min_periods: int,
     block: int = 64,
+    impl: str = "scan",
 ):
     """RSTR kernel: sum of head-aligned decay weights (renormalized over valid)
     times the windowed series (``factor_calculator.py:136-142``).  Weight at
     window position p is ``decay**p`` — see module docstring for why this is
-    exact for short early windows too."""
+    exact for short early windows too.
+
+    The scan path uses calendar-time weights ``(1/decay)**(t-j)``, which
+    differ from position weights by a constant per-window factor that the
+    renormalization cancels."""
     dtype = x.dtype
     lam = decay_rate(half_life, dtype)
+
+    if _check_impl(impl):
+        valid = jnp.isfinite(x)
+        m = valid.astype(dtype)
+        xz = jnp.where(valid, x, 0.0)
+        t_idx = jnp.arange(x.shape[0], dtype=dtype)[:, None]
+        num, den = decay_windowed_sums_scan(
+            [xz * m, m], window, t_idx, 1.0 / lam)
+        n = windowed_sum_scan(m, window)
+        return jnp.where(n >= min_periods, num / den,
+                         jnp.asarray(jnp.nan, dtype))
+
     wpos = lam ** jnp.arange(window, dtype=dtype)  # (W,) head-aligned
 
     def reducer(w):
@@ -226,10 +420,18 @@ def rolling_sum(
     window: int,
     min_periods: int,
     block: int = 64,
+    impl: str = "scan",
 ):
     """NaN-skipping rolling sum with a min_periods gate — the liquidity base
     (``factor_calculator.py:346-350``)."""
     dtype = x.dtype
+
+    if _check_impl(impl):
+        valid = jnp.isfinite(x)
+        m = valid.astype(dtype)
+        s = windowed_sum_scan(jnp.where(valid, x, 0.0), window)
+        n = windowed_sum_scan(m, window)
+        return jnp.where(n >= min_periods, s, jnp.asarray(jnp.nan, dtype))
 
     def reducer(w):
         valid = jnp.isfinite(w)
@@ -245,12 +447,29 @@ def rolling_cmra(
     *,
     window: int = 252,
     block: int = 64,
+    impl: str = "scan",
 ):
     """CMRA kernel: log(1+max Z) - log(1+min Z) with Z the cumulative-return
     path over the window; requires a fully valid window
     (``factor_calculator.py:206-219`` — pandas only calls the reducer when all
-    ``window`` observations are present)."""
+    ``window`` observations are present).
+
+    The scan path uses the algebraic collapse of the reference formula: with
+    ``Z_j = exp(sum log_ret) - 1``, ``log1p(Z_j)`` IS the windowed cumulative
+    log return, so CMRA = (windowed max - windowed min) of the global
+    log-return prefix path — the window base and any shifts from dates
+    outside the (fully valid) window cancel in the range."""
     dtype = log_ret.dtype
+
+    if _check_impl(impl):
+        valid = jnp.isfinite(log_ret)
+        m = valid.astype(dtype)
+        prefix = jnp.cumsum(jnp.where(valid, log_ret, 0.0), axis=0)
+        big = jnp.where(valid, prefix, -jnp.inf)
+        small = jnp.where(valid, -prefix, -jnp.inf)
+        rng = windowed_max_scan(big, window) + windowed_max_scan(small, window)
+        n = windowed_sum_scan(m, window)
+        return jnp.where(n >= window, rng, jnp.asarray(jnp.nan, dtype))
 
     def reducer(w):
         valid = jnp.isfinite(w)

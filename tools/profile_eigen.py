@@ -1,9 +1,6 @@
 """Split the eigen stage's wall into its internal parts on the current backend."""
 import os
 import sys
-import time
-
-import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -24,18 +21,9 @@ sweeps = sim_sweeps_for(K, dtype, T)
 print("sim sweeps:", sweeps, "full:", _sweeps_for(K, dtype))
 
 
-def force(x):
-    return float(np.asarray(jnp.sum(jnp.where(jnp.isfinite(x), x, 0.0))))
-
-
-def t3(fn, *args):
-    force(fn(*args))
-    ts = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        force(fn(*args))
-        ts.append(time.perf_counter() - t0)
-    return min(ts)
+# bench.py owns the tunnel-aware timing helpers (block_until_ready does not
+# block on this TPU tunnel; timings must force a scalar host transfer)
+from bench import _force as force, _time3 as t3  # noqa: E402
 
 
 @jax.jit
