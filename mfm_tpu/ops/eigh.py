@@ -251,3 +251,39 @@ def batched_eigh_weighted_diag(A, d0, *, prefer_pallas: bool | None = None,
     h = jnp.einsum("...ki,...k->...i", V * V,
                    jnp.broadcast_to(d0, A.shape[:-1]))
     return w, h
+
+
+def pinv_psd(G: jax.Array, *, rcond: float | None = None,
+             prefer_pallas: bool | None = None) -> jax.Array:
+    """Moore-Penrose pseudo-inverse of symmetric PSD-up-to-roundoff batches.
+
+    For symmetric input, SVD-based ``pinv`` (the reference's
+    ``np.linalg.pinv``, ``Barra-master/mfm/CrossSection.py:76``) equals the
+    eigendecomposition form ``V diag(1/w where |w| > cut) V'`` with
+    ``cut = rcond * max|w|`` — but the eigh rides the Pallas Jacobi kernel
+    on TPU instead of XLA's iterative SVD.  ``rcond`` defaults to JAX's
+    ``pinv`` default (``10 * n * eps``) so this is a drop-in replacement.
+
+    Odd n is padded to even with an isolated diagonal entry c = trace/n:
+    ``pinv(blockdiag(G, c)) = blockdiag(pinv(G), 1/c)`` exactly, and for PSD
+    G, ``trace/n`` lies in ``[lambda_max/n, lambda_max]`` so it neither
+    raises the cutoff nor gets discarded by it.
+    """
+    n = G.shape[-1]
+    dtype = G.dtype
+    if rcond is None:
+        rcond = 10.0 * n * float(jnp.finfo(dtype).eps)
+    pad = n % 2 == 1
+    if pad:
+        tr = jnp.trace(G, axis1=-2, axis2=-1) / n
+        Gp = jnp.zeros(G.shape[:-2] + (n + 1, n + 1), dtype)
+        Gp = Gp.at[..., :n, :n].set(G)
+        G = Gp.at[..., n, n].set(tr)
+    w, V = batched_eigh(G, prefer_pallas=prefer_pallas,
+                        canonical_signs=False)
+    cut = rcond * jnp.max(jnp.abs(w), axis=-1, keepdims=True)
+    inv_w = jnp.where(jnp.abs(w) > cut, 1.0 / jnp.where(w == 0, 1.0, w), 0.0)
+    out = jnp.einsum("...ik,...k,...jk->...ij", V, inv_w, V)
+    if pad:
+        out = out[..., :n, :n]
+    return out

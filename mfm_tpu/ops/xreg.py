@@ -29,6 +29,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from mfm_tpu.ops.eigh import pinv_psd
 from mfm_tpu.ops.masked import masked_var, zscore_cap_weighted
 
 from mfm_tpu.utils.prec import highest_matmul_precision
@@ -125,6 +126,30 @@ def cross_section_regress(
       n_industries: P (static).  P=0 runs the no-industry branch
                 (``CrossSection.py:95-98``).
     """
+    normal = _normal_equations(
+        ret, cap, styles, industry, valid, n_industries=n_industries,
+        standardize_styles=standardize_styles,
+    )
+    Ginv = pinv_psd(normal.G)
+    return _solve_from_normal(normal, Ginv, return_exposure=return_exposure)
+
+
+class _NormalEq(NamedTuple):
+    X: jax.Array        # (N, K) design in estimation basis
+    retz: jax.Array     # (N,) returns, zeroed outside the universe
+    valid: jax.Array    # (N,) the regression's own universe
+    R: jax.Array | None # (K, K-1) constraint, None when P == 0
+    XtW: jax.Array      # (K-1, N) (or (K, N) when P == 0)
+    G: jax.Array        # (K-1, K-1) constrained normal matrix
+
+
+@highest_matmul_precision
+def _normal_equations(ret, cap, styles, industry, valid, *, n_industries,
+                      standardize_styles) -> _NormalEq:
+    """One date's design + constrained normal equations (everything before
+    the pseudo-inverse).  Split out so :func:`regress_panel` can hoist the
+    G pseudo-inverse out of the date vmap into ONE batched eigh — on TPU
+    that rides the Pallas Jacobi kernel instead of T per-date XLA SVDs."""
     P = n_industries
     Q = styles.shape[-1]
     X, valid, capz = regression_design(
@@ -141,13 +166,20 @@ def cross_section_regress(
         Xr = X @ R  # (N, K-1)
         XtW = Xr.T * w[None, :]
         G = XtW @ Xr  # (K-1, K-1)
-        omega = R @ (jnp.linalg.pinv(G) @ XtW)  # (K, N)
     else:
+        R = None
         XtW = X.T * w[None, :]
         G = XtW @ X
-        omega = jnp.linalg.pinv(G) @ XtW
+    return _NormalEq(X, jnp.where(valid, ret, 0.0), valid, R, XtW, G)
 
-    retz = jnp.where(valid, ret, 0.0)
+
+@highest_matmul_precision
+def _solve_from_normal(normal: _NormalEq, Ginv: jax.Array, *,
+                       return_exposure: bool) -> CrossSectionResult:
+    """Second half of the regression given ``Ginv = pinv(G)``
+    (``CrossSection.py:74-76,101-106``)."""
+    X, retz, valid, R, XtW, _ = normal
+    omega = Ginv @ XtW if R is None else R @ (Ginv @ XtW)  # (K, N)
     factor_ret = omega @ retz  # (K,)
     spec = retz - X @ factor_ret
     # equal-weight population variance over the date's universe (CrossSection.py:106)
@@ -175,11 +207,18 @@ def regress_panel(
 
     ret/cap: (T, N); styles: (T, N, Q); industry: (T, N) int; valid: (T, N).
     This replaces the reference's serial date loop (``mfm/MFM.py:57-68``).
+
+    The per-date pseudo-inverse is hoisted out of the vmap: all T normal
+    matrices decompose in ONE batched eigh (:func:`mfm_tpu.ops.eigh.pinv_psd`
+    — the Pallas Jacobi kernel on TPU) instead of T small XLA SVDs.
     """
-    fn = lambda r, c, s, i, v: cross_section_regress(
+    phase1 = lambda r, c, s, i, v: _normal_equations(
         r, c, s, i, v,
         n_industries=n_industries,
         standardize_styles=standardize_styles,
-        return_exposure=return_exposure,
     )
-    return jax.vmap(fn)(ret, cap, styles, industry, valid)
+    normal = jax.vmap(phase1)(ret, cap, styles, industry, valid)
+    Ginv = pinv_psd(normal.G)  # (T, K-1, K-1) in one batch
+    phase2 = lambda ne, gi: _solve_from_normal(
+        ne, gi, return_exposure=return_exposure)
+    return jax.vmap(phase2)(normal, Ginv)

@@ -250,3 +250,21 @@ def test_batched_eigh_weighted_diag_fallback_matches_loopy():
                                        rtol=1e-10, atol=1e-12)
             np.testing.assert_allclose(np.asarray(h[t, m])[order], hr,
                                        rtol=1e-8, atol=1e-10)
+
+
+def test_pinv_psd_matches_numpy_pinv():
+    """Eigh-based PSD pseudo-inverse (the regression stage's solver) vs
+    np.linalg.pinv, including rank-deficient, odd-n (padded), and zero
+    matrices."""
+    from mfm_tpu.ops.eigh import pinv_psd
+
+    rng = np.random.default_rng(21)
+    for n, rank in ((41, 41), (41, 30), (6, 6), (6, 3)):
+        X = rng.standard_normal((5, rank, n))
+        G = np.einsum("bri,brj->bij", X, X)
+        got = np.asarray(pinv_psd(jnp.asarray(G), prefer_pallas=False))
+        ref = np.linalg.pinv(G)
+        np.testing.assert_allclose(got, ref, rtol=5e-9, atol=1e-10)
+    # zero matrix -> zero pseudo-inverse
+    Z = jnp.zeros((2, 5, 5))
+    np.testing.assert_array_equal(np.asarray(pinv_psd(Z)), np.zeros((2, 5, 5)))
