@@ -232,7 +232,8 @@ def _factors(args):
         del p.fields["end_date"]
     barra, _ = run_factor_pipeline(
         p.fields, idx_close, l1, p.dates, p.stocks,
-        PipelineConfig(dtype=args.dtype, block=args.block),
+        PipelineConfig(dtype=args.dtype, block=args.block,
+                       rolling_impl=args.rolling_impl),
     )
     os.makedirs(args.out, exist_ok=True)
     out_path = os.path.join(args.out, "barra_data.csv")
@@ -318,6 +319,7 @@ def _pipeline(args):
         ),
         dtype=args.dtype,
         block=args.block,
+        rolling_impl=args.rolling_impl,
     )
     os.makedirs(args.out, exist_ok=True)
     barra_path = os.path.join(args.out, "barra_data.csv")
@@ -747,6 +749,10 @@ def main(argv=None):
                    help="rolling-kernel date-block size (memory = block x "
                         "window x stocks floats per input); default: auto "
                         "from the panel width (64 at CSI300, 16 at all-A)")
+    f.add_argument("--rolling-impl", choices=("scan", "block"),
+                   default="scan",
+                   help="rolling-kernel implementation: O(T*N) two-level "
+                        "scans (default) or the windowed-gather form")
     f.set_defaults(fn=_factors)
 
     d = sub.add_parser("demo", help="synthetic end-to-end risk model")
@@ -800,6 +806,10 @@ def main(argv=None):
     pl.add_argument("--block", type=int, default=None,
                     help="rolling-kernel date-block size; default: auto "
                          "from the panel width (64 at CSI300, 16 at all-A)")
+    pl.add_argument("--rolling-impl", choices=("scan", "block"),
+                    default="scan",
+                    help="rolling-kernel implementation: O(T*N) two-level "
+                         "scans (default) or the windowed-gather form")
     pl.add_argument("--specific-risk", action="store_true",
                     help="also write specific_risk.csv (shrunk EWMA "
                          "specific vol per stock x date)")

@@ -160,8 +160,15 @@ class PipelineConfig:
     #: 64 at CSI300's 300 stocks, 16 at all-A's 5,000 per the BASELINE.md
     #: block sweep).
     block: int | None = None
+    #: rolling-kernel implementation: "scan" (O(T*N) two-level chunked
+    #: scans, the default) or "block" (the windowed-gather reference
+    #: formulation; uses ``block``)
+    rolling_impl: str = "scan"
 
     def __post_init__(self):
+        if self.rolling_impl not in ("scan", "block"):
+            raise ValueError(f"rolling_impl must be 'scan' or 'block', "
+                             f"got {self.rolling_impl!r}")
         if self.block is None:
             return
         if not isinstance(self.block, int) or isinstance(self.block, bool) \

@@ -111,7 +111,8 @@ def run_factor_pipeline(
         for k, v in fields.items()
     }
     eng = FactorEngine(jfields, jnp.asarray(index_close, dtype),
-                       config=config.factors, block=config.block)
+                       config=config.factors, block=config.block,
+                       rolling_impl=config.rolling_impl)
     factors = {k: np.asarray(v) for k, v in eng.run().items()}
     observed = np.isfinite(np.asarray(fields["close"], np.float64))
     barra = assemble_barra_table(

@@ -1,0 +1,12 @@
+"""Config validation (PipelineConfig flags beyond the schema test)."""
+
+
+def test_rolling_impl_validated():
+    import pytest
+
+    from mfm_tpu.config import PipelineConfig
+
+    assert PipelineConfig().rolling_impl == "scan"
+    assert PipelineConfig(rolling_impl="block").rolling_impl == "block"
+    with pytest.raises(ValueError):
+        PipelineConfig(rolling_impl="Scan")

@@ -252,3 +252,26 @@ def test_scan_float32_drift():
         d = rel(arr, truth)
         assert np.max(d) < 5e-4, np.max(d)
         assert np.median(d) < 2e-6, np.median(d)
+
+
+def test_decay_windowed_sums_scan_brute_force():
+    """Unit-level pin of the two-level machinery itself: random masked terms
+    and a random nondecreasing expo (event-time-like, including flat runs and
+    jumps), checked against a brute-force O(T*W) loop at chunk boundaries,
+    window == T, and T % window != 0."""
+    from mfm_tpu.ops.rolling import decay_windowed_sums_scan
+
+    rng = np.random.default_rng(13)
+    T, N = 97, 3
+    term = rng.normal(size=(T, N))
+    term[rng.random((T, N)) < 0.3] = 0.0  # pre-zeroed invalids
+    expo = np.cumsum(rng.integers(0, 3, (T, N)), axis=0).astype(float)
+    for window, lam in ((13, 0.9), (40, 0.97), (97, 0.95), (30, 1.0 / 0.9)):
+        (got,) = decay_windowed_sums_scan(
+            [jnp.asarray(term)], window, jnp.asarray(expo), lam)
+        ref = np.zeros((T, N))
+        for t in range(T):
+            for j in range(max(0, t - window + 1), t + 1):
+                ref[t] += lam ** (expo[t] - expo[j]) * term[j]
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-10,
+                                   atol=1e-12)

@@ -1,0 +1,22 @@
+#!/bin/bash
+# Run every BASELINE.json bench config on the current backend and collect the
+# JSON lines in OUTDIR (default /tmp/bench_all).  Pair with
+# tools/refresh_hardware_evidence.sh for the parity gates.
+#
+#   tools/bench_all.sh [OUTDIR]
+#
+# Configs (bench.py): default = config 1 (risk model e2e, the driver metric),
+# beta, factors, alla, alpha.  Each prints ONE JSON line; a dead TPU tunnel
+# falls back to CPU with an `errors` field rather than hanging.
+set -eo pipefail
+cd "$(dirname "$0")/.."
+out=${1:-/tmp/bench_all}
+mkdir -p "$out"
+
+python bench.py                  | tail -1 > "$out/config1_risk.json"
+python bench.py --config beta    | tail -1 > "$out/config2_beta.json"
+python bench.py --config factors | tail -1 > "$out/config3_factors.json"
+python bench.py --config alla    | tail -1 > "$out/config4_alla.json"
+python bench.py --config alpha   | tail -1 > "$out/config5_alpha.json"
+
+cat "$out"/config*.json
