@@ -343,12 +343,17 @@ CONFIGS = {
 }
 
 
-def _probe_backend(attempts=2, timeout=90):
+def _probe_backend(attempts=4, timeout=90):
     """Ask (in a subprocess, so a hung TPU plugin can't wedge this process)
     which backend JAX actually brings up.  Round 1 died here: the axon TPU
     client constructor blocks forever when the tunnel is down, and the first
     `device_put` raised with no JSON emitted (VERDICT.md weak #2).  Returns
-    (platform|None, error|None)."""
+    (platform|None, error|None).
+
+    Four attempts with growing backoff (~7 min worst case) ride out a
+    *flapping* tunnel — observed mid-round-4: the tunnel dropped and
+    recovered on a minutes scale — while a genuinely dead tunnel still ends
+    in the CPU-fallback record rather than a hang."""
     err = None
     for i in range(attempts):
         try:

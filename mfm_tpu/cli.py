@@ -80,7 +80,8 @@ def _write_result_tables(res, out: str, specific_risk: bool) -> None:
 
 def _risk(args):
     import numpy as np
-    from mfm_tpu.config import PipelineConfig, RiskModelConfig
+    from mfm_tpu.ops.rolling import ROLLING_IMPLS
+from mfm_tpu.config import PipelineConfig, RiskModelConfig
     from mfm_tpu.data.barra import load_barra_csv
     from mfm_tpu.pipeline import run_risk_pipeline
 
@@ -749,7 +750,7 @@ def main(argv=None):
                    help="rolling-kernel date-block size (memory = block x "
                         "window x stocks floats per input); default: auto "
                         "from the panel width (64 at CSI300, 16 at all-A)")
-    f.add_argument("--rolling-impl", choices=("scan", "block"),
+    f.add_argument("--rolling-impl", choices=ROLLING_IMPLS,
                    default="scan",
                    help="rolling-kernel implementation: O(T*N) two-level "
                         "scans (default) or the windowed-gather form")
@@ -806,7 +807,7 @@ def main(argv=None):
     pl.add_argument("--block", type=int, default=None,
                     help="rolling-kernel date-block size; default: auto "
                          "from the panel width (64 at CSI300, 16 at all-A)")
-    pl.add_argument("--rolling-impl", choices=("scan", "block"),
+    pl.add_argument("--rolling-impl", choices=ROLLING_IMPLS,
                     default="scan",
                     help="rolling-kernel implementation: O(T*N) two-level "
                          "scans (default) or the windowed-gather form")

@@ -166,8 +166,10 @@ class PipelineConfig:
     rolling_impl: str = "scan"
 
     def __post_init__(self):
-        if self.rolling_impl not in ("scan", "block"):
-            raise ValueError(f"rolling_impl must be 'scan' or 'block', "
+        from mfm_tpu.ops.rolling import ROLLING_IMPLS
+
+        if self.rolling_impl not in ROLLING_IMPLS:
+            raise ValueError(f"rolling_impl must be one of {ROLLING_IMPLS}, "
                              f"got {self.rolling_impl!r}")
         if self.block is None:
             return

@@ -159,10 +159,16 @@ def _prev_chunk_suffix(B: jax.Array, fill=0.0):
     )
 
 
+#: the rolling-kernel implementations — the single source for config
+#: validation and CLI ``choices`` (scan = O(T*N) two-level chunked scans,
+#: block = the windowed-gather reference formulation)
+ROLLING_IMPLS = ("scan", "block")
+
+
 def _check_impl(impl: str) -> bool:
     """Validate the rolling-kernel impl switch; True for the scan path."""
-    if impl not in ("scan", "block"):
-        raise ValueError(f"impl must be 'scan' or 'block', got {impl!r}")
+    if impl not in ROLLING_IMPLS:
+        raise ValueError(f"impl must be one of {ROLLING_IMPLS}, got {impl!r}")
     return impl == "scan"
 
 

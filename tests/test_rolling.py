@@ -275,3 +275,19 @@ def test_decay_windowed_sums_scan_brute_force():
                 ref[t] += lam ** (expo[t] - expo[j]) * term[j]
         np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-10,
                                    atol=1e-12)
+
+
+def test_windowed_max_scan_brute_force():
+    from mfm_tpu.ops.rolling import windowed_max_scan
+
+    rng = np.random.default_rng(14)
+    T, N = 101, 4
+    x = rng.normal(size=(T, N))
+    x[rng.random((T, N)) < 0.2] = -np.inf  # masked entries, as callers pass
+    for window in (7, 25, 101, 120):
+        got = np.asarray(windowed_max_scan(jnp.asarray(x), window))
+        ref = np.stack([
+            np.max(x[max(0, t - window + 1): t + 1], axis=0)
+            for t in range(T)
+        ])
+        np.testing.assert_array_equal(got, ref)

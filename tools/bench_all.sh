@@ -13,10 +13,18 @@ cd "$(dirname "$0")/.."
 out=${1:-/tmp/bench_all}
 mkdir -p "$out"
 
-python bench.py                  | tail -1 > "$out/config1_risk.json"
-python bench.py --config beta    | tail -1 > "$out/config2_beta.json"
-python bench.py --config factors | tail -1 > "$out/config3_factors.json"
-python bench.py --config alla    | tail -1 > "$out/config4_alla.json"
-python bench.py --config alpha   | tail -1 > "$out/config5_alpha.json"
+# probe the backend ONCE here: each bench.py run would otherwise repeat its
+# own multi-attempt probe (~6.5 min per config against a dead tunnel);
+# a dead tunnel pins every config straight to the CPU fallback instead
+plat=()
+timeout 90 python -c "import jax; assert jax.devices()[0].platform in ('tpu', 'axon')" \
+  || { echo "TPU backend not reachable — running the CPU fallback" >&2
+       plat=(--platform cpu); }
+
+python bench.py                  "${plat[@]}" | tail -1 > "$out/config1_risk.json"
+python bench.py --config beta    "${plat[@]}" | tail -1 > "$out/config2_beta.json"
+python bench.py --config factors "${plat[@]}" | tail -1 > "$out/config3_factors.json"
+python bench.py --config alla    "${plat[@]}" | tail -1 > "$out/config4_alla.json"
+python bench.py --config alpha   "${plat[@]}" | tail -1 > "$out/config5_alpha.json"
 
 cat "$out"/config*.json
