@@ -80,8 +80,7 @@ def _write_result_tables(res, out: str, specific_risk: bool) -> None:
 
 def _risk(args):
     import numpy as np
-    from mfm_tpu.ops.rolling import ROLLING_IMPLS
-from mfm_tpu.config import PipelineConfig, RiskModelConfig
+    from mfm_tpu.config import PipelineConfig, RiskModelConfig
     from mfm_tpu.data.barra import load_barra_csv
     from mfm_tpu.pipeline import run_risk_pipeline
 
@@ -685,6 +684,10 @@ def _etl_missing(args):
 
 
 def main(argv=None):
+    # safe pre-pinning: importing the module only loads jax, it does not
+    # initialize a backend (the --platform pin below still wins)
+    from mfm_tpu.ops.rolling import ROLLING_IMPLS
+
     ap = argparse.ArgumentParser(prog="mfm_tpu")
     ap.add_argument("--platform", default=None, metavar="cpu|tpu",
                     help="pin the JAX platform via the config API (env "
