@@ -55,8 +55,11 @@ def _make_kernel(n: int, sweeps: int, dtype):
     def perm_cols(x, perm):
         return jnp.stack([x[:, i] for i in perm], axis=1)
 
-    def one_round(_, carry):
-        x, v = carry
+    def rotated(idx):
+        return idx // 2, idx % 2 == 0
+
+    def _angles(x):
+        """Per-pair Jacobi angles (c, s) from the current adjacent pairs."""
         app = jnp.stack([x[2 * i, 2 * i] for i in range(h)])        # (h, L)
         apq = jnp.stack([x[2 * i, 2 * i + 1] for i in range(h)])
         aqq = jnp.stack([x[2 * i + 1, 2 * i + 1] for i in range(h)])
@@ -68,48 +71,70 @@ def _make_kernel(n: int, sweeps: int, dtype):
         t = jnp.where(small, 0.0, t)
         c = 1.0 / jnp.sqrt(1.0 + t * t)
         s = t * c
+        return c, s
 
-        # Rotation and the fixed basis permutation to the next pairing are
-        # fused: each output row/column is the rotated row/column pi[.],
-        # written directly into its permuted slot — one restack per array per
-        # round instead of a rotation pass plus a permutation pass.
-        def rotated(idx):
-            i, even = idx // 2, idx % 2 == 0
-            return (i, even)
+    # Rotation and the fixed basis permutation to the next pairing are fused:
+    # each output row/column is the rotated row/column pi[.], written directly
+    # into its permuted slot — one restack per array per round instead of a
+    # rotation pass plus a permutation pass.
 
-        # rows: A <- perm_rows(J^T A, pi)
-        rows = []
+    def rot_rows(arrs, c, s):
+        """perm_rows(J^T a, pi) for every array, one fused restack each."""
+        outs = [[] for _ in arrs]
         for r in range(n):
             i, even = rotated(pi[r])
-            a, b = x[2 * i], x[2 * i + 1]           # (n, L)
-            rows.append(c[i] * a - s[i] * b if even
-                        else s[i] * a + c[i] * b)
-        y = jnp.stack(rows, axis=0)                 # (n, n, L)
-        # cols: A <- perm_cols(A J, pi)  (row perm commutes with col rotation)
-        cols, vcols = [], []
+            for out, arr in zip(outs, arrs):
+                a, b = arr[2 * i], arr[2 * i + 1]   # (n, L)
+                out.append(c[i] * a - s[i] * b if even
+                           else s[i] * a + c[i] * b)
+        return [jnp.stack(out, axis=0) for out in outs]
+
+    def rot_cols(arrs, c, s):
+        """perm_cols(a J, pi) for every array (row perm commutes with the
+        column rotation, so this composes with rot_rows either way)."""
+        outs = [[] for _ in arrs]
         for q in range(n):
             i, even = rotated(pi[q])
-            a, b = y[:, 2 * i], y[:, 2 * i + 1]
-            va, vb = v[:, 2 * i], v[:, 2 * i + 1]
-            if even:
-                cols.append(c[i] * a - s[i] * b)
-                vcols.append(c[i] * va - s[i] * vb)
-            else:
-                cols.append(s[i] * a + c[i] * b)
-                vcols.append(s[i] * va + c[i] * vb)
-        x = jnp.stack(cols, axis=1)
-        v = jnp.stack(vcols, axis=1)
+            for out, arr in zip(outs, arrs):
+                a, b = arr[:, 2 * i], arr[:, 2 * i + 1]
+                out.append(c[i] * a - s[i] * b if even
+                           else s[i] * a + c[i] * b)
+        return [jnp.stack(out, axis=1) for out in outs]
+
+    def one_round(_, carry):
+        x, v = carry
+        c, s = _angles(x)
+        (y,) = rot_rows([x], c, s)
+        x, v = rot_cols([y, v], c, s)
         return (x, v)
 
-    def _decompose(a_ref):
+    def one_round_vt(_, carry):
+        # Same rotation, but the eigenvector accumulator is stored TRANSPOSED
+        # (vt[j, k] = V[k, j]): V <- V J becomes vt <- perm_rows(J' vt, pi) —
+        # a rows pass with contiguous (n, L) tile-set slices, instead of the
+        # strided column slices of one_round's fused v-cols update.  Purely an
+        # internal layout choice of the weighted kernel (V never leaves VMEM
+        # there); A/B-able on hardware via ``vt_rows``.
+        x, vt = carry
+        c, s = _angles(x)
+        y, vt = rot_rows([x, vt], c, s)
+        (x,) = rot_cols([y], c, s)
+        return (x, vt)
+
+    def _decompose(a_ref, vt_rows=False):
         x = a_ref[0]                          # (n, n, L)
         i3 = jax.lax.broadcasted_iota(jnp.int32, (n, n, LANES), 0)
         j3 = jax.lax.broadcasted_iota(jnp.int32, (n, n, LANES), 1)
         v = jnp.where(i3 == j3, jnp.asarray(1.0, dtype), jnp.asarray(0.0, dtype))
         # move into the interleaved basis
         x = perm_cols(perm_rows(x, b0), b0)
-        v = perm_cols(v, b0)
-        return jax.lax.fori_loop(0, sweeps * (n - 1), one_round, (x, v))
+        if vt_rows:
+            v = perm_rows(v, b0)  # identity' = identity: vt0 = (v0)'
+            step = one_round_vt
+        else:
+            v = perm_cols(v, b0)
+            step = one_round
+        return jax.lax.fori_loop(0, sweeps * (n - 1), step, (x, v))
 
     def kernel(a_ref, w_ref, v_ref):
         x, v = _decompose(a_ref)
@@ -117,20 +142,25 @@ def _make_kernel(n: int, sweeps: int, dtype):
         w_ref[0] = jnp.stack([x[inv[i], inv[i]] for i in range(n)])  # (n, L)
         v_ref[0] = jnp.stack([v[:, inv[i]] for i in range(n)], axis=1)
 
-    def weighted_kernel(a_ref, d_ref, w_ref, h_ref):
-        # Same decomposition, but instead of writing the (n, n, L) eigenvector
-        # block back to HBM, reduce it against the per-matrix weight vector d
-        # in VMEM: h_i = sum_k V_ki^2 d_k.  v's ROWS stay in original index
-        # order throughout (only columns rotate/permute), so d — supplied in
-        # original order — broadcasts directly; column slot j is mapped back
-        # to original index order through inv, exactly like w.
-        x, v = _decompose(a_ref)
-        d = d_ref[0]                          # (n, L), original index order
-        hsum = jnp.sum(v * v * d[:, None, :], axis=0)   # (n, L) per slot
-        w_ref[0] = jnp.stack([x[inv[i], inv[i]] for i in range(n)])
-        h_ref[0] = jnp.stack([hsum[inv[i]] for i in range(n)])
+    def make_weighted_kernel(vt_rows):
+        def weighted_kernel(a_ref, d_ref, w_ref, h_ref):
+            # Same decomposition, but instead of writing the (n, n, L)
+            # eigenvector block back to HBM, reduce it against the per-matrix
+            # weight vector d in VMEM: h_i = sum_k V_ki^2 d_k.  The k axis
+            # (original index order throughout — d is supplied in that order)
+            # is v's row axis in the cols layout and vt's column axis in the
+            # rows layout; slot j maps back through inv, exactly like w.
+            x, v = _decompose(a_ref, vt_rows=vt_rows)
+            d = d_ref[0]                      # (n, L), original index order
+            if vt_rows:
+                hsum = jnp.sum(v * v * d[None, :, :], axis=1)
+            else:
+                hsum = jnp.sum(v * v * d[:, None, :], axis=0)
+            w_ref[0] = jnp.stack([x[inv[i], inv[i]] for i in range(n)])
+            h_ref[0] = jnp.stack([hsum[inv[i]] for i in range(n)])
+        return weighted_kernel
 
-    return kernel, weighted_kernel
+    return kernel, make_weighted_kernel
 
 
 def _pack_lanes(x: jax.Array):
@@ -204,9 +234,11 @@ def jacobi_eigh_tpu(A: jax.Array, sweeps: int | None = None,
     return w, V
 
 
-@functools.partial(jax.jit, static_argnames=("sweeps", "interpret"))
+@functools.partial(jax.jit, static_argnames=("sweeps", "vt_rows",
+                                             "interpret"))
 def jacobi_eigh_weighted_diag_tpu(A: jax.Array, d0: jax.Array,
                                   sweeps: int | None = None,
+                                  vt_rows: bool = False,
                                   interpret: bool = False):
     """Fused eigenvalues + weighted eigenvector diagonal: (w, h) with
     ``h_i = sum_k V_ki^2 d0_k`` for symmetric (B, n, n) ``A`` and per-matrix
@@ -232,7 +264,8 @@ def jacobi_eigh_weighted_diag_tpu(A: jax.Array, d0: jax.Array,
     Ax, nb = _pack_lanes(A)
     dx, _ = _pack_lanes(d0)                                 # (nb, n, L)
 
-    _, kernel = _make_kernel(n, sweeps, dtype)
+    _, make_weighted = _make_kernel(n, sweeps, dtype)
+    kernel = make_weighted(vt_rows)
     w, h = pl.pallas_call(
         kernel,
         grid=(nb,),

@@ -268,3 +268,22 @@ def test_pinv_psd_matches_numpy_pinv():
     # zero matrix -> zero pseudo-inverse
     Z = jnp.zeros((2, 5, 5))
     np.testing.assert_array_equal(np.asarray(pinv_psd(Z)), np.zeros((2, 5, 5)))
+
+
+def test_weighted_diag_kernel_vt_rows_layout_matches():
+    """The transposed-eigenvector (rows-pass) layout of the weighted kernel
+    is an internal VMEM layout choice and must produce identical (w, h)."""
+    from mfm_tpu.ops.eigh_pallas import jacobi_eigh_weighted_diag_tpu
+
+    rng = np.random.default_rng(22)
+    n, B = 8, 5
+    X = rng.standard_normal((B, 16, n)).astype(np.float32)
+    A = jnp.asarray(np.einsum("bnk,bnl->bkl", X, X) / 16)
+    d0 = jnp.asarray(np.abs(rng.standard_normal((B, n))).astype(np.float32))
+
+    w0, h0 = jacobi_eigh_weighted_diag_tpu(A, d0, interpret=True)
+    w1, h1 = jacobi_eigh_weighted_diag_tpu(A, d0, vt_rows=True,
+                                           interpret=True)
+    np.testing.assert_array_equal(np.asarray(w0), np.asarray(w1))
+    np.testing.assert_allclose(np.asarray(h0), np.asarray(h1),
+                               rtol=1e-6, atol=1e-7)
