@@ -285,7 +285,8 @@ class RiskPipelineResult:
         if not np.isfinite(F).all():
             raise ValueError(f"no valid adjusted covariance at date index {t}")
         x = X.T @ w
-        factor_var = float(x @ F @ x)
+        Fx = F @ x
+        factor_var = float(x @ Fx)
         if specific_vol is None:
             specific_vol = self._specific_panels(
                 half_life, ngroup, q, min_periods)[1][t]
@@ -299,12 +300,18 @@ class RiskPipelineResult:
                 "observations); pass specific_vol= explicitly or zero their "
                 "weight")
         spec_var = float(np.sum((w[held] ** 2) * (sv[held] ** 2)))
+        # Euler decomposition of the factor variance: contribution_i =
+        # x_i (F x)_i, summing exactly to x'Fx — the per-factor risk
+        # attribution a Barra covariance exists to provide
+        contrib = x * Fx
         return {
             "date": a.dates[t],
             "factor_var": factor_var,
             "specific_var": spec_var,
             "total_vol": float(np.sqrt(factor_var + spec_var)),
             "factor_exposures": pd.Series(x, index=a.factor_names()),
+            "factor_risk_contribution": pd.Series(contrib,
+                                                  index=a.factor_names()),
         }
 
 

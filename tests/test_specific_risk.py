@@ -114,6 +114,12 @@ def test_portfolio_risk_decomposition():
     x = rep["factor_exposures"].to_numpy()
     F = np.asarray(res.outputs.vr_cov[-1], np.float64)
     np.testing.assert_allclose(rep["factor_var"], x @ F @ x, rtol=1e-9)
+    # Euler attribution: per-factor contributions sum exactly to factor_var
+    contrib = rep["factor_risk_contribution"]
+    assert list(contrib.index) == list(rep["factor_exposures"].index)
+    np.testing.assert_allclose(contrib.to_numpy(), x * (F @ x), rtol=1e-12)
+    np.testing.assert_allclose(contrib.sum(), rep["factor_var"],
+                               rtol=1e-14)
 
     # nonzero weight outside the universe is an error, not silence
     bad = np.ones_like(w) / len(w)
