@@ -45,6 +45,44 @@ def _maybe_portfolio_bias(res, args) -> None:
         json.dump(rep, fh, indent=1)
 
 
+def _maybe_portfolio_risk(res, args) -> None:
+    """Predicted portfolio risk + per-factor Euler attribution for a
+    ``ts_code,weight`` CSV, written to ``OUT/portfolio_risk.json`` when
+    ``--portfolio FILE`` was given (shared by ``risk`` and ``pipeline``).
+
+    Unknown ts_codes in the file are an error (a silent drop would change
+    the portfolio); universe stocks absent from the file get weight 0."""
+    if not args.portfolio:
+        return
+    import numpy as np
+    import pandas as pd
+
+    pf = pd.read_csv(args.portfolio)
+    missing = {"ts_code", "weight"} - set(pf.columns)
+    if missing:
+        raise SystemExit(f"--portfolio file lacks columns {sorted(missing)}")
+    stocks = list(res.arrays.stocks)
+    unknown = sorted(set(pf["ts_code"]) - set(stocks))
+    if unknown:
+        raise SystemExit(f"--portfolio has ts_codes outside the panel: "
+                         f"{unknown[:5]}{'...' if len(unknown) > 5 else ''}")
+    dup = pf["ts_code"][pf["ts_code"].duplicated()]
+    if len(dup):
+        # label assignment below is last-wins; silently collapsing repeated
+        # rows would compute risk for a different portfolio
+        raise SystemExit(f"--portfolio lists ts_codes more than once: "
+                         f"{sorted(set(dup))[:5]}")
+    w = pd.Series(0.0, index=stocks)
+    w[pf["ts_code"].to_numpy()] = pf["weight"].to_numpy(float)
+    rep = res.portfolio_risk(w.to_numpy(), t=args.portfolio_date)
+    rep = {k: (v.to_dict() if isinstance(v, pd.Series)
+               else v if not isinstance(v, np.generic) else v.item())
+           for k, v in rep.items()}
+    rep["date"] = str(rep["date"])
+    with open(os.path.join(args.out, "portfolio_risk.json"), "w") as fh:
+        json.dump(rep, fh, indent=1)
+
+
 def _save_outputs_npz(res, out: str, source) -> None:
     """Persist every stage output (incl. the full covariance series) as one
     identity-stamped artifact — one schema shared by ``risk`` and
@@ -162,6 +200,7 @@ def _risk(args):
     # USE4's headline acceptance test (random test portfolios) — the
     # reference only runs the eigen-portfolio variant
     _maybe_portfolio_bias(res, args)
+    _maybe_portfolio_risk(res, args)
     print(json.dumps({
         "dates": int(arrays.ret.shape[0]), "stocks": int(arrays.ret.shape[1]),
         "factors": len(arrays.factor_names()), "wall_s": round(wall, 3),
@@ -373,6 +412,7 @@ def _pipeline(args):
     # acceptance-test compute stays OUT of the reported wall (same policy
     # as _risk's bias block)
     _maybe_portfolio_bias(res, args)
+    _maybe_portfolio_risk(res, args)
     print(json.dumps({
         "rows": int(len(barra)),
         "dates": int(res.arrays.ret.shape[0]),
@@ -738,6 +778,12 @@ def main(argv=None):
                    help="also run the USE4 random-portfolio bias acceptance "
                         "test with Q portfolios and write "
                         "OUT/portfolio_bias.json")
+    r.add_argument("--portfolio", default=None, metavar="CSV",
+                   help="ts_code,weight table: write predicted portfolio "
+                        "risk + per-factor Euler attribution to "
+                        "OUT/portfolio_risk.json")
+    r.add_argument("--portfolio-date", type=int, default=-1,
+                   help="date index for --portfolio (default: last)")
     r.set_defaults(fn=_risk)
 
     f = sub.add_parser("factors", help="style-factor production (main.py path)")
@@ -827,6 +873,12 @@ def main(argv=None):
                          "OUT/portfolio_bias.json")
     pl.add_argument("--bias-burn-in", type=int, default=252,
                     help="dates excluded from the burn-in-free bias scope")
+    pl.add_argument("--portfolio", default=None, metavar="CSV",
+                    help="ts_code,weight table: write predicted portfolio "
+                         "risk + per-factor Euler attribution to "
+                         "OUT/portfolio_risk.json")
+    pl.add_argument("--portfolio-date", type=int, default=-1,
+                    help="date index for --portfolio (default: last)")
     pl.set_defaults(fn=_pipeline)
 
     al = sub.add_parser("alpha",

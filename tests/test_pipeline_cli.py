@@ -244,3 +244,52 @@ def test_risk_save_outputs_flag(tmp_path, capsys):
     assert outputs.vr_cov.shape[0] == 40  # FULL covariance series
     assert meta["source"] == barra
     assert len(meta["dates"]) == 2 and meta["n_stocks"] == 16
+
+
+def test_pipeline_portfolio_risk_flag(store_dir, tmp_path, capsys):
+    import numpy as np
+    import pandas as pd
+
+    out = str(tmp_path / "o")
+    cli_main(["pipeline", "--store", store_dir, "--out", out,
+              "--eigen-sims", "4", "--start", "20200101"])
+    json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # equal-weight the last date's universe from the produced barra table
+    df = pd.read_csv(os.path.join(out, "barra_data.csv"))
+    # the final date's t+1 return is NaN (main.py:99 shift), so the last
+    # date with a full universe is the second-to-last — exercise
+    # --portfolio-date while at it
+    dates = sorted(df.date.unique())
+    last = df[df.date == dates[-2]].dropna()
+    assert len(last) > 0
+    pf = str(tmp_path / "pf.csv")
+    pd.DataFrame({"ts_code": last.stocknames,
+                  "weight": 1.0 / len(last)}).to_csv(pf, index=False)
+    cli_main(["pipeline", "--store", store_dir, "--out", out,
+              "--eigen-sims", "4", "--start", "20200101",
+              "--resume", "--portfolio", pf, "--portfolio-date", "-2"])
+    capsys.readouterr()
+    rec = json.load(open(os.path.join(out, "portfolio_risk.json")))
+    assert rec["total_vol"] > 0
+    contrib = rec["factor_risk_contribution"]
+    assert np.isclose(sum(contrib.values()), rec["factor_var"], rtol=1e-6)
+    assert np.isclose(rec["factor_exposures"]["country"], 1.0, atol=1e-6)
+
+    # unknown ts_codes must be an error, not a silent drop
+    bad = str(tmp_path / "bad.csv")
+    pd.DataFrame({"ts_code": ["NOPE.SZ"], "weight": [1.0]}).to_csv(
+        bad, index=False)
+    with pytest.raises(SystemExit, match="outside the panel"):
+        cli_main(["pipeline", "--store", store_dir, "--out", out,
+                  "--eigen-sims", "4", "--start", "20200101",
+                  "--resume", "--portfolio", bad])
+
+    # duplicate rows must be an error, not last-wins
+    code = last.stocknames.iloc[0]
+    dup = str(tmp_path / "dup.csv")
+    pd.DataFrame({"ts_code": [code, code], "weight": [0.5, 0.5]}).to_csv(
+        dup, index=False)
+    with pytest.raises(SystemExit, match="more than once"):
+        cli_main(["pipeline", "--store", store_dir, "--out", out,
+                  "--eigen-sims", "4", "--start", "20200101",
+                  "--resume", "--portfolio", dup])
