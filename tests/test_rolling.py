@@ -266,7 +266,8 @@ def test_decay_windowed_sums_scan_brute_force():
     term = rng.normal(size=(T, N))
     term[rng.random((T, N)) < 0.3] = 0.0  # pre-zeroed invalids
     expo = np.cumsum(rng.integers(0, 3, (T, N)), axis=0).astype(float)
-    for window, lam in ((13, 0.9), (40, 0.97), (97, 0.95), (30, 1.0 / 0.9)):
+    for window, lam in ((1, 0.9), (2, 0.9), (13, 0.9), (40, 0.97),
+                        (97, 0.95), (30, 1.0 / 0.9)):
         (got,) = decay_windowed_sums_scan(
             [jnp.asarray(term)], window, jnp.asarray(expo), lam)
         ref = np.zeros((T, N))
