@@ -1,0 +1,95 @@
+"""Alpha -> risk-model integration: the title's full loop.
+
+The reference promises an "LLM-Driven Multi-factor Model" but contains no
+LLM-factor code at all (SURVEY.md intro); this module closes the loop the
+title describes: a batch of (LLM-)generated alpha expressions is evaluated
+over the raw market panel, scored against forward returns, greedily
+de-correlated (:mod:`mfm_tpu.alpha.select`), and the survivors become extra
+*style columns* of the barra table — so the constrained cross-sectional
+regression prices them alongside the classic styles and the covariance
+stack forecasts their risk.
+
+Exposure convention: each selected alpha is per-date z-scored over its
+valid cross-section and missing values become 0 (= mean exposure), so the
+reference's drop-any-NaN row filter (``demo.py:25-27``) never loses rows to
+alpha warm-up windows; the regression's own cap-weighted standardization
+(``CrossSection.py:12-20``) then rescales like any other style.  On dates
+where an alpha is entirely invalid the column is all-zero and the
+constrained solve's pseudo-inverse (the reference's own degeneracy policy,
+``CrossSection.py:76``) prices it at ~0.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from mfm_tpu.alpha.dsl import compile_alpha, cs_zscore, evaluate_alphas
+from mfm_tpu.alpha.metrics import information_coefficient
+from mfm_tpu.alpha.select import select_alphas
+
+
+def alpha_style_columns(
+    sources: Sequence[str],
+    fields: Mapping[str, jax.Array],
+    fwd_ret: jax.Array,
+    k: int,
+    max_corr: float = 0.7,
+) -> tuple[list[str], np.ndarray, dict]:
+    """Evaluate, select, and standardize alphas into style-column form.
+
+    Args:
+      sources: candidate expressions (validated against ``fields``).
+      fields: (T, N) panel fields the expressions reference.
+      fwd_ret: (T, N) next-period returns (the barra table's ``ret``).
+      k / max_corr: selection budget and pairwise PnL-correlation cap
+        (:func:`mfm_tpu.alpha.select.select_alphas`).
+
+    Returns ``(names, exposures (T, N, k'), report)`` with k' <= k selected
+    columns named ``alpha_01``.. in selection order, exposures z-scored per
+    date with NaN -> 0, and a JSON-ready report mapping each name to its
+    expression and mean IC.
+    """
+    if not sources:
+        raise ValueError("no alpha expressions given")
+    for i, src in enumerate(sources, 1):
+        expr = compile_alpha(src)  # raises on bad syntax/vocabulary
+        missing = [f for f in expr.fields if f not in fields]
+        if missing:
+            raise ValueError(f"expression {i} references unknown panel "
+                             f"field(s) {missing}: {src!r}")
+    alphas = evaluate_alphas(sources, fields)          # (E, T, N)
+    # one IC pass serves both the selection scores (select_alphas' default
+    # is exactly |mean IC| — passing it avoids recomputing the full
+    # (E, T, N) reduction) and the report
+    ic = information_coefficient(alphas, fwd_ret)      # (E, T)
+    m = jnp.isfinite(ic)
+    mean_ic = jnp.sum(jnp.where(m, ic, 0.0), axis=-1) / jnp.maximum(
+        jnp.sum(m, axis=-1), 1)
+    sel = select_alphas(alphas, fwd_ret, k, max_corr=max_corr,
+                        scores=jnp.abs(mean_ic))
+    chosen = sel["indices"]                            # selection order
+    if not len(chosen):
+        raise ValueError("alpha selection kept no expressions (all scores "
+                         "below the floor or pairwise-correlated away)")
+
+    z = cs_zscore(alphas[jnp.asarray(chosen)])         # (k', T, N)
+    z = jnp.where(jnp.isfinite(z), z, 0.0)
+    exposures = np.moveaxis(np.asarray(z, np.float32), 0, -1)  # (T, N, k')
+
+    names = [f"alpha_{i + 1:02d}" for i in range(len(chosen))]
+    report = {
+        name: {
+            "expression": sources[int(e)],
+            "mean_ic": float(mean_ic[int(e)]),
+            # sel["scores"] is aligned to the selection order, not to the
+            # expression index
+            "score": float(sel["scores"][pos]),
+        }
+        for pos, (name, e) in enumerate(zip(names, chosen))
+    }
+    return names, exposures, report

@@ -339,6 +339,74 @@ def _prepare(args):
                       "industry": industry_path}))
 
 
+def _read_alpha_sources(path):
+    """Read + syntax-validate an ``--alphas`` expression file, fail-fast
+    (before any expensive pipeline stage runs) with file:line context —
+    same policy as the ``alpha`` subcommand's reader."""
+    from mfm_tpu.alpha.dsl import compile_alpha
+
+    sources = []
+    try:
+        fh = open(path)
+    except OSError as err:
+        raise SystemExit(f"--alphas: {err}") from err
+    with fh:
+        for i, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                compile_alpha(line)
+            except (ValueError, SyntaxError) as err:
+                raise SystemExit(f"{path}:{i}: {err}") from err
+            sources.append(line)
+    if not sources:
+        raise SystemExit(f"--alphas: {path} has no expressions")
+    return sources
+
+
+def _append_alpha_styles(args, sources, barra, prep):
+    """Evaluate/select the ``--alphas`` expressions on the prepared raw
+    panel and append the survivors as style columns of the barra table (in
+    memory only — the resumable stage artifact stays the classic factor
+    table; selection is cheap and deterministic, so it recomputes per run)."""
+    import numpy as np
+    import pandas as pd
+    import jax.numpy as jnp
+    from mfm_tpu.alpha.integrate import alpha_style_columns
+
+    fields = {k: jnp.asarray(np.asarray(v, np.float32))
+              for k, v in prep.fields.items()}
+
+    # forward returns = the barra table's own t+1 ``ret`` column, densified
+    # on the prepared (dates x stocks) grid
+    t_idx = {d: i for i, d in enumerate(pd.to_datetime(prep.dates))}
+    s_idx = {s: j for j, s in enumerate(prep.stocks)}
+    bdates = pd.to_datetime(barra["date"])
+    ti = bdates.map(t_idx).to_numpy()
+    si = barra["stocknames"].map(s_idx).to_numpy()
+    if np.isnan(ti.astype(float)).any() or np.isnan(si.astype(float)).any():
+        raise SystemExit("--alphas: the resumed barra table's dates/stocks "
+                         "do not match the store's prepared panel — rerun "
+                         "without --resume")
+    T, N = len(prep.dates), len(prep.stocks)
+    fwd = np.full((T, N), np.nan, np.float32)
+    fwd[ti, si] = barra["ret"].to_numpy(np.float32)
+
+    try:
+        names, expo, report = alpha_style_columns(
+            sources, fields, jnp.asarray(fwd),
+            k=args.alpha_top, max_corr=args.alpha_max_corr)
+    except ValueError as err:
+        raise SystemExit(f"--alphas: {err}") from err
+
+    barra = barra.drop(columns=[c for c in barra.columns
+                                if c.startswith("alpha_")])
+    for j, name in enumerate(names):
+        barra[name] = expo[ti, si, j]
+    return barra, report
+
+
 def _pipeline(args):
     """One-command end-to-end: raw store -> master panel -> factor table ->
     risk outputs (the reference's ``main.py`` + ``demo.py`` chain), with a
@@ -369,6 +437,9 @@ def _pipeline(args):
     # stage-artifact pandas IO between them); the result-table writes after
     # the block stay out, and an exception inside still stops the trace
     # (no half-open profiler session)
+    # fail-fast on a bad --alphas path/expression BEFORE the factor stage
+    alpha_sources = _read_alpha_sources(args.alphas) if args.alphas else None
+    prep = None
     with _profile_ctx(args.profile):
         if args.resume and os.path.exists(barra_path) \
                 and os.path.exists(industry_info_path):
@@ -403,6 +474,24 @@ def _pipeline(args):
             out_store.replace("barra_factors", barra)
             out_store.replace("sw_industry_info_for_factors", info_df)
 
+        n_alpha_styles = 0
+        if args.alphas:
+            # the title's full loop: (LLM-)generated alpha expressions ->
+            # evaluate on the raw panel -> IC-score + de-correlate -> the
+            # survivors join the barra table as extra style columns, priced
+            # by the constrained regression and forecast by the covariance
+            # stack (mfm_tpu/alpha/integrate.py)
+            if prep is None:  # --resume skipped the prepare stage
+                prep = prepare_factor_inputs(
+                    PanelStore(args.store), index_code=args.index_code,
+                    start_date=args.start, end_date=args.end,
+                    fin_start_date=args.fin_start)
+            barra, report = _append_alpha_styles(args, alpha_sources,
+                                                 barra, prep)
+            n_alpha_styles = len(report)
+            with open(os.path.join(args.out, "alpha_styles.json"), "w") as fh:
+                json.dump(report, fh, indent=1)
+
         codes = info_df["code"].to_numpy()
         res = run_risk_pipeline(barra_df=barra, config=cfg,
                                 industry_codes=codes)
@@ -421,6 +510,7 @@ def _pipeline(args):
         "factor_stage_wall_s": round(factor_wall, 3),
         "wall_s": round(wall, 3),
         "mean_r2": float(np.nanmean(np.asarray(res.outputs.r2))),
+        "alpha_styles": n_alpha_styles,
         "out": args.out,
     }))
 
@@ -879,6 +969,15 @@ def main(argv=None):
                          "OUT/portfolio_risk.json")
     pl.add_argument("--portfolio-date", type=int, default=-1,
                     help="date index for --portfolio (default: last)")
+    pl.add_argument("--alphas", default=None, metavar="FILE",
+                    help="alpha-DSL expressions (one per line): evaluate on "
+                         "the raw panel, select the best de-correlated "
+                         "--alpha-top, and price them as extra style "
+                         "factors (report: OUT/alpha_styles.json)")
+    pl.add_argument("--alpha-top", type=_positive_int, default=5,
+                    help="max alpha styles to keep (default 5)")
+    pl.add_argument("--alpha-max-corr", type=float, default=0.7,
+                    help="pairwise PnL-correlation cap for alpha selection")
     pl.set_defaults(fn=_pipeline)
 
     al = sub.add_parser("alpha",

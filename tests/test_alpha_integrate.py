@@ -1,0 +1,52 @@
+"""Alpha -> risk-model integration (alpha/integrate.py): selected alpha
+expressions become extra style columns of the barra table, priced by the
+constrained regression like any classic style."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from mfm_tpu.alpha.integrate import alpha_style_columns
+
+
+@pytest.fixture(scope="module")
+def panel():
+    rng = np.random.default_rng(0)
+    T, N = 80, 30
+    close = np.cumprod(1 + 0.02 * rng.standard_normal((T, N)), axis=0) * 20
+    vol = np.exp(rng.normal(12, 1, (T, N)))
+    close[rng.random((T, N)) < 0.05] = np.nan
+    fields = {"close": jnp.asarray(close, jnp.float32),
+              "volume": jnp.asarray(vol, jnp.float32)}
+    fwd = np.vstack([close[1:] / close[:-1] - 1.0,
+                     np.full((1, N), np.nan)]).astype(np.float32)
+    return fields, jnp.asarray(fwd)
+
+
+def test_alpha_style_columns_shapes_and_report(panel):
+    fields, fwd = panel
+    srcs = ["-delta(close, 5)",               # reversal: real signal vs fwd
+            "cs_rank(ts_mean(volume, 10))",   # volume level
+            "-delta(close, 5) * 1.0001"]      # near-duplicate of #1
+    names, expo, report = alpha_style_columns(srcs, fields, fwd, k=2,
+                                              max_corr=0.9)
+    T, N = fields["close"].shape
+    assert expo.shape == (T, N, len(names)) and len(names) <= 2
+    # z-scored with NaN->0: every date's cross-section is finite
+    assert np.isfinite(expo).all()
+    # per-date mean ~ 0 on dates with valid data (z-score + zero fill)
+    assert np.abs(expo.mean(axis=1)).max() < 0.5
+    # the near-duplicate must not be selected alongside its twin
+    picked = {report[n]["expression"] for n in names}
+    assert not {"-delta(close, 5)", "-delta(close, 5) * 1.0001"} <= picked
+    for n in names:
+        assert np.isfinite(report[n]["mean_ic"])
+        assert np.isfinite(report[n]["score"])
+
+
+def test_alpha_style_columns_validates(panel):
+    fields, fwd = panel
+    with pytest.raises(ValueError, match="unknown panel field"):
+        alpha_style_columns(["delta(nope, 3)"], fields, fwd, k=1)
+    with pytest.raises(ValueError, match="no alpha expressions"):
+        alpha_style_columns([], fields, fwd, k=1)

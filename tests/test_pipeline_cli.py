@@ -293,3 +293,56 @@ def test_pipeline_portfolio_risk_flag(store_dir, tmp_path, capsys):
         cli_main(["pipeline", "--store", store_dir, "--out", out,
                   "--eigen-sims", "4", "--start", "20200101",
                   "--resume", "--portfolio", dup])
+
+
+def test_pipeline_alpha_styles_flag(store_dir, tmp_path, capsys):
+    """The title's loop end-to-end: --alphas expressions become priced style
+    factors — factor_returns.csv grows alpha_* columns and the report maps
+    them to expressions."""
+    exprs = str(tmp_path / "alphas.txt")
+    with open(exprs, "w") as fh:
+        fh.write("# candidates\n"
+                 "-delta(close, 5)\n"
+                 "cs_rank(ts_mean(turnover_rate, 10))\n"
+                 "-delta(close, 5) * 1.0001\n")
+    out = str(tmp_path / "o")
+    cli_main(["pipeline", "--store", store_dir, "--out", out,
+              "--eigen-sims", "4", "--start", "20200101",
+              "--alphas", exprs, "--alpha-top", "2"])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["alpha_styles"] >= 1
+    rep = json.load(open(os.path.join(out, "alpha_styles.json")))
+    assert set(rep) == {f"alpha_{i+1:02d}" for i in range(rec["alpha_styles"])}
+    fr = pd.read_csv(os.path.join(out, "factor_returns.csv"), index_col=0)
+    for name in rep:
+        assert name in fr.columns
+        assert np.isfinite(fr[name].to_numpy(float)).all()
+    # the near-duplicate pair must not BOTH survive selection
+    picked = {v["expression"] for v in rep.values()}
+    assert not {"-delta(close, 5)", "-delta(close, 5) * 1.0001"} <= picked
+    # the stage artifact stays the classic table (no alpha columns persisted)
+    barra = pd.read_csv(os.path.join(out, "barra_data.csv"), nrows=1)
+    assert not any(c.startswith("alpha_") for c in barra.columns)
+
+    # --resume re-prepares the raw panel for the alpha stage and reproduces
+    # the same selection
+    cli_main(["pipeline", "--store", store_dir, "--out", out,
+              "--eigen-sims", "4", "--start", "20200101",
+              "--resume", "--alphas", exprs, "--alpha-top", "2"])
+    capsys.readouterr()
+    rep2 = json.load(open(os.path.join(out, "alpha_styles.json")))
+    assert rep2 == rep
+
+    # bad expression or missing file fails fast with file:line, before the
+    # factor stage runs
+    bad = str(tmp_path / "bad_alphas.txt")
+    with open(bad, "w") as fh:
+        fh.write("delta(close, 5\n")  # unclosed paren -> SyntaxError
+    with pytest.raises(SystemExit, match="bad_alphas.txt:1"):
+        cli_main(["pipeline", "--store", store_dir, "--out", out,
+                  "--eigen-sims", "4", "--start", "20200101",
+                  "--resume", "--alphas", bad])
+    with pytest.raises(SystemExit, match="--alphas"):
+        cli_main(["pipeline", "--store", store_dir, "--out", out,
+                  "--eigen-sims", "4", "--start", "20200101",
+                  "--resume", "--alphas", str(tmp_path / "nope.txt")])
