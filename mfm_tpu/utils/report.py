@@ -7,8 +7,9 @@ the eigenfactor bias picture (``mfm/utils.py:116``).  This module turns that
 into a first-class driver: one JSON health summary plus one small-multiples
 PNG, computed from the result tables the ``risk``/``pipeline`` subcommands
 write (``factor_returns.csv``, ``r_squared.csv``, ``lambda.csv``, and — when
-present — ``specific_returns.csv``, ``bias_stats.json``, and
-``portfolio_bias.json``).
+present — ``specific_returns.csv`` plus the optional JSON artifacts:
+``bias_stats.json``, ``portfolio_bias.json``, ``portfolio_risk.json``,
+``alpha_styles.json``).
 
 Everything here is host-side pandas over small result tables; no JAX.
 """
@@ -43,10 +44,11 @@ def load_results(results_dir: str) -> dict:
     """Read whatever result tables exist under ``results_dir``.
 
     Returns a dict with ``factor_returns`` / ``r_squared`` / ``lambda`` /
-    ``specific_returns`` DataFrames (absent keys omitted) plus
-    ``bias_stats`` / ``portfolio_bias`` (the parsed ``bias_stats.json`` /
-    ``portfolio_bias.json``) when present.  ``factor_returns`` is
-    required — a results dir without it is not a risk-run output.
+    ``specific_returns`` DataFrames (absent keys omitted) plus the parsed
+    optional JSON artifacts when present: ``bias_stats`` /
+    ``portfolio_bias`` / ``portfolio_risk`` / ``alpha_styles``.
+    ``factor_returns`` is required — a results dir without it is not a
+    risk-run output.
     """
     out = {}
     for key, fname in (("factor_returns", "factor_returns.csv"),
@@ -61,7 +63,9 @@ def load_results(results_dir: str) -> dict:
             f"{results_dir}/factor_returns.csv not found — run the `risk` or "
             "`pipeline` subcommand into this directory first")
     for key, fname in (("bias_stats", "bias_stats.json"),
-                       ("portfolio_bias", "portfolio_bias.json")):
+                       ("portfolio_bias", "portfolio_bias.json"),
+                       ("portfolio_risk", "portfolio_risk.json"),
+                       ("alpha_styles", "alpha_styles.json")):
         path = os.path.join(results_dir, fname)
         if os.path.exists(path):
             with open(path) as fh:
@@ -149,6 +153,20 @@ def model_health_summary(results_dir: str, ann_factor: int = 252,
             "mean": scope.get("mean"),
             "median": scope.get("median"),
             "mean_abs_dev_from_1": scope.get("mean_abs_dev_from_1"),
+        }
+    if "portfolio_risk" in res:
+        pr = res["portfolio_risk"]
+        summary["portfolio_risk"] = {
+            "date": pr.get("date"),
+            "total_vol": pr.get("total_vol"),
+            "factor_var": pr.get("factor_var"),
+            "specific_var": pr.get("specific_var"),
+        }
+    if "alpha_styles" in res:
+        summary["alpha_styles"] = {
+            name: {"expression": d.get("expression"),
+                   "mean_ic": d.get("mean_ic")}
+            for name, d in res["alpha_styles"].items()
         }
     return summary
 

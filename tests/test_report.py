@@ -96,6 +96,20 @@ def test_summary_optional_sections(tmp_path):
     np.testing.assert_allclose(s["specific_dispersion"]["mean_xsec_std"],
                                sp.std(axis=1, ddof=1).mean(), atol=1e-5)
 
+    # portfolio_risk.json and alpha_styles.json surface when present
+    (tmp_path / "portfolio_risk.json").write_text(json.dumps({
+        "date": "2020-06-30", "total_vol": 0.012,
+        "factor_var": 1e-4, "specific_var": 4.4e-5,
+        "factor_exposures": {"country": 1.0}}))
+    (tmp_path / "alpha_styles.json").write_text(json.dumps({
+        "alpha_01": {"expression": "-delta(close, 5)", "mean_ic": 0.03,
+                     "score": 0.03}}))
+    s = model_health_summary(str(tmp_path))
+    assert s["portfolio_risk"] == {"date": "2020-06-30", "total_vol": 0.012,
+                                   "factor_var": 1e-4,
+                                   "specific_var": 4.4e-5}
+    assert s["alpha_styles"]["alpha_01"]["expression"] == "-delta(close, 5)"
+
 
 def test_missing_factor_returns_raises(tmp_path):
     from mfm_tpu.utils.report import model_health_summary
