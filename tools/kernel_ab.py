@@ -1,0 +1,64 @@
+"""A/B experiments to run on the real TPU (tunnel was down for the rest of
+round 4's second session — run these the moment it answers):
+
+1. weighted Jacobi kernel eigenvector layout (vt_rows=False vs True —
+   strided column slices vs contiguous rows-pass tile sets; pick the faster
+   as the default in ops/eigh.py::batched_eigh_weighted_diag)
+2. scan-vs-block rolling kernels at CSI300 and all-A shapes (BASELINE.md's
+   pending TPU numbers for the O(T*N) scan path)
+"""
+import sys
+import time
+
+import numpy as np
+
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from mfm_tpu.ops.eigh_pallas import jacobi_eigh_weighted_diag_tpu
+from mfm_tpu.ops.rolling import rolling_beta_hsigma
+
+
+def force(x):
+    if isinstance(x, tuple):
+        x = x[0]
+    return float(np.asarray(jnp.nansum(x)))
+
+
+def t3(fn, *a):
+    force(fn(*a))
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        force(fn(*a))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+# --- weighted kernel V layout A/B (the eigen stage's dominant cost) ---
+K, B, sweeps = 42, 1390 * 100, 4
+X = jax.random.normal(jax.random.key(0), (B, 64, K), jnp.float32)
+A = jnp.einsum("bnk,bnl->bkl", X, X) / 64
+d0 = jnp.abs(jax.random.normal(jax.random.key(1), (B, K), jnp.float32))
+
+for vt in (False, True):
+    f = jax.jit(lambda A, d0, vt=vt: sum(map(jnp.sum,
+        jacobi_eigh_weighted_diag_tpu(A, d0, sweeps=sweeps, vt_rows=vt))))
+    print(f"weighted kernel vt_rows={vt}: {t3(f, A, d0):.4f} s", flush=True)
+
+# --- scan vs block rolling ---
+rng = np.random.default_rng(0)
+for T, N in ((1390, 300), (2500, 5000)):
+    x = rng.normal(0.001, 0.02, (T, N)).astype(np.float32)
+    x[rng.random((T, N)) < 0.1] = np.nan
+    xj = jnp.asarray(x)
+    mkt = jnp.asarray(rng.normal(0.0005, 0.01, T).astype(np.float32))
+    for impl in ("scan", "block"):
+        blk = 64 if N == 300 else 16
+        f = jax.jit(lambda y, m, i=impl, b=blk: rolling_beta_hsigma(
+            y, m, impl=i, block=b))
+        print(f"beta_hsigma[{impl}] {T}x{N}: {t3(f, xj, mkt):.4f} s",
+              flush=True)
