@@ -245,7 +245,11 @@ def batched_eigh_weighted_diag(A, d0, *, prefer_pallas: bool | None = None,
 
         flat = A.reshape((-1,) + A.shape[-2:])
         dflat = jnp.broadcast_to(d0, A.shape[:-1]).reshape(-1, n)
-        w, h = jacobi_eigh_weighted_diag_tpu(flat, dflat, sweeps=sweeps)
+        # vt_rows: transposed eigenvector accumulator (rows-pass updates with
+        # contiguous tile sets) — measured 1.5x faster than the cols layout at
+        # the eigen MC's (139e3, 42, 42) shape on v5e (tools/kernel_ab.py).
+        w, h = jacobi_eigh_weighted_diag_tpu(flat, dflat, sweeps=sweeps,
+                                             vt_rows=True)
         return w.reshape(A.shape[:-1]), h.reshape(A.shape[:-1])
     w, V = jnp.linalg.eigh(A)
     h = jnp.einsum("...ki,...k->...i", V * V,
