@@ -1,0 +1,47 @@
+#!/bin/bash
+# Probe the TPU tunnel until it answers, then capture everything this round
+# still wants from real hardware, in priority order:
+#
+#   1. tools/refresh_hardware_evidence.sh  (parity gates + config-1 bench —
+#      re-captures PARITY_TPU.json under the current kernel defaults)
+#   2. bench.py --config alla   (the scan-path all-A number, BASELINE.md row 4)
+#   3. bench.py --config alpha  (config-5 refresh)
+#
+# Outputs land in OUTDIR (default /tmp/tpu_watch); run `git diff` afterwards —
+# refresh_hardware_evidence.sh edits PARITY_TPU.json in place when gates pass.
+#
+#   tools/tpu_watch.sh [OUTDIR] [MAX_WAIT_S]
+set -uo pipefail
+cd "$(dirname "$0")/.."
+out=${1:-/tmp/tpu_watch}
+max_wait=${2:-28800}
+mkdir -p "$out"
+
+start=$(date +%s)
+while true; do
+  if timeout 90 python -c \
+      "import jax; assert jax.devices()[0].platform in ('tpu', 'axon')" \
+      2>/dev/null; then
+    echo "tunnel up at $(date -Is)" | tee "$out/status"
+    break
+  fi
+  now=$(date +%s)
+  if (( now - start > max_wait )); then
+    echo "gave up after ${max_wait}s" | tee "$out/status"
+    exit 1
+  fi
+  sleep 60
+done
+
+bash tools/refresh_hardware_evidence.sh > "$out/refresh.log" 2>&1 \
+  || echo "refresh_hardware_evidence FAILED (see refresh.log)" >> "$out/status"
+python bench.py --config alla 2> "$out/alla.err" | tail -1 > "$out/config4_alla.json" \
+  || echo "alla bench FAILED (see alla.err)" >> "$out/status"
+python bench.py --config alpha 2> "$out/alpha.err" | tail -1 > "$out/config5_alpha.json" \
+  || echo "alpha bench FAILED (see alpha.err)" >> "$out/status"
+# a capture that fell back to CPU is NOT evidence — flag it
+grep -L '"backend": "tpu"' "$out"/config*.json 2>/dev/null \
+  | sed 's/$/: backend is not tpu/' >> "$out/status"
+echo "capture finished at $(date -Is) (check status lines above for failures)" \
+  >> "$out/status"
+cat "$out"/config*.json
