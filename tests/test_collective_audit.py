@@ -1,0 +1,22 @@
+"""The communication-layout doctrine (mfm_tpu/parallel/mesh.py) as a test:
+XLA must implement the sharded stages with stock-axis reductions only —
+no full-panel movement, and none at all for the rolling layout."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+
+from collective_audit import build_report  # noqa: E402
+
+
+def test_collective_doctrine_holds_on_virtual_mesh():
+    rep = build_report(T=64, N=48, P=5, Q=3, meshes=((4, 2),))
+    entry = rep["meshes"]["4x2"]
+    # stock axis split in two -> the normal-equation / cap-sum contractions
+    # must communicate, and only via reductions
+    assert entry["regression"]["by_kind"].get("all-reduce", 0) >= 1
+    assert entry["regression_is_reduce_only"]
+    assert entry["rolling_is_communication_free"]
+    assert entry["no_full_panel_collective"]
+    assert rep["invariants_hold"]
