@@ -15,6 +15,7 @@ from mfm_tpu.alpha.dsl import (
     compile_alpha_batch,
     evaluate_alphas,
 )
+from mfm_tpu.alpha.llm import extract_expressions
 from mfm_tpu.alpha.metrics import (
     alpha_summary,
     information_coefficient,
@@ -34,6 +35,7 @@ __all__ = [
     "compile_alpha",
     "compile_alpha_batch",
     "evaluate_alphas",
+    "extract_expressions",
     "information_coefficient",
     "rank_ic",
     "rank_turnover",

@@ -1,0 +1,125 @@
+"""Tolerant extraction of DSL expressions from raw LLM output.
+
+The strict readers (``cli._read_alpha_sources``, ``alpha --exprs``) demand
+one clean expression per line and fail fast on anything else — right for
+curated files, wrong for the actual output of an LLM asked to "propose 50
+alpha factors", which arrives wrapped in markdown fences, numbered lists,
+inline backticks, ``alpha_3 = ...`` assignments, and prose paragraphs.
+This module pulls every *valid* DSL expression out of such text and reports
+what it rejected and why, so the title's loop
+
+    LLM chat dump -> extract -> validate -> dedup -> evaluate/select
+        -> style factors of the risk model (``pipeline --alphas``)
+
+needs no hand-cleaning step.  The validator is the DSL compiler itself
+(:func:`mfm_tpu.alpha.dsl.compile_alpha` — same vocabulary, same rejection
+of non-DSL syntax); extraction only normalizes the surrounding chrome:
+
+- markdown code fences are unwrapped (their language tag line dropped);
+- list markers (``1.``, ``-``, ``*``, ``•``) and inline backticks strip;
+- ``name = expr`` / ``name: expr`` keeps the right-hand side when the left
+  is a bare identifier (the LLM's label, not a DSL field);
+- trailing ``,`` / ``;`` strip;
+- prose lines simply fail to compile and land in the rejection report; a
+  bare identifier or constant (``momentum``, ``42``) — valid DSL but never
+  a useful alpha, and exactly what stray prose words look like — is
+  rejected as ``trivial`` unless it came from inside backticks/a fence;
+- duplicates (structural: same AST after whitespace/parens/label chrome)
+  are dropped, first occurrence wins.
+
+``known_fields`` (e.g. the panel's columns) tightens validation: candidates
+referencing other names are rejected as ``unknown-field`` instead of
+crashing the evaluator later.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from mfm_tpu.alpha.dsl import compile_alpha
+
+_FENCE = re.compile(r"^\s*```")
+_LIST_MARKER = re.compile(r"^\s*(?:[-*•]|\d+[.)])\s+")
+_LABEL = re.compile(r"^\s*[A-Za-z_]\w*\s*[=:]\s*(?![=])")
+_TRAILING = re.compile(r"[,;\s]+$")
+
+
+def _candidates(text: str) -> Iterable[tuple[int, str, bool]]:
+    """Yield (lineno, cleaned-candidate, was_code_marked) per non-blank line."""
+    fenced = False
+    for no, raw in enumerate(text.splitlines(), 1):
+        if _FENCE.match(raw):
+            fenced = not fenced
+            continue
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        # inline backticks: EVERY span is its own candidate (a line may
+        # offer several alternatives); the surrounding prose is chrome
+        spans = re.findall(r"`([^`]+)`", line)
+        if spans:
+            for sp in spans:
+                sp = _TRAILING.sub("", _LABEL.sub("", sp.strip()))
+                if sp:
+                    yield no, sp, True
+            continue
+        code_marked = fenced
+        line = _LIST_MARKER.sub("", line)
+        # the DSL grammar contains no ':' anywhere, so a colon whose prefix
+        # holds no expression syntax is label chrome ("**Mean reversion**:")
+        head, sep, tail = line.partition(":")
+        if sep and not any(c in head for c in "(`="):
+            line = tail.strip()
+        line = _LABEL.sub("", line)
+        line = _TRAILING.sub("", line)
+        if line:
+            yield no, line, code_marked
+
+
+def extract_expressions(text: str, known_fields=None):
+    """Extract valid DSL expressions from raw LLM output.
+
+    Returns ``(exprs, report)``: ``exprs`` is the deduplicated list of
+    expression sources in first-seen order; ``report`` holds
+    ``n_candidates`` / ``n_extracted`` / ``n_duplicates`` and ``rejected``
+    as a list of ``(lineno, candidate, reason)`` — surfaced by the CLI so a
+    silently-dropped factor is visible, not mysterious.
+    """
+    known = set(known_fields) if known_fields is not None else None
+    exprs: list[str] = []
+    seen: set[str] = set()
+    rejected: list[tuple[int, str, str]] = []
+    n_cand = n_dup = 0
+    for no, cand, code_marked in _candidates(text):
+        n_cand += 1
+        try:
+            e = compile_alpha(cand)
+        except (ValueError, SyntaxError) as err:
+            rejected.append((no, cand, f"not DSL: {err}"))
+            continue
+        body = e.tree.body
+        if (not code_marked
+                and isinstance(body, (ast.Name, ast.Constant))):
+            rejected.append((no, cand, "trivial: bare name/constant "
+                                       "outside code markup"))
+            continue
+        if known is not None:
+            missing = [f for f in e.fields if f not in known]
+            if missing:
+                rejected.append((no, cand, f"unknown-field: {missing}"))
+                continue
+        key = ast.dump(body)
+        if key in seen:
+            n_dup += 1
+            continue
+        seen.add(key)
+        exprs.append(cand)
+    report = {
+        "n_candidates": n_cand,
+        "n_extracted": len(exprs),
+        "n_duplicates": n_dup,
+        "rejected": rejected,
+    }
+    return exprs, report

@@ -339,27 +339,40 @@ def _prepare(args):
                       "industry": industry_path}))
 
 
-def _read_alpha_sources(path):
+def _read_alpha_sources(path, llm=False):
     """Read + syntax-validate an ``--alphas`` expression file, fail-fast
     (before any expensive pipeline stage runs) with file:line context —
-    same policy as the ``alpha`` subcommand's reader."""
+    same policy as the ``alpha`` subcommand's reader.  ``llm=True`` switches
+    to tolerant extraction from raw LLM output (``alpha/llm.py``) instead of
+    one-clean-expression-per-line."""
+    import sys
+
     from mfm_tpu.alpha.dsl import compile_alpha
 
-    sources = []
     try:
         fh = open(path)
     except OSError as err:
         raise SystemExit(f"--alphas: {err}") from err
+    sources = []
     with fh:
-        for i, line in enumerate(fh, 1):
-            line = line.strip()
-            if not line or line.startswith("#"):
-                continue
-            try:
-                compile_alpha(line)
-            except (ValueError, SyntaxError) as err:
-                raise SystemExit(f"{path}:{i}: {err}") from err
-            sources.append(line)
+        if llm:
+            from mfm_tpu.alpha.llm import extract_expressions
+
+            sources, rep = extract_expressions(fh.read())
+            for no, cand, reason in rep["rejected"]:
+                # stderr: pipeline stdout is a single JSON summary line
+                print(f"--alphas (llm) {path}:{no}: skipped: {reason}",
+                      file=sys.stderr)
+        else:
+            for i, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                try:
+                    compile_alpha(line)
+                except (ValueError, SyntaxError) as err:
+                    raise SystemExit(f"{path}:{i}: {err}") from err
+                sources.append(line)
     if not sources:
         raise SystemExit(f"--alphas: {path} has no expressions")
     return sources
@@ -377,6 +390,28 @@ def _append_alpha_styles(args, sources, barra, prep):
 
     fields = {k: jnp.asarray(np.asarray(v, np.float32))
               for k, v in prep.fields.items()}
+
+    if getattr(args, "alphas_llm", False):
+        # llm mode is tolerant end to end: an extracted expression whose
+        # fields the prepared panel lacks (a hallucinated name) is dropped
+        # with a report, not a pipeline abort — the field set only becomes
+        # known here, after prepare ran
+        import sys
+
+        from mfm_tpu.alpha.dsl import compile_alpha
+
+        kept = []
+        for s in sources:
+            missing = [f for f in compile_alpha(s).fields if f not in fields]
+            if missing:
+                print(f"--alphas (llm): dropped {s!r}: unknown panel "
+                      f"field(s) {missing}", file=sys.stderr)
+            else:
+                kept.append(s)
+        if not kept:
+            raise SystemExit("--alphas: no extracted expression references "
+                             f"known panel fields (have: {sorted(fields)})")
+        sources = kept
 
     # forward returns = the barra table's own t+1 ``ret`` column, densified
     # on the prepared (dates x stocks) grid
@@ -438,7 +473,8 @@ def _pipeline(args):
     # the block stay out, and an exception inside still stops the trace
     # (no half-open profiler session)
     # fail-fast on a bad --alphas path/expression BEFORE the factor stage
-    alpha_sources = _read_alpha_sources(args.alphas) if args.alphas else None
+    alpha_sources = (_read_alpha_sources(args.alphas, llm=args.alphas_llm)
+                     if args.alphas else None)
     prep = None
     with _profile_ctx(args.profile):
         if args.resume and os.path.exists(barra_path) \
@@ -537,27 +573,39 @@ def _alpha(args):
     import sys
 
     exprs = []
+    llm_report = None
     # `--exprs -` reads stdin: the LLM-pipe workflow the title promises
     # (generator | mfm-tpu alpha --exprs - --panel ...)
     src = (contextlib.nullcontext(sys.stdin) if args.exprs == "-"
            else open(args.exprs))
     with src as fh:
-        for i, line in enumerate(fh, 1):
-            line = line.strip()
-            if not line or line.startswith("#"):
-                continue
-            try:
-                # surface syntax/vocabulary errors with a file:line; ast
-                # raises SyntaxError, the validator ValueError
-                e = compile_alpha(line)
-            except (ValueError, SyntaxError) as err:
-                raise SystemExit(f"{args.exprs}:{i}: {err}") from err
-            missing = [f for f in e.fields if f not in fields]
-            if missing:
-                raise SystemExit(
-                    f"{args.exprs}:{i}: panel has no field(s) {missing} "
-                    f"(have: {sorted(fields)})")
-            exprs.append(line)
+        if args.llm:
+            # raw chat output: tolerant extraction, rejections reported
+            # (stderr keeps stdout a clean JSON line) instead of fail-fast
+            from mfm_tpu.alpha.llm import extract_expressions
+
+            exprs, llm_report = extract_expressions(
+                fh.read(), known_fields=fields)
+            for no, cand, reason in llm_report.pop("rejected"):
+                print(f"{args.exprs}:{no}: skipped: {reason}",
+                      file=sys.stderr)
+        else:
+            for i, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                try:
+                    # surface syntax/vocabulary errors with a file:line; ast
+                    # raises SyntaxError, the validator ValueError
+                    e = compile_alpha(line)
+                except (ValueError, SyntaxError) as err:
+                    raise SystemExit(f"{args.exprs}:{i}: {err}") from err
+                missing = [f for f in e.fields if f not in fields]
+                if missing:
+                    raise SystemExit(
+                        f"{args.exprs}:{i}: panel has no field(s) {missing} "
+                        f"(have: {sorted(fields)})")
+                exprs.append(line)
     if not exprs:
         raise SystemExit(f"{args.exprs}: no expressions")
     observed = np.isfinite(np.asarray(p.fields[args.fwd_field]))
@@ -576,6 +624,8 @@ def _alpha(args):
         "n_exprs": len(exprs),
         "dates": int(values.shape[1]), "stocks": int(values.shape[2]),
     }
+    if llm_report is not None:
+        report["llm_extraction"] = llm_report
     if args.select is not None:
         # greedy top-k under the PnL-correlation cap (alpha/select.py) —
         # ranked by |mean IC| (reusing the scorecard's own, not recomputing
@@ -974,6 +1024,9 @@ def main(argv=None):
                          "the raw panel, select the best de-correlated "
                          "--alpha-top, and price them as extra style "
                          "factors (report: OUT/alpha_styles.json)")
+    pl.add_argument("--alphas-llm", action="store_true",
+                    help="treat --alphas as raw LLM output (tolerant "
+                         "extraction instead of one-expression-per-line)")
     pl.add_argument("--alpha-top", type=_positive_int, default=5,
                     help="max alpha styles to keep (default 5)")
     pl.add_argument("--alpha-max-corr", type=float, default=0.7,
@@ -1010,6 +1063,11 @@ def main(argv=None):
                          "(selected expressions when --select ran, else "
                          "all) + a FILE.exprs.txt column map — feedable "
                          "back into the factors pipeline as custom styles")
+    al.add_argument("--llm", action="store_true",
+                    help="treat --exprs as RAW LLM output (markdown fences, "
+                         "numbered lists, `name = expr` labels, prose): "
+                         "extract every valid DSL expression, dedup, and "
+                         "report what was rejected instead of failing fast")
     al.set_defaults(fn=_alpha)
 
     c = sub.add_parser("crosscheck",

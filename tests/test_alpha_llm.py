@@ -1,0 +1,132 @@
+"""Tolerant extraction of DSL expressions from raw LLM output
+(mfm_tpu/alpha/llm.py) and its --llm CLI surface."""
+
+import json
+
+import pytest
+
+from mfm_tpu.alpha.llm import extract_expressions
+from mfm_tpu.cli import main as cli_main
+
+from test_alpha_cli import panel_csv  # noqa: F401  (fixture reuse)
+
+
+CHAT = """\
+Here are some alpha factor ideas for your panel:
+
+1. `cs_rank(delta(close, 3))`
+2. **Mean reversion**: -ts_corr(close, volume, 10)
+3. alpha_momentum = cs_zscore(ts_mean(ret, 5))
+
+```python
+signed_power(cs_winsorize(ret, 2.5), 0.5)
+cs_rank(delta(close, 3))
+```
+
+Note that factor 1 captures short-term momentum, while factor 2
+is a classic price-volume divergence signal.
+
+- volume
+
+Hope these help! Let me know if you want variations.
+"""
+
+
+def test_extracts_valid_dedups_and_reports():
+    exprs, rep = extract_expressions(
+        CHAT, known_fields={"close", "ret", "volume"})
+    # four unique expressions; the fenced repeat of #1 dedups away
+    assert exprs == [
+        "cs_rank(delta(close, 3))",
+        "-ts_corr(close, volume, 10)",
+        "cs_zscore(ts_mean(ret, 5))",
+        "signed_power(cs_winsorize(ret, 2.5), 0.5)",
+    ]
+    assert rep["n_extracted"] == 4
+    assert rep["n_duplicates"] == 1
+    # prose lines land in the rejection report, not in the result
+    assert rep["rejected"]
+    assert all(r not in exprs for _, r, _ in rep["rejected"])
+
+
+def test_bare_name_needs_code_markup():
+    # "- volume" is a valid DSL expression but indistinguishable from prose;
+    # only code markup (backticks / fences) vouches for it
+    exprs, rep = extract_expressions("- volume\n")
+    assert exprs == []
+    assert rep["rejected"][0][2].startswith("trivial")
+    exprs, _ = extract_expressions("`volume`\n")
+    assert exprs == ["volume"]
+
+
+def test_every_backtick_span_is_a_candidate():
+    # "or"-style lines offer alternatives; none may vanish silently
+    exprs, rep = extract_expressions(
+        "Try `cs_rank(delta(close, 3))` or `cs_rank(volume)` here\n")
+    assert exprs == ["cs_rank(delta(close, 3))", "cs_rank(volume)"]
+    assert rep["n_candidates"] == 2
+
+
+def test_unknown_fields_are_rejected_not_fatal():
+    exprs, rep = extract_expressions(
+        "cs_rank(close)\ncs_rank(unknown_thing)\n", known_fields={"close"})
+    assert exprs == ["cs_rank(close)"]
+    assert any("unknown-field" in r for _, _, r in rep["rejected"])
+
+
+def test_label_stripping_keeps_comparisons():
+    # `x = expr` labels strip; comparison operators inside expressions don't
+    exprs, _ = extract_expressions(
+        "a1 = cs_rank(close) * (close >= delay(close, 5))\n")
+    assert exprs == ["cs_rank(close) * (close >= delay(close, 5))"]
+
+
+def test_alpha_cli_llm_mode(panel_csv, tmp_path, capsys):  # noqa: F811
+    chat = tmp_path / "chat.md"
+    chat.write_text(CHAT)
+    out = str(tmp_path / "scores.csv")
+    cli_main(["alpha", "--llm", "--exprs", str(chat), "--panel", panel_csv,
+              "--out", out])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["n_exprs"] == 4
+    assert rec["llm_extraction"]["n_extracted"] == 4
+    assert rec["llm_extraction"]["n_duplicates"] == 1
+
+
+def test_alpha_cli_llm_mode_all_prose_fails(panel_csv, tmp_path):  # noqa: F811
+    chat = tmp_path / "chat.md"
+    chat.write_text("I could not think of any factors today, sorry.\n")
+    with pytest.raises(SystemExit, match="no expressions"):
+        cli_main(["alpha", "--llm", "--exprs", str(chat), "--panel",
+                  panel_csv])
+
+
+def test_pipeline_alphas_llm_tolerates_hallucinated_fields(tmp_path, capsys):
+    """pipeline --alphas-llm: a chat dump with one hallucinated field name
+    must not abort the run — the bad expression drops with a stderr report,
+    the good ones get priced, and stdout stays one clean JSON line."""
+    import json
+    import os
+
+    from mfm_tpu.data.etl import PanelStore
+    from mfm_tpu.data.synthetic import synthetic_collections
+
+    store = tmp_path / "store"
+    synthetic_collections(PanelStore(str(store)), T=100, N=16,
+                          n_industries=4, seed=7)
+    chat = tmp_path / "chat.md"
+    chat.write_text(
+        "Two ideas:\n"
+        "1. `-delta(close, 5)`\n"
+        "2. `cs_rank(market_cap_weighted_sentiment)`\n"  # hallucinated field
+    )
+    out = str(tmp_path / "o")
+    cli_main(["pipeline", "--store", str(store), "--out", out,
+              "--eigen-sims", "4", "--start", "20200101",
+              "--alphas", str(chat), "--alphas-llm", "--alpha-top", "2"])
+    cap = capsys.readouterr()
+    rec = json.loads(cap.out.strip().splitlines()[-1])
+    assert rec["alpha_styles"] == 1
+    assert "market_cap_weighted_sentiment" in cap.err
+    rep = json.load(open(os.path.join(out, "alpha_styles.json")))
+    assert [v["expression"] for v in rep.values()] == ["-delta(close, 5)"]
