@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import inspect
 from typing import Callable, Dict, Mapping, Sequence
 
 import jax
@@ -302,6 +303,29 @@ _OPS: Dict[str, Callable] = {
     "cs_neutralize": cs_neutralize,
 }
 
+# WorldQuant "101 Formulaic Alphas" vocabulary aliases: LLMs prompted for
+# alpha factors overwhelmingly emit these operator names (the 101-alphas
+# paper is in their training data), so the DSL accepts them directly —
+# each maps onto the op of matching semantics (cross-sectional rank/scale,
+# trailing-window reductions).  delay / delta / decay_linear / ts_rank /
+# ts_argmax / ts_argmin / log / sign / abs already share names.  min/max
+# deliberately stay ELEMENTWISE (NumPy semantics) — the 101 paper reads
+# min(x, d) as ts_min; the validator rejects the ambiguous integer form
+# rather than silently picking a meaning.  Op names (incl. aliases) are
+# reserved words: a panel field may not use one.
+_ALIASES = {
+    "rank": "cs_rank",
+    "stddev": "ts_std",
+    "correlation": "ts_corr",
+    "covariance": "ts_cov",
+    "sum": "ts_sum",
+    "product": "ts_product",
+    "signedpower": "signed_power",
+    "indneutralize": "cs_neutralize",
+    "scale": "cs_scale",
+}
+_OPS.update({alias: _OPS[target] for alias, target in _ALIASES.items()})
+
 _BINOPS = {
     ast.Add: jnp.add,
     ast.Sub: jnp.subtract,
@@ -338,13 +362,33 @@ def _collect_fields(node, fields):
             fields.add(n.id)
 
 
+def _check_arity(name: str, nargs: int):
+    """Reject calls whose argument count the op cannot bind — at COMPILE
+    time, so a 101-paper signature mismatch (``scale(x, 2)``,
+    ``sum(x)`` without the window) surfaces as a reportable ValueError
+    instead of a TypeError mid-evaluation inside the jit batch."""
+    try:
+        sig = inspect.signature(_OPS[name])
+    except (TypeError, ValueError):  # some jnp callables hide theirs
+        return
+    try:
+        sig.bind(*([None] * nargs))
+    except TypeError:
+        raise ValueError(f"{name} does not take {nargs} argument(s)") from None
+
+
 def compile_alpha(source: str) -> AlphaExpr:
     """Parse an expression string into a callable panel op.
 
     Raises ValueError on any syntax outside the DSL (attribute access,
-    subscripts, lambdas, comprehensions, ... are all rejected).
+    subscripts, lambdas, comprehensions, ... are all rejected), on a call
+    with unbindable arity, on an op name used as a value (op names are
+    reserved words — evaluation would mistake one for a panel field), and
+    on the 101-ambiguous ``min(x, d)``/``max(x, d)`` integer form (the
+    paper reads it as ts_min/ts_max; this DSL's min/max are elementwise).
     """
     tree = ast.parse(source, mode="eval")
+    callees = {id(n.func) for n in ast.walk(tree) if isinstance(n, ast.Call)}
     for node in ast.walk(tree):
         if isinstance(node, (ast.Attribute, ast.Subscript, ast.Lambda, ast.ListComp,
                              ast.DictComp, ast.SetComp, ast.GeneratorExp, ast.Await,
@@ -353,6 +397,20 @@ def compile_alpha(source: str) -> AlphaExpr:
         if isinstance(node, ast.Call):
             if not isinstance(node.func, ast.Name) or node.func.id not in _OPS:
                 raise ValueError(f"unknown function in alpha: {ast.dump(node.func)[:60]}")
+            _check_arity(node.func.id, len(node.args))
+            if (node.func.id in ("min", "max") and len(node.args) == 2
+                    and isinstance(node.args[1], ast.Constant)
+                    and isinstance(node.args[1].value, int)):
+                raise ValueError(
+                    f"ambiguous {node.func.id}(x, {node.args[1].value}): the "
+                    "101-alphas paper reads this as the windowed "
+                    f"ts_{node.func.id}; write ts_{node.func.id}(x, d) for "
+                    "the window or use a float (e.g. "
+                    f"{node.args[1].value}.0) for an elementwise clamp")
+        elif isinstance(node, ast.Name) and node.id in _OPS \
+                and id(node) not in callees:
+            raise ValueError(f"op name {node.id!r} used as a value "
+                             "(op names are reserved words)")
     fields: set = set()
     _collect_fields(tree, fields)
     return AlphaExpr(source=source, tree=tree, fields=tuple(sorted(fields)))

@@ -16,7 +16,12 @@ needs no hand-cleaning step.  The validator is the DSL compiler itself
 of non-DSL syntax); extraction only normalizes the surrounding chrome:
 
 - markdown code fences are unwrapped (their language tag line dropped);
-- list markers (``1.``, ``-``, ``*``, ``•``) and inline backticks strip;
+- list markers (``1.``, ``-``, ``*``, ``•``) and inline backticks strip.
+  NOTE the convention this fixes: a leading ``- `` (dash, space) is read as
+  a LIST BULLET, never as negation — a negated alpha must be written
+  ``-expr`` with no space (how LLMs overwhelmingly format it).  The report
+  counts dash-bullet strips (``n_dash_bullets_stripped``) so a surprising
+  sign is traceable;
 - ``name = expr`` / ``name: expr`` keeps the right-hand side when the left
   is a bare identifier (the LLM's label, not a DSL field);
 - trailing ``,`` / ``;`` strip;
@@ -38,18 +43,27 @@ import ast
 import re
 from typing import Iterable
 
-from mfm_tpu.alpha.dsl import compile_alpha
+from mfm_tpu.alpha.dsl import _ALIASES, compile_alpha
 
 _FENCE = re.compile(r"^\s*```")
+_INLINE_FENCE = re.compile(r"^\s*```(.*?)```\s*$")
 _LIST_MARKER = re.compile(r"^\s*(?:[-*•]|\d+[.)])\s+")
 _LABEL = re.compile(r"^\s*[A-Za-z_]\w*\s*[=:]\s*(?![=])")
 _TRAILING = re.compile(r"[,;\s]+$")
 
 
-def _candidates(text: str) -> Iterable[tuple[int, str, bool]]:
-    """Yield (lineno, cleaned-candidate, was_code_marked) per non-blank line."""
+def _candidates(text: str) -> Iterable[tuple[int, str, bool, bool]]:
+    """Yield (lineno, cleaned-candidate, was_code_marked, was_dash_bullet)
+    per non-blank line (one per inline-backtick span on span lines)."""
     fenced = False
     for no, raw in enumerate(text.splitlines(), 1):
+        m = _INLINE_FENCE.match(raw)
+        if m:  # ```expr``` opens AND closes on one line: inline code,
+            sp = m.group(1).strip()  # not a fence toggle
+            sp = _TRAILING.sub("", _LABEL.sub("", sp))
+            if sp:
+                yield no, sp, True, False
+            continue
         if _FENCE.match(raw):
             fenced = not fenced
             continue
@@ -63,9 +77,10 @@ def _candidates(text: str) -> Iterable[tuple[int, str, bool]]:
             for sp in spans:
                 sp = _TRAILING.sub("", _LABEL.sub("", sp.strip()))
                 if sp:
-                    yield no, sp, True
+                    yield no, sp, True, False
             continue
         code_marked = fenced
+        dash_bullet = line.startswith("- ")
         line = _LIST_MARKER.sub("", line)
         # the DSL grammar contains no ':' anywhere, so a colon whose prefix
         # holds no expression syntax is label chrome ("**Mean reversion**:")
@@ -75,7 +90,20 @@ def _candidates(text: str) -> Iterable[tuple[int, str, bool]]:
         line = _LABEL.sub("", line)
         line = _TRAILING.sub("", line)
         if line:
-            yield no, line, code_marked
+            yield no, line, code_marked, dash_bullet
+
+
+def _canonical_key(body: ast.AST) -> str:
+    """Structural dedup key, alias-insensitive: ``rank(close)`` and
+    ``cs_rank(close)`` are the same factor (LLM output mixes the 101-Alphas
+    and DSL vocabularies — the whole reason the aliases exist)."""
+    import copy
+
+    b = copy.deepcopy(body)
+    for n in ast.walk(b):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name):
+            n.func.id = _ALIASES.get(n.func.id, n.func.id)
+    return ast.dump(b)
 
 
 def extract_expressions(text: str, known_fields=None):
@@ -91,8 +119,8 @@ def extract_expressions(text: str, known_fields=None):
     exprs: list[str] = []
     seen: set[str] = set()
     rejected: list[tuple[int, str, str]] = []
-    n_cand = n_dup = 0
-    for no, cand, code_marked in _candidates(text):
+    n_cand = n_dup = n_dash = 0
+    for no, cand, code_marked, dash_bullet in _candidates(text):
         n_cand += 1
         try:
             e = compile_alpha(cand)
@@ -100,26 +128,35 @@ def extract_expressions(text: str, known_fields=None):
             rejected.append((no, cand, f"not DSL: {err}"))
             continue
         body = e.tree.body
-        if (not code_marked
-                and isinstance(body, (ast.Name, ast.Constant))):
-            rejected.append((no, cand, "trivial: bare name/constant "
-                                       "outside code markup"))
+        if not e.fields:
+            # no panel dependency -> a constant signal ('-0.03', '5'),
+            # never a factor; also crashes batch stacking downstream
+            rejected.append((no, cand, "trivial: no panel fields"))
+            continue
+        if not code_marked and isinstance(body, ast.Name):
+            rejected.append((no, cand, "trivial: bare name outside "
+                                       "code markup"))
             continue
         if known is not None:
             missing = [f for f in e.fields if f not in known]
             if missing:
                 rejected.append((no, cand, f"unknown-field: {missing}"))
                 continue
-        key = ast.dump(body)
+        key = _canonical_key(body)
         if key in seen:
             n_dup += 1
             continue
         seen.add(key)
+        if dash_bullet:
+            n_dash += 1
         exprs.append(cand)
     report = {
         "n_candidates": n_cand,
         "n_extracted": len(exprs),
         "n_duplicates": n_dup,
+        # dash-space reads as a bullet, never negation (module docstring) —
+        # count the strips so a surprising sign is traceable
+        "n_dash_bullets_stripped": n_dash,
         "rejected": rejected,
     }
     return exprs, report

@@ -339,14 +339,27 @@ def _prepare(args):
                       "industry": industry_path}))
 
 
+def _extract_llm_sources(text, path, known_fields=None):
+    """Shared ``--llm`` ingestion (``alpha --llm`` and ``pipeline
+    --alphas-llm``): tolerant extraction with per-line rejection reasons on
+    stderr — stdout stays each command's single JSON line.  Returns
+    ``(sources, count-only report)``."""
+    import sys
+
+    from mfm_tpu.alpha.llm import extract_expressions
+
+    sources, rep = extract_expressions(text, known_fields=known_fields)
+    for no, cand, reason in rep.pop("rejected"):
+        print(f"{path}:{no}: skipped: {reason}", file=sys.stderr)
+    return sources, rep
+
+
 def _read_alpha_sources(path, llm=False):
     """Read + syntax-validate an ``--alphas`` expression file, fail-fast
     (before any expensive pipeline stage runs) with file:line context —
     same policy as the ``alpha`` subcommand's reader.  ``llm=True`` switches
     to tolerant extraction from raw LLM output (``alpha/llm.py``) instead of
     one-clean-expression-per-line."""
-    import sys
-
     from mfm_tpu.alpha.dsl import compile_alpha
 
     try:
@@ -356,13 +369,7 @@ def _read_alpha_sources(path, llm=False):
     sources = []
     with fh:
         if llm:
-            from mfm_tpu.alpha.llm import extract_expressions
-
-            sources, rep = extract_expressions(fh.read())
-            for no, cand, reason in rep["rejected"]:
-                # stderr: pipeline stdout is a single JSON summary line
-                print(f"--alphas (llm) {path}:{no}: skipped: {reason}",
-                      file=sys.stderr)
+            sources, _ = _extract_llm_sources(fh.read(), path)
         else:
             for i, line in enumerate(fh, 1):
                 line = line.strip()
@@ -580,15 +587,9 @@ def _alpha(args):
            else open(args.exprs))
     with src as fh:
         if args.llm:
-            # raw chat output: tolerant extraction, rejections reported
-            # (stderr keeps stdout a clean JSON line) instead of fail-fast
-            from mfm_tpu.alpha.llm import extract_expressions
-
-            exprs, llm_report = extract_expressions(
-                fh.read(), known_fields=fields)
-            for no, cand, reason in llm_report.pop("rejected"):
-                print(f"{args.exprs}:{no}: skipped: {reason}",
-                      file=sys.stderr)
+            # raw chat output: tolerant extraction instead of fail-fast
+            exprs, llm_report = _extract_llm_sources(
+                fh.read(), args.exprs, known_fields=fields)
         else:
             for i, line in enumerate(fh, 1):
                 line = line.strip()

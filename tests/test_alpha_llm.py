@@ -101,6 +101,108 @@ def test_alpha_cli_llm_mode_all_prose_fails(panel_csv, tmp_path):  # noqa: F811
                   panel_csv])
 
 
+def test_constant_candidates_rejected_everywhere():
+    """'IC: -0.03' chrome and code-marked '5' are field-free constants —
+    rejected, never handed to the batch evaluator to crash on."""
+    exprs, rep = extract_expressions("IC: -0.03\nwhere `5` is the lookback\n")
+    assert exprs == []
+    assert all("no panel fields" in r for _, _, r in rep["rejected"])
+
+
+def test_single_line_triple_fence_is_inline_code():
+    exprs, rep = extract_expressions(
+        "```cs_rank(close)```\nsome prose follows\nvolume\n",
+        known_fields={"close", "volume"})
+    # the expression is kept AND the fence state does not invert: the
+    # following bare prose words stay unmarked and are rejected
+    assert exprs == ["cs_rank(close)"]
+    assert all("trivial" in r or "not DSL" in r
+               for _, _, r in rep["rejected"])
+
+
+def test_alias_and_canonical_spellings_dedup():
+    exprs, rep = extract_expressions("`rank(close)`\n`cs_rank(close)`\n")
+    assert exprs == ["rank(close)"]
+    assert rep["n_duplicates"] == 1
+
+
+def test_op_names_are_reserved_words():
+    """A backticked bare op name (LLM prose: 'where `rank` is ...') must be
+    rejected at compile, not crash evaluation with a panel KeyError."""
+    import pytest as _pytest
+
+    from mfm_tpu.alpha.dsl import compile_alpha
+
+    for bad in ("rank", "sum + 1", "delta(rank, 5)"):
+        with _pytest.raises(ValueError, match="reserved"):
+            compile_alpha(bad)
+    exprs, rep = extract_expressions("where `rank` is the rank op\n")
+    assert exprs == []
+    assert "reserved" in rep["rejected"][0][2]
+
+
+def test_arity_checked_at_compile():
+    import pytest as _pytest
+
+    from mfm_tpu.alpha.dsl import compile_alpha
+
+    for bad in ("scale(cs_rank(close), 2)", "sum(close)",
+                "ts_corr(close, 5)"):
+        with _pytest.raises(ValueError, match="argument"):
+            compile_alpha(bad)
+    compile_alpha("cs_winsorize(close)")      # optional k still optional
+    compile_alpha("cs_winsorize(close, 3.0)")
+
+
+def test_ambiguous_windowed_min_max_rejected():
+    import pytest as _pytest
+
+    from mfm_tpu.alpha.dsl import compile_alpha
+
+    with _pytest.raises(ValueError, match="ambiguous"):
+        compile_alpha("max(close, 5)")   # 101 paper means ts_max
+    compile_alpha("ts_max(close, 5)")    # the window form
+    compile_alpha("max(close, 5.0)")     # elementwise clamp, explicit
+    compile_alpha("max(close, open)")    # two-panel elementwise
+
+
+def test_dash_bullet_convention_is_counted():
+    exprs, rep = extract_expressions("- cs_rank(delta(close, 3))\n")
+    assert exprs == ["cs_rank(delta(close, 3))"]
+    assert rep["n_dash_bullets_stripped"] == 1
+    # no-space negation is NOT a bullet
+    exprs, rep = extract_expressions("-ts_corr(close, volume, 10)\n")
+    assert exprs == ["-ts_corr(close, volume, 10)"]
+    assert rep["n_dash_bullets_stripped"] == 0
+
+
+def test_worldquant_alias_vocabulary():
+    """A genuine 101-Alphas-style expression parses and the aliases compute
+    exactly what their canonical ops compute."""
+    import numpy as np
+
+    from mfm_tpu.alpha.dsl import compile_alpha
+
+    src = ("-1 * correlation(rank(delta(log(volume), 1)), "
+           "rank((close - open) / open), 6)")
+    canon = ("-1 * ts_corr(cs_rank(delta(log(volume), 1)), "
+             "cs_rank((close - open) / open), 6)")
+    rng = np.random.default_rng(3)
+    T, N = 30, 8
+    close = np.exp(rng.normal(1, 0.1, (T, N))).astype(np.float32)
+    panel = {"close": close,
+             "open": (close * np.exp(rng.normal(0, 0.01, (T, N)))
+                      ).astype(np.float32),
+             "volume": np.exp(rng.normal(10, 1, (T, N))).astype(np.float32)}
+    a = np.asarray(compile_alpha(src)(panel))
+    b = np.asarray(compile_alpha(canon)(panel))
+    np.testing.assert_array_equal(a, b)
+    # extraction accepts the alias vocabulary too
+    exprs, _ = extract_expressions(
+        f"`{src}`\n", known_fields={"close", "open", "volume"})
+    assert exprs == [src]
+
+
 def test_pipeline_alphas_llm_tolerates_hallucinated_fields(tmp_path, capsys):
     """pipeline --alphas-llm: a chat dump with one hallucinated field name
     must not abort the run — the bad expression drops with a stderr report,
