@@ -179,20 +179,49 @@ def eigh_small(A, *, use_jacobi: bool | None = None, canonical_signs=True):
     return w, V
 
 
-def _resolve_prefer_pallas(A, prefer_pallas: bool | None) -> bool:
-    """Shared backend dispatch for the batched eigh entry points.
+def _pallas_eligible(A) -> bool:
+    """Static (shape/dtype) eligibility for the Pallas Jacobi kernel.
 
     Mosaic has no 64-bit support, so f64 (x64 parity runs,
     ``tools/tpu_parity.py --x64``) always takes XLA's emulated-f64 eigh;
-    otherwise the Pallas kernel is preferred on TPU for even n <= 128.
+    the kernel itself handles even n <= 128 only.
     """
     n = A.shape[-1]
-    if A.dtype == jnp.float64:
-        return False
+    return A.dtype != jnp.float64 and n % 2 == 0 and n <= 128
+
+
+def _dispatch_eigh(operands: tuple, prefer_pallas, pallas_fn, xla_fn):
+    """Shared backend dispatch for the batched eigh entry points.
+
+    ``operands[0]`` is the matrix batch; extra operands ride along to the
+    branch functions.  ``prefer_pallas=None`` resolves the backend at
+    LOWERING time via ``lax.platform_dependent`` — not by querying
+    ``jax.devices()`` at trace time.  The trace-time query is wrong whenever
+    the computation targets a different backend than the process default: a
+    TPU-attached process jitting onto a virtual CPU mesh (the driver's
+    ``dryrun_multichip`` gate) would bake the Pallas branch into a CPU
+    program and die in lowering.  With ``platform_dependent`` the same
+    traced program lowers the Pallas branch on TPU and the XLA eigh
+    anywhere else; for single-platform lowering the choice is made before
+    the compiler ever sees a conditional.
+    """
+    if not _pallas_eligible(operands[0]):
+        if prefer_pallas:
+            A = operands[0]
+            raise ValueError(
+                "prefer_pallas=True but the Pallas Jacobi kernel cannot "
+                f"handle dtype={A.dtype}, n={A.shape[-1]} (needs non-f64, "
+                "even n <= 128) — an explicit pin must not silently "
+                "measure the XLA fallback")
+        return xla_fn(*operands)
     if prefer_pallas is None:
-        platform = jax.devices()[0].platform
-        return platform in ("tpu", "axon") and n % 2 == 0 and n <= 128
-    return prefer_pallas
+        # 'axon' mirrors the tunnelled-TPU plugin name: device.platform
+        # reports 'tpu' there (PARITY_TPU.json), so 'tpu' is the branch that
+        # matches in practice; the alias is insurance against the plugin
+        # ever surfacing its own name as the lowering platform.
+        return jax.lax.platform_dependent(*operands, tpu=pallas_fn,
+                                          axon=pallas_fn, default=xla_fn)
+    return (pallas_fn if prefer_pallas else xla_fn)(*operands)
 
 
 def batched_eigh(A, *, prefer_pallas: bool | None = None,
@@ -210,17 +239,21 @@ def batched_eigh(A, *, prefer_pallas: bool | None = None,
     XLA/LAPACK fallback (CPU, or odd/large n) always solves to full
     precision and silently ignores it.
     """
-    if _resolve_prefer_pallas(A, prefer_pallas):
+    def _pallas(A):
         from mfm_tpu.ops.eigh_pallas import jacobi_eigh_tpu
 
         flat = A.reshape((-1,) + A.shape[-2:])
         w, V = jacobi_eigh_tpu(flat, sweeps=sweeps,
                                canonical_signs=canonical_signs, sort=sort)
         return (w.reshape(A.shape[:-1]), V.reshape(A.shape))
-    w, V = jnp.linalg.eigh(A)
-    if canonical_signs:
-        return canonicalize_signs(w, V)
-    return w, V
+
+    def _xla(A):
+        w, V = jnp.linalg.eigh(A)
+        if canonical_signs:
+            return canonicalize_signs(w, V)
+        return w, V
+
+    return _dispatch_eigh((A,), prefer_pallas, _pallas, _xla)
 
 
 def batched_eigh_weighted_diag(A, d0, *, prefer_pallas: bool | None = None,
@@ -240,21 +273,25 @@ def batched_eigh_weighted_diag(A, d0, *, prefer_pallas: bool | None = None,
     two small outputs.
     """
     n = A.shape[-1]
-    if _resolve_prefer_pallas(A, prefer_pallas):
+    d0b = jnp.broadcast_to(d0, A.shape[:-1])
+
+    def _pallas(A, d0b):
         from mfm_tpu.ops.eigh_pallas import jacobi_eigh_weighted_diag_tpu
 
         flat = A.reshape((-1,) + A.shape[-2:])
-        dflat = jnp.broadcast_to(d0, A.shape[:-1]).reshape(-1, n)
+        dflat = d0b.reshape(-1, n)
         # vt_rows: transposed eigenvector accumulator (rows-pass updates with
         # contiguous tile sets) — measured 1.5x faster than the cols layout at
         # the eigen MC's (139e3, 42, 42) shape on v5e (tools/kernel_ab.py).
         w, h = jacobi_eigh_weighted_diag_tpu(flat, dflat, sweeps=sweeps,
                                              vt_rows=True)
         return w.reshape(A.shape[:-1]), h.reshape(A.shape[:-1])
-    w, V = jnp.linalg.eigh(A)
-    h = jnp.einsum("...ki,...k->...i", V * V,
-                   jnp.broadcast_to(d0, A.shape[:-1]))
-    return w, h
+
+    def _xla(A, d0b):
+        w, V = jnp.linalg.eigh(A)
+        return w, jnp.einsum("...ki,...k->...i", V * V, d0b)
+
+    return _dispatch_eigh((A, d0b), prefer_pallas, _pallas, _xla)
 
 
 def pinv_psd(G: jax.Array, *, rcond: float | None = None,

@@ -13,6 +13,7 @@ import pytest
 from mfm_tpu.ops.eigh import (
     _brent_luk_perms,
     batched_eigh,
+    batched_eigh_weighted_diag,
     canonicalize_signs,
     jacobi_eigh,
 )
@@ -83,6 +84,57 @@ def test_batched_eigh_dispatcher_cpu():
     A = _random_sym(rng, 7, 10)
     w, V = batched_eigh(jnp.asarray(A))
     np.testing.assert_allclose(np.asarray(w), np.linalg.eigh(A)[0], atol=1e-12)
+
+
+def test_batched_eigh_dispatch_is_lowering_time_not_trace_time(monkeypatch):
+    """The Pallas-vs-XLA choice must be made by ``lax.platform_dependent``
+    at lowering time, NOT by querying ``jax.devices()`` during tracing.
+
+    The trace-time query once baked the process-default backend into the
+    program: a TPU-attached process jitting onto a virtual CPU mesh (the
+    driver's ``dryrun_multichip`` gate running after ``entry()`` in the same
+    process) selected the Pallas branch and died with "Only interpret mode
+    is supported on CPU backend".  Poisoning ``jax.devices`` proves no
+    trace-time query remains; the jitted call still runs on CPU because the
+    platform resolves during lowering.
+    """
+    from mfm_tpu.ops import eigh as eigh_mod
+
+    def _boom(*a, **k):
+        raise AssertionError("trace-time jax.devices() query in eigh dispatch")
+
+    monkeypatch.setattr(eigh_mod.jax, "devices", _boom)
+    rng = np.random.default_rng(3)
+    A = rng.standard_normal((4, 6, 6)).astype(np.float32)
+    A = jnp.asarray(A + np.swapaxes(A, -1, -2))
+    d0 = jnp.asarray(np.abs(rng.standard_normal((4, 6))).astype(np.float32))
+
+    w, _ = jax.jit(lambda A: batched_eigh(A))(A)
+    np.testing.assert_allclose(
+        np.asarray(w), np.linalg.eigh(np.asarray(A, np.float64))[0],
+        rtol=1e-5, atol=1e-6)
+    w2, h2 = jax.jit(batched_eigh_weighted_diag)(A, d0)
+    wr, Vr = np.linalg.eigh(np.asarray(A, np.float64))
+    order = np.argsort(np.asarray(w2), axis=-1)
+    np.testing.assert_allclose(
+        np.take_along_axis(np.asarray(h2), order, -1),
+        np.einsum("...ki,...k->...i", Vr**2, np.asarray(d0, np.float64)),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_explicit_pallas_pin_on_ineligible_shape_raises():
+    """An explicit ``prefer_pallas=True`` on a shape/dtype the kernel cannot
+    run (odd n, n > 128, f64) must raise, not silently measure XLA — the
+    same no-silent-fallback rule bench.py applies to platform pins."""
+    rng = np.random.default_rng(5)
+    A_odd = rng.standard_normal((2, 7, 7)).astype(np.float32)
+    A_odd = jnp.asarray(A_odd + np.swapaxes(A_odd, -1, -2))
+    with pytest.raises(ValueError, match="prefer_pallas=True"):
+        batched_eigh(A_odd, prefer_pallas=True)
+    A_f64 = jnp.asarray(np.eye(6)[None].astype(np.float64))
+    with pytest.raises(ValueError, match="prefer_pallas=True"):
+        batched_eigh_weighted_diag(A_f64, jnp.ones((1, 6)),
+                                   prefer_pallas=True)
 
 
 def test_pallas_kernel_interpret_matches_lapack():
