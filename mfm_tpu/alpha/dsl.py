@@ -273,9 +273,14 @@ _ELEMENTWISE = {
     "sign": jnp.sign,
     "sqrt": lambda x: jnp.sqrt(jnp.where(x >= 0, x, jnp.nan)),
     "power": jnp.power,
-    "min": jnp.minimum,
-    "max": jnp.maximum,
-    "where": jnp.where,
+    # explicit-arity wrappers: the raw jnp callables under-constrain
+    # ``inspect.signature`` — jnp.where defaults x/y to None (1- and 2-arg
+    # calls bind, then crash inside the jit batch) and the minimum/maximum
+    # ufunc wrappers report zero required positionals — so _check_arity
+    # could not reject ``where(cond)`` / ``min(x)`` at compile time
+    "min": lambda x, y: jnp.minimum(x, y),
+    "max": lambda x, y: jnp.maximum(x, y),
+    "where": lambda cond, x, y: jnp.where(cond, x, y),
 }
 
 _OPS: Dict[str, Callable] = {

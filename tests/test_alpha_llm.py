@@ -152,6 +152,14 @@ def test_arity_checked_at_compile():
             compile_alpha(bad)
     compile_alpha("cs_winsorize(close)")      # optional k still optional
     compile_alpha("cs_winsorize(close, 3.0)")
+    # ops whose raw jnp signatures under-constrain sig.bind: jnp.where
+    # defaults x/y (1- and 2-arg calls bound, then crashed inside the jit
+    # batch), the minimum/maximum ufunc wrappers report zero required args
+    for bad in ("where(close > 0)", "where(close > 0, close)",
+                "where(close > 0, close, 0.0, 1.0)", "min(close)", "max()"):
+        with _pytest.raises(ValueError, match="argument"):
+            compile_alpha(bad)
+    compile_alpha("where(close > 0, close, -close)")  # the 3-arg contract
 
 
 def test_ambiguous_windowed_min_max_rejected():
