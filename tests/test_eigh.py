@@ -122,6 +122,29 @@ def test_batched_eigh_dispatch_is_lowering_time_not_trace_time(monkeypatch):
         rtol=1e-4, atol=1e-5)
 
 
+def test_platform_dependent_lowerings_pick_the_right_branch():
+    """Hardware-free proof that the lowering-time dispatch picks the Pallas
+    kernel on TPU and the XLA eigh on CPU: AOT-export the same jitted
+    function for each platform from this CPU-only host and look for the
+    Mosaic custom call in the lowered module.  Catches both regressions the
+    dispatch rework could introduce — the ``tpu=`` branch not matching the
+    TPU lowering platform (silent ~8x eigen slowdown) and the Pallas branch
+    leaking into CPU programs (driver-gate lowering failure)."""
+    from jax import export
+
+    # the suite conftest enables x64 for golden parity; Mosaic lowering
+    # rejects the weak-f64 literals that mode creates, and production
+    # (pipeline fast path) runs with x64 off anyway
+    with jax.enable_x64(False):
+        A = jnp.asarray(np.eye(42, dtype=np.float32)[None].repeat(2, 0))
+        f = jax.jit(lambda A: batched_eigh(A))
+        tpu_mod = str(export.export(f, platforms=("tpu",))(A).mlir_module())
+        assert "tpu_custom_call" in tpu_mod
+        cpu_mod = str(export.export(f, platforms=("cpu",))(A).mlir_module())
+        assert "tpu_custom_call" not in cpu_mod
+        assert "eigh" in cpu_mod or "custom_call" in cpu_mod
+
+
 def test_explicit_pallas_pin_on_ineligible_shape_raises():
     """An explicit ``prefer_pallas=True`` on a shape/dtype the kernel cannot
     run (odd n, n > 128, f64) must raise, not silently measure XLA — the
