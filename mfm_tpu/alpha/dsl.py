@@ -73,8 +73,14 @@ def cs_scale(x):
 
 def delay(x, d: int):
     d = int(d)
+    if d == 0:
+        return x
+    if d >= x.shape[0]:
+        # lag past the series start: every cell is pre-history.  Without
+        # this branch the concat below would emit shape (d, N), not (T, N)
+        return jnp.full_like(x, jnp.nan)
     pad = jnp.full((d,) + x.shape[1:], jnp.nan, x.dtype)
-    return jnp.concatenate([pad, x[:-d]], axis=0) if d else x
+    return jnp.concatenate([pad, x[:-d]], axis=0)
 
 
 def delta(x, d: int):
@@ -367,6 +373,51 @@ def _collect_fields(node, fields):
             fields.add(n.id)
 
 
+# positional args that must be INTEGER CONSTANTS within a per-op range
+# (windows, lags, group counts): {canonical op: {arg index: (lo, hi)}}.
+# They parameterize static shapes, so a non-constant there
+# (``delay(close, volume)``) or a non-int (``ts_mean(close, 5.5)``,
+# ``cs_neutralize(x, g, 32.5)``) either crashes the shared jit batch at
+# trace time — aborting every expression in the chunk — or, worse, traces
+# "fine" with silently truncated semantics (arange(5.5) -> window 6).
+# Checked at compile so bad lines land in the tolerant-mode per-line
+# rejection report instead.  Windows need >= 1 and are capped at 2048: the
+# window-materializing reductions (_windows) build a (T, w, N) tensor, so
+# an LLM-emitted ``ts_rank(close, 50000)`` would OOM the whole chunk, while
+# every real trading window is <= 504 (RSTR) and 2048 is ~8 years of
+# trading days.  delay/delta lags support 0 (identity / zero) under the
+# same cap; num_groups is capped at 4096 — the op scatter-adds into a
+# (T, num_groups) table (SW L1 has 31 industries, so 4096 is generous).
+# Float-valued constants like cs_winsorize's k or exponents are
+# deliberately absent.
+_W = (1, 2048)
+_STATIC_INT_ARGS = {
+    "delay": {1: (0, 2048)}, "delta": {1: (0, 2048)},
+    "ts_mean": {1: _W}, "ts_std": {1: _W}, "ts_sum": {1: _W},
+    "ts_min": {1: _W}, "ts_max": {1: _W}, "ts_product": {1: _W},
+    "ts_rank": {1: _W}, "ts_argmax": {1: _W}, "ts_argmin": {1: _W},
+    "decay_linear": {1: _W},
+    "ts_corr": {2: _W}, "ts_cov": {2: _W},
+    "cs_neutralize": {2: (1, 4096)},
+}
+
+
+def _check_static_int_args(node: ast.Call):
+    canon = _ALIASES.get(node.func.id, node.func.id)
+    for idx, (lo, hi) in _STATIC_INT_ARGS.get(canon, {}).items():
+        if idx >= len(node.args):
+            continue  # optional (cs_neutralize's num_groups); arity is
+            # checked separately
+        a = node.args[idx]
+        if not (isinstance(a, ast.Constant) and isinstance(a.value, int)
+                and not isinstance(a.value, bool) and lo <= a.value <= hi):
+            got = ast.unparse(a)
+            raise ValueError(
+                f"{node.func.id} argument {idx + 1} must be an integer "
+                f"constant in [{lo}, {hi}] (a window/lag/group count), "
+                f"got {got!r}")
+
+
 def _check_arity(name: str, nargs: int):
     """Reject calls whose argument count the op cannot bind — at COMPILE
     time, so a 101-paper signature mismatch (``scale(x, 2)``,
@@ -403,6 +454,7 @@ def compile_alpha(source: str) -> AlphaExpr:
             if not isinstance(node.func, ast.Name) or node.func.id not in _OPS:
                 raise ValueError(f"unknown function in alpha: {ast.dump(node.func)[:60]}")
             _check_arity(node.func.id, len(node.args))
+            _check_static_int_args(node)
             if (node.func.id in ("min", "max") and len(node.args) == 2
                     and isinstance(node.args[1], ast.Constant)
                     and isinstance(node.args[1].value, int)):

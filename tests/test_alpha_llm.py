@@ -162,6 +162,57 @@ def test_arity_checked_at_compile():
     compile_alpha("where(close > 0, close, -close)")  # the 3-arg contract
 
 
+def test_window_args_must_be_positive_int_constants():
+    """Window/lag/group-count args parameterize static shapes: a float
+    window silently truncates (arange(5.5) -> 6), zero/negative windows and
+    panel-valued lags crash the shared jit batch at trace time — all must
+    be rejected per line at compile instead."""
+    import pytest as _pytest
+
+    from mfm_tpu.alpha.dsl import compile_alpha
+
+    for bad in ("ts_mean(close, 5.5)", "ts_mean(close, 0)",
+                "delta(close, -2)", "delay(close, volume)",
+                "cs_neutralize(close, ind, 32.5)",
+                "cs_neutralize(close, ind, 1000000000)",  # (T, G) table OOM
+                "ts_rank(close, 50000)",       # (T, w, N) window OOM
+                "ts_corr(close, volume, 10.0)",
+                "stddev(close, 2.5)",          # alias resolves to ts_std
+                "ts_rank(close, True)"):
+        with _pytest.raises(ValueError, match="integer constant"):
+            compile_alpha(bad)
+    # valid forms unaffected, including the optional num_groups, the
+    # delay/delta zero-lag identity, and genuinely-float parameters
+    # (winsorize k, exponents)
+    compile_alpha("ts_mean(close, 5)")
+    compile_alpha("delay(close, 0)")
+    compile_alpha("delta(close, 0)")
+    compile_alpha("cs_neutralize(close, ind)")
+    compile_alpha("cs_neutralize(close, ind, 32)")
+    compile_alpha("cs_winsorize(close, 2.5)")
+    compile_alpha("signed_power(close, 0.5)")
+    # rejection lands in the tolerant-mode report, not a batch crash
+    exprs, rep = extract_expressions("`ts_mean(close, 5.5)`\n")
+    assert exprs == []
+    assert "integer constant" in rep["rejected"][0][2]
+
+
+def test_delay_past_series_start_keeps_panel_shape():
+    """delay(x, d >= T) is all pre-history: it must return an all-NaN
+    (T, N) panel, not the (d, N) shape the pad+concat form would emit."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mfm_tpu.alpha.dsl import delay
+
+    x = jnp.asarray(np.arange(12, dtype=np.float32).reshape(4, 3))
+    for d in (4, 7):
+        out = np.asarray(delay(x, d))
+        assert out.shape == (4, 3)
+        assert np.isnan(out).all()
+    np.testing.assert_array_equal(np.asarray(delay(x, 0)), np.asarray(x))
+
+
 def test_ambiguous_windowed_min_max_rejected():
     import pytest as _pytest
 
