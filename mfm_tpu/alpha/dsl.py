@@ -354,6 +354,22 @@ _CMPOPS = {
     ast.NotEq: jnp.not_equal,
 }
 
+# exactly the node types _eval_node can evaluate (plus the operator classes
+# it maps and the Load context every Name carries): validation is a
+# WHITELIST, so anything newer/fancier — lists, ternaries, boolean ops,
+# f-strings, walrus, ast.keyword, FloorDiv/BitXor/... — fails compile with
+# a per-line ValueError instead of surfacing mid-batch as _eval_node's
+# "unsupported node" / a _BINOPS KeyError inside the shared jit trace.
+_ALLOWED_NODES = (ast.Expression, ast.Constant, ast.Name, ast.Load,
+                  ast.BinOp, ast.UnaryOp, ast.Compare, ast.Call,
+                  ast.USub, ast.UAdd) + tuple(_BINOPS) + tuple(_CMPOPS)
+
+# longest source worth parsing: the 101-alphas corpus tops out around 200
+# chars; 4096 leaves room for legitimately-deep composites while keeping
+# CPython's parser clear of the stack overflows that degenerate
+# sampling-loop lines ('-'*20000 + 'close') trigger
+_MAX_SOURCE_CHARS = 4096
+
 
 @dataclasses.dataclass
 class AlphaExpr:
@@ -418,6 +434,23 @@ def _check_static_int_args(node: ast.Call):
                 f"got {got!r}")
 
 
+# deepest expression tree accepted: real alphas nest < 20 levels; beyond
+# ~1000 the recursive _eval_node would hit Python's recursion limit at
+# evaluation time, INSIDE the shared jit batch.  Computed iteratively so
+# the check itself cannot overflow.
+_MAX_AST_DEPTH = 100
+
+
+def _ast_depth(tree) -> int:
+    depth = 0
+    stack = [(tree, 1)]
+    while stack:
+        node, d = stack.pop()
+        depth = max(depth, d)
+        stack.extend((child, d + 1) for child in ast.iter_child_nodes(node))
+    return depth
+
+
 def _check_arity(name: str, nargs: int):
     """Reject calls whose argument count the op cannot bind — at COMPILE
     time, so a 101-paper signature mismatch (``scale(x, 2)``,
@@ -436,20 +469,49 @@ def _check_arity(name: str, nargs: int):
 def compile_alpha(source: str) -> AlphaExpr:
     """Parse an expression string into a callable panel op.
 
-    Raises ValueError on any syntax outside the DSL (attribute access,
-    subscripts, lambdas, comprehensions, ... are all rejected), on a call
+    Raises ValueError on any syntax outside what :func:`_eval_node` can
+    evaluate (the whitelist below — attribute access, subscripts, lambdas,
+    comprehensions, lists/tuples/dicts, ternaries, boolean operators,
+    f-strings, ``//``/bitwise operators, ... are all rejected), on a call
     with unbindable arity, on an op name used as a value (op names are
     reserved words — evaluation would mistake one for a panel field), and
     on the 101-ambiguous ``min(x, d)``/``max(x, d)`` integer form (the
     paper reads it as ts_min/ts_max; this DSL's min/max are elementwise).
+    Everything is checked HERE so that nothing that compiles can later
+    fail inside the shared jit batch, where one bad expression would abort
+    the whole chunk: parser blowups on degenerate sampling-loop lines
+    become ValueError, and the node/operator/constant whitelists are
+    exactly ``_eval_node``'s capabilities.
     """
-    tree = ast.parse(source, mode="eval")
+    if len(source) > _MAX_SOURCE_CHARS:
+        raise ValueError(
+            f"expression too long: {len(source)} chars (max "
+            f"{_MAX_SOURCE_CHARS}) — degenerate sampling-loop line?")
+    try:
+        tree = ast.parse(source, mode="eval")
+    except (RecursionError, MemoryError):
+        # CPython's parser overflows its stack on deep token runs
+        # ('-'*3000 + 'close') — per-line handlers expect ValueError
+        raise ValueError("expression too deeply nested to parse") from None
+    depth = _ast_depth(tree)
+    if depth > _MAX_AST_DEPTH:
+        raise ValueError(
+            f"expression nests {depth} levels deep (max {_MAX_AST_DEPTH}) — "
+            "evaluation would overflow the recursion limit mid-batch")
     callees = {id(n.func) for n in ast.walk(tree) if isinstance(n, ast.Call)}
     for node in ast.walk(tree):
-        if isinstance(node, (ast.Attribute, ast.Subscript, ast.Lambda, ast.ListComp,
-                             ast.DictComp, ast.SetComp, ast.GeneratorExp, ast.Await,
-                             ast.Starred, ast.keyword)):
-            raise ValueError(f"disallowed syntax in alpha: {ast.dump(node)[:60]}")
+        if not isinstance(node, _ALLOWED_NODES):
+            raise ValueError(f"disallowed syntax in alpha: "
+                             f"{type(node).__name__} in {ast.dump(node)[:60]}")
+        if isinstance(node, ast.Constant) and (
+                isinstance(node.value, bool)
+                or not isinstance(node.value, (int, float))):
+            # strings/None/bytes/complex would reach jnp ops and die
+            # there; bools are not part of the DSL grammar either
+            raise ValueError(
+                f"non-numeric constant {str(node.value)[:40]!r} in alpha")
+        if isinstance(node, ast.Compare) and len(node.ops) != 1:
+            raise ValueError("chained comparisons unsupported in alpha")
         if isinstance(node, ast.Call):
             if not isinstance(node.func, ast.Name) or node.func.id not in _OPS:
                 raise ValueError(f"unknown function in alpha: {ast.dump(node.func)[:60]}")

@@ -120,27 +120,37 @@ def extract_expressions(text: str, known_fields=None):
     seen: set[str] = set()
     rejected: list[tuple[int, str, str]] = []
     n_cand = n_dup = n_dash = 0
+    def _trunc(s: str, cap: int = 200) -> str:
+        return s if len(s) <= cap else s[:cap] + "..."
+
+    def reject(no, cand, reason):
+        # truncate BOTH fields: monster candidates, and reasons that embed
+        # candidate text (compile_alpha's messages quote the offender)
+        rejected.append((no, _trunc(cand), _trunc(reason)))
+
     for no, cand, code_marked, dash_bullet in _candidates(text):
         n_cand += 1
         try:
             e = compile_alpha(cand)
         except (ValueError, SyntaxError) as err:
-            rejected.append((no, cand, f"not DSL: {err}"))
+            # compile_alpha guarantees this catch suffices: degenerate
+            # sampling-loop lines (over-long, parser-overflowing, or
+            # depth-capped) all surface as ValueError
+            reject(no, cand, f"not DSL: {err}")
             continue
         body = e.tree.body
         if not e.fields:
             # no panel dependency -> a constant signal ('-0.03', '5'),
             # never a factor; also crashes batch stacking downstream
-            rejected.append((no, cand, "trivial: no panel fields"))
+            reject(no, cand, "trivial: no panel fields")
             continue
         if not code_marked and isinstance(body, ast.Name):
-            rejected.append((no, cand, "trivial: bare name outside "
-                                       "code markup"))
+            reject(no, cand, "trivial: bare name outside code markup")
             continue
         if known is not None:
             missing = [f for f in e.fields if f not in known]
             if missing:
-                rejected.append((no, cand, f"unknown-field: {missing}"))
+                reject(no, cand, f"unknown-field: {missing}")
                 continue
         key = _canonical_key(body)
         if key in seen:

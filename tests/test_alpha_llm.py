@@ -197,6 +197,103 @@ def test_window_args_must_be_positive_int_constants():
     assert "integer constant" in rep["rejected"][0][2]
 
 
+def test_compile_alpha_fuzz_raises_only_value_or_syntax_errors():
+    """Tolerant-mode contract: ANY junk line fed to compile_alpha either
+    compiles or raises ValueError/SyntaxError — never a third exception
+    type, which would escape the per-line handler and abort the whole
+    ingestion run.  Seeded fragment-soup fuzz (a 20k-sample run of the same
+    generator found zero violations)."""
+    import random
+    import warnings
+
+    from mfm_tpu.alpha.dsl import compile_alpha
+
+    rng = random.Random(0)
+    frags = ["cs_rank", "ts_mean", "close", "volume", "(", ")", ",", "5",
+             "5.5", "+", "-", "*", "/", "**", ">", "where", "min", "lambda",
+             "[", "]", ".", "sum", "delay", "'x'", "__import__", "None",
+             "True", "1e300", "0", "-3", "close.T", "{", "}", ":", "x", " ",
+             "ind"]
+    n_ok = 0
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", SyntaxWarning)
+        for _ in range(2000):
+            s = "".join(rng.choice(frags)
+                        for _ in range(rng.randint(1, 12)))
+            try:
+                compile_alpha(s)
+                n_ok += 1
+            except (ValueError, SyntaxError):
+                pass
+    assert n_ok > 50  # the generator does produce valid DSL too
+
+
+def test_degenerate_sampling_loop_lines_rejected_per_line():
+    """Repeated-token LLM sampling loops produce single pathological lines
+    ('-'*20000 + 'close', 'close' '+close'*10000, deep paren nests) that
+    blow up the CPython parser (RecursionError/MemoryError) or would
+    overflow _eval_node's recursion mid-batch.  Ingestion must land every
+    one of them in the per-line rejection report and keep going."""
+    import pytest as _pytest
+
+    from mfm_tpu.alpha.dsl import compile_alpha
+
+    # under the length cap but over the AST depth cap -> compile-time
+    # rejection.  (Parens are not AST nodes: deep paren nests either
+    # collapse to depth ~3 or hit CPython's own ~200-paren SyntaxError,
+    # both already safe.)
+    with _pytest.raises(ValueError, match="levels deep"):
+        compile_alpha("-" * 500 + "close")
+    with _pytest.raises(ValueError, match="levels deep"):
+        compile_alpha("close" + "+close" * 150)
+    compile_alpha("((((close))))")            # sane nesting unaffected
+    compile_alpha("close" + "+close" * 50)    # long-but-sane sums too
+
+    dump = "\n".join([
+        "`cs_rank(delta(close, 3))`",
+        "`" + "-" * 20000 + "close`",          # parser MemoryError class
+        "`close" + "+close" * 10000 + "`",     # parser RecursionError class
+        "`" + "-" * 500 + "close`",            # depth cap
+        "`" + "(" * 500 + "close" + ")" * 500 + "`",  # parser paren limit
+    ])
+    exprs, rep = extract_expressions(dump, known_fields={"close"})
+    assert exprs == ["cs_rank(delta(close, 3))"]
+    reasons = [r for _, _, r in rep["rejected"]]
+    assert len(reasons) == 4
+    assert sum("too long" in r for r in reasons) == 2
+    assert sum("levels deep" in r for r in reasons) == 1
+    # monster candidates are truncated in the report, not echoed whole
+    assert all(len(c) <= 203 for _, c, _ in rep["rejected"])
+    # the same degenerate lines must stay per-line failures for the STRICT
+    # readers too (cli --exprs): compile_alpha itself raises ValueError,
+    # never RecursionError/MemoryError out of the parser
+    for line in ("-" * 20000 + "close", "close" + "+close" * 10000):
+        with _pytest.raises(ValueError, match="too long"):
+            compile_alpha(line)
+
+
+def test_compile_rejects_everything_eval_cannot_run():
+    """The validator is a whitelist of exactly _eval_node's capabilities:
+    anything it lets through must evaluate.  These all previously COMPILED
+    and then died mid-batch inside the shared jit trace (unsupported-node
+    ValueError or a _BINOPS KeyError)."""
+    import pytest as _pytest
+
+    from mfm_tpu.alpha.dsl import compile_alpha
+
+    for bad in ("[close]", "(close, volume)", "{1: close}",
+                "close if volume else ret", "close and volume",
+                "not close", "close // volume", "close ^ volume",
+                "close << 2", "f'{close}'", "close + 'x'",
+                "ts_mean(close, 3) < ret < close"):
+        with _pytest.raises((ValueError, SyntaxError)):
+            compile_alpha(bad)
+    # the full legitimate surface still compiles
+    for good in ("cs_rank(close) > 0.5", "-close % 2 + +volume",
+                 "where(close > 0, close ** 2, 0.0) / ts_mean(close, 5)"):
+        compile_alpha(good)
+
+
 def test_delay_past_series_start_keeps_panel_shape():
     """delay(x, d >= T) is all pre-history: it must return an all-NaN
     (T, N) panel, not the (d, N) shape the pad+concat form would emit."""
