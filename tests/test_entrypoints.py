@@ -46,6 +46,29 @@ def test_dryrun_multichip_8_devices_driver_command():
     assert "dryrun_multichip OK on 8 devices" in proc.stdout
 
 
+def test_entry_exports_for_tpu_from_cpu_host():
+    """Hardware-free TPU lowering gate for the WHOLE flagship step: AOT-
+    export entry()'s program for platform 'tpu' from this CPU-only host.
+    Catches Mosaic/XLA TPU lowering regressions anywhere in the pipeline
+    (not just the eigh dispatch) without a TPU attached, and pins that the
+    Pallas Jacobi kernel is actually part of the TPU program."""
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        # the suite env exports JAX_ENABLE_X64=true (conftest); production
+        # runs x64 off, and Mosaic rejects x64-mode weak-f64 literals
+        "jax.config.update('jax_enable_x64', False)\n"
+        "from jax import export\n"
+        "import __graft_entry__\n"
+        "fn, args = __graft_entry__.entry()\n"
+        "exp = export.export(jax.jit(fn), platforms=('tpu',))(*args)\n"
+        "mod = str(exp.mlir_module())\n"
+        "print('tpu export OK, mosaic:', 'tpu_custom_call' in mod)\n"
+    )
+    proc = _run(code, {}, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "tpu export OK, mosaic: True" in proc.stdout
+
+
 @pytest.mark.slow
 def test_entry_compiles_and_runs_single_chip():
     code = (
