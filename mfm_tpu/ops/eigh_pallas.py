@@ -121,20 +121,67 @@ def _make_kernel(n: int, sweeps: int, dtype):
         (x,) = rot_cols([y], c, s)
         return (x, vt)
 
-    def _decompose(a_ref, vt_rows=False):
+    def compose2_rows(vt, c1, s1, c2, s2):
+        """Two consecutive vt row passes fused into ONE restack.
+
+        The vt update has no feedback into the angle computation, so two
+        rounds' rotations compose: with P the fixed inter-round position
+        permutation and J'_r the r-th paired rotation,
+        ``vt2 = P(J2' P(J1' vt))`` — each output row is a 4-term
+        combination of input rows at STATIC indices.  Same FLOPs as the
+        two separate passes, one restack instead of two; an A/B candidate
+        (``v_compose2``) for the pass-overhead share of the kernel.
+        """
+        def mid(q):
+            # row q of perm_rows(J1' vt, pi) == (J1' vt)[pi[q]]
+            j, even = rotated(pi[q])
+            a, b = vt[2 * j], vt[2 * j + 1]     # (n, L) tile sets
+            return c1[j] * a - s1[j] * b if even else s1[j] * a + c1[j] * b
+
+        # each mid row feeds two output rows — compute once (unstacked:
+        # these stay loose vregs, only the final result is restacked)
+        mids = [mid(q) for q in range(n)]
+        out = []
+        for r in range(n):
+            i2, even2 = rotated(pi[r])
+            m1, m2 = mids[2 * i2], mids[2 * i2 + 1]
+            out.append(c2[i2] * m1 - s2[i2] * m2 if even2
+                       else s2[i2] * m1 + c2[i2] * m2)
+        return jnp.stack(out, axis=0)
+
+    def one_pair_vt(_, carry):
+        # two rounds per iteration: A takes its usual per-round row+col
+        # passes (angles feed back through A), vt takes one composed pass
+        x, vt = carry
+        c1, s1 = _angles(x)
+        (y,) = rot_rows([x], c1, s1)
+        (x,) = rot_cols([y], c1, s1)
+        c2, s2 = _angles(x)
+        (y,) = rot_rows([x], c2, s2)
+        (x,) = rot_cols([y], c2, s2)
+        return (x, compose2_rows(vt, c1, s1, c2, s2))
+
+    def _decompose(a_ref, vt_rows=False, v_compose2=False):
         x = a_ref[0]                          # (n, n, L)
         i3 = jax.lax.broadcasted_iota(jnp.int32, (n, n, LANES), 0)
         j3 = jax.lax.broadcasted_iota(jnp.int32, (n, n, LANES), 1)
         v = jnp.where(i3 == j3, jnp.asarray(1.0, dtype), jnp.asarray(0.0, dtype))
         # move into the interleaved basis
         x = perm_cols(perm_rows(x, b0), b0)
-        if vt_rows:
+        rounds = sweeps * (n - 1)
+        if v_compose2:
             v = perm_rows(v, b0)  # identity' = identity: vt0 = (v0)'
+            carry = jax.lax.fori_loop(0, rounds // 2, one_pair_vt, (x, v))
+            if rounds % 2:
+                carry = one_round_vt(0, carry)
+            return carry
+        if vt_rows:
+            v = perm_rows(v, b0)
             step = one_round_vt
         else:
             v = perm_cols(v, b0)
             step = one_round
-        return jax.lax.fori_loop(0, sweeps * (n - 1), step, (x, v))
+        return jax.lax.fori_loop(0, rounds, step, (x, v))
 
     def kernel(a_ref, w_ref, v_ref):
         x, v = _decompose(a_ref)
@@ -142,7 +189,7 @@ def _make_kernel(n: int, sweeps: int, dtype):
         w_ref[0] = jnp.stack([x[inv[i], inv[i]] for i in range(n)])  # (n, L)
         v_ref[0] = jnp.stack([v[:, inv[i]] for i in range(n)], axis=1)
 
-    def make_weighted_kernel(vt_rows):
+    def make_weighted_kernel(vt_rows, v_compose2=False):
         def weighted_kernel(a_ref, d_ref, w_ref, h_ref):
             # Same decomposition, but instead of writing the (n, n, L)
             # eigenvector block back to HBM, reduce it against the per-matrix
@@ -150,7 +197,8 @@ def _make_kernel(n: int, sweeps: int, dtype):
             # (original index order throughout — d is supplied in that order)
             # is v's row axis in the cols layout and vt's column axis in the
             # rows layout; slot j maps back through inv, exactly like w.
-            x, v = _decompose(a_ref, vt_rows=vt_rows)
+            x, v = _decompose(a_ref, vt_rows=vt_rows,
+                              v_compose2=v_compose2)
             d = d_ref[0]                      # (n, L), original index order
             if vt_rows:
                 hsum = jnp.sum(v * v * d[None, :, :], axis=1)
@@ -235,10 +283,11 @@ def jacobi_eigh_tpu(A: jax.Array, sweeps: int | None = None,
 
 
 @functools.partial(jax.jit, static_argnames=("sweeps", "vt_rows",
-                                             "interpret"))
+                                             "v_compose2", "interpret"))
 def jacobi_eigh_weighted_diag_tpu(A: jax.Array, d0: jax.Array,
                                   sweeps: int | None = None,
                                   vt_rows: bool = True,
+                                  v_compose2: bool = False,
                                   interpret: bool = False):
     """Fused eigenvalues + weighted eigenvector diagonal: (w, h) with
     ``h_i = sum_k V_ki^2 d0_k`` for symmetric (B, n, n) ``A`` and per-matrix
@@ -259,11 +308,20 @@ def jacobi_eigh_weighted_diag_tpu(A: jax.Array, d0: jax.Array,
     outputs, layout only): True stores it transposed so the V-update is a
     rows pass over contiguous tile sets — measured 1.5x faster than the
     cols layout's strided column slices at the eigen MC's (139e3, 42, 42)
-    shape on v5e (tools/kernel_ab.py), hence the default.
+    shape on v5e (tools/kernel_ab.py), hence the default.  ``v_compose2``
+    (vt layout only) fuses each two consecutive vt row passes into one
+    4-term restack — algebraically identical (the vt update has no
+    feedback into the angles), same FLOPs, one fewer stack
+    materialization per round pair; an A/B candidate for the
+    pass-overhead share of the kernel (``tools/kernel_ab.py``).
     """
     B, n, _ = A.shape
     assert n % 2 == 0, "pallas path requires even n"
     assert d0.shape == (B, n), (d0.shape, (B, n))  # one weight vector per matrix
+    if v_compose2 and not vt_rows:
+        # the composed update builds vt in the rows layout; reducing it with
+        # the cols-layout formula would return a silently wrong h
+        raise ValueError("v_compose2 requires vt_rows=True")
     dtype = A.dtype
     if sweeps is None:
         sweeps = _sweeps_for(n, dtype)
@@ -271,7 +329,7 @@ def jacobi_eigh_weighted_diag_tpu(A: jax.Array, d0: jax.Array,
     dx, _ = _pack_lanes(d0)                                 # (nb, n, L)
 
     _, make_weighted = _make_kernel(n, sweeps, dtype)
-    kernel = make_weighted(vt_rows)
+    kernel = make_weighted(vt_rows, v_compose2)
     w, h = pl.pallas_call(
         kernel,
         grid=(nb,),

@@ -362,3 +362,27 @@ def test_weighted_diag_kernel_vt_rows_layout_matches():
     np.testing.assert_array_equal(np.asarray(w0), np.asarray(w1))
     np.testing.assert_allclose(np.asarray(h0), np.asarray(h1),
                                rtol=1e-6, atol=1e-7)
+
+
+def test_weighted_diag_kernel_v_compose2_bitwise_identical():
+    """The composed two-round vt update performs the SAME floating-point
+    operations in the same order as two sequential vt row passes (only the
+    intermediate restack disappears), so (w, h) must be bitwise equal —
+    for both even (sweeps=4 -> 28 rounds) and odd (sweeps=7 -> 49 rounds,
+    one trailing single round) round counts at n=8."""
+    from mfm_tpu.ops.eigh_pallas import jacobi_eigh_weighted_diag_tpu
+
+    rng = np.random.default_rng(23)
+    n, B = 8, 5
+    X = rng.standard_normal((B, 16, n)).astype(np.float32)
+    A = jnp.asarray(np.einsum("bnk,bnl->bkl", X, X) / 16)
+    d0 = jnp.asarray(np.abs(rng.standard_normal((B, n))).astype(np.float32))
+
+    for sweeps in (4, 7):
+        w0, h0 = jacobi_eigh_weighted_diag_tpu(
+            A, d0, sweeps=sweeps, vt_rows=True, interpret=True)
+        w1, h1 = jacobi_eigh_weighted_diag_tpu(
+            A, d0, sweeps=sweeps, vt_rows=True, v_compose2=True,
+            interpret=True)
+        np.testing.assert_array_equal(np.asarray(w0), np.asarray(w1))
+        np.testing.assert_array_equal(np.asarray(h0), np.asarray(h1))

@@ -51,7 +51,11 @@ def test_entry_exports_for_tpu_from_cpu_host():
     export entry()'s program for platform 'tpu' from this CPU-only host.
     Catches Mosaic/XLA TPU lowering regressions anywhere in the pipeline
     (not just the eigh dispatch) without a TPU attached, and pins that the
-    Pallas Jacobi kernel is actually part of the TPU program."""
+    Pallas Jacobi kernel is actually part of the TPU program.
+
+    Deliberately NOT slow-marked (measured ~6 s — lowering only, no
+    compile/execute), same policy as the unmarked dryrun_multichip gate
+    above: gates belong in the fast suite."""
     code = (
         "import jax; jax.config.update('jax_platforms', 'cpu')\n"
         # the suite env exports JAX_ENABLE_X64=true (conftest); production

@@ -6,6 +6,9 @@ round 4's second session — run these the moment it answers):
    as the default in ops/eigh.py::batched_eigh_weighted_diag)
 2. scan-vs-block rolling kernels at CSI300 and all-A shapes (BASELINE.md's
    pending TPU numbers for the O(T*N) scan path)
+3. v_compose2 (round 4 third session): two vt row passes fused into one
+   4-term restack — bitwise-identical outputs (pinned in tests/test_eigh.py);
+   promote to the batched_eigh_weighted_diag default if it wins on hardware
 """
 import sys
 import time
@@ -44,10 +47,12 @@ X = jax.random.normal(jax.random.key(0), (B, 64, K), jnp.float32)
 A = jnp.einsum("bnk,bnl->bkl", X, X) / 64
 d0 = jnp.abs(jax.random.normal(jax.random.key(1), (B, K), jnp.float32))
 
-for vt in (False, True):
-    f = jax.jit(lambda A, d0, vt=vt: sum(map(jnp.sum,
-        jacobi_eigh_weighted_diag_tpu(A, d0, sweeps=sweeps, vt_rows=vt))))
-    print(f"weighted kernel vt_rows={vt}: {t3(f, A, d0):.4f} s", flush=True)
+for vt, comp in ((False, False), (True, False), (True, True)):
+    f = jax.jit(lambda A, d0, vt=vt, comp=comp: sum(map(jnp.sum,
+        jacobi_eigh_weighted_diag_tpu(A, d0, sweeps=sweeps, vt_rows=vt,
+                                      v_compose2=comp))))
+    print(f"weighted kernel vt_rows={vt} v_compose2={comp}: "
+          f"{t3(f, A, d0):.4f} s", flush=True)
 
 # --- scan vs block rolling ---
 rng = np.random.default_rng(0)
