@@ -343,7 +343,7 @@ CONFIGS = {
 }
 
 
-def _probe_backend(attempts=None, timeout=90):
+def _probe_backend(attempts=None, timeout=90, extra_env=None):
     """Ask (in a subprocess, so a hung TPU plugin can't wedge this process)
     which backend JAX actually brings up.  Round 1 died here: the axon TPU
     client constructor blocks forever when the tunnel is down, and the first
@@ -366,6 +366,9 @@ def _probe_backend(attempts=None, timeout=90):
             # a typo'd override must not crash before the JSON record, and
             # 0/negative must not silently skip the probe
             attempts = 10
+    # extra_env overlays os.environ in the child (e.g. mirroring an
+    # in-process JAX_PLATFORMS config pin for __graft_entry__'s gate probe)
+    env = {**os.environ, **extra_env} if extra_env else None
     err = None
     for i in range(attempts):
         try:
@@ -373,6 +376,7 @@ def _probe_backend(attempts=None, timeout=90):
                 [sys.executable, "-c",
                  "import jax; print('PLATFORM=' + jax.devices()[0].platform)"],
                 capture_output=True, text=True, timeout=timeout, cwd=REPO,
+                env=env,
             )
             for line in proc.stdout.splitlines():
                 if line.startswith("PLATFORM="):
