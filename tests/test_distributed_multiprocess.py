@@ -67,43 +67,78 @@ def _free_port():
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_mesh_and_collective():
+def _run_round():
+    """One two-worker round.  Returns (outs, None) or (None, failure str)."""
     port = _free_port()
     coord = f"127.0.0.1:{port}"
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", WORKER, str(pid), coord],
-            cwd=REPO, env=env, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True,
-        )
-        for pid in range(2)
-    ]
-    outs = []
-    for p in procs:
-        try:
-            # generous: under full-suite load the gloo handshake + two cold
-            # 4-device CPU backends can take minutes (flaked at 180 s)
-            out, err = p.communicate(timeout=600)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("distributed worker timed out")
-        assert p.returncode == 0, err[-4000:]
-        # Gloo prints connection banners to stdout around the payload — find
-        # the JSON line rather than assuming it is last
-        rec = None
-        for line in reversed(out.strip().splitlines()):
+    procs = []
+    try:
+        for pid in range(2):
+            # inside the try: a spawn failure on worker 1 (fork EAGAIN under
+            # load) must still reap worker 0 in the finally, and is itself
+            # a load symptom the retry round should ride out
             try:
-                rec = json.loads(line)
-                break
-            except json.JSONDecodeError:
-                continue
-        assert rec is not None, out[-2000:]
-        outs.append(rec)
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-c", WORKER, str(pid), coord],
+                    cwd=REPO, env=env, stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE, text=True,
+                ))
+            except OSError as e:
+                return None, f"worker spawn failed: {e}"
+        outs = []
+        for p in procs:
+            try:
+                # generous: under full-suite load the gloo handshake + two
+                # cold 4-device CPU backends can take minutes (flaked at
+                # 180 s)
+                out, err = p.communicate(timeout=600)
+            except subprocess.TimeoutExpired:
+                return None, "distributed worker timed out"
+            if p.returncode != 0:
+                return None, f"worker rc={p.returncode}: {err[-4000:]}"
+            # Gloo prints banners to stdout around the payload — find the
+            # payload dict (a bare number in a banner also parses as JSON)
+            rec = None
+            for line in reversed(out.strip().splitlines()):
+                try:
+                    cand = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(cand, dict) and "pid" in cand:
+                    rec = cand
+                    break
+            if rec is None:
+                return None, f"no JSON payload in worker stdout: {out[-2000:]}"
+            outs.append(rec)
+        return outs, None
+    finally:
+        # every failure return must reap BOTH workers: an orphaned worker
+        # blocks on the 2-process barrier forever, holding 4 virtual
+        # devices of load under the retry round
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+                q.communicate()
+
+
+@pytest.mark.slow
+def test_two_process_mesh_and_collective():
+    # One bounded retry: under full-suite load the coordinator handshake /
+    # distributed init can blow jax's INTERNAL timeouts and kill a worker
+    # even though nothing is wrong with the code (observed: green alone in
+    # ~8 s, red inside a 21-minute saturated suite run).  A deterministic
+    # breakage fails both rounds; the first failure is surfaced as a
+    # warning so persistent flaking stays visible in -rw output.
+    outs, fail = _run_round()
+    if fail is not None:
+        import warnings
+
+        warnings.warn(f"first distributed round failed ({fail}); retrying")
+        outs, fail = _run_round()
+    assert fail is None, fail
     for rec in outs:
         assert rec["rows_ok"] is True
         assert rec["total"] == float(sum(range(8)))
