@@ -278,12 +278,16 @@ _ELEMENTWISE = {
     "log": lambda x: jnp.log(jnp.where(x > 0, x, jnp.nan)),
     "sign": jnp.sign,
     "sqrt": lambda x: jnp.sqrt(jnp.where(x >= 0, x, jnp.nan)),
-    "power": jnp.power,
     # explicit-arity wrappers: the raw jnp callables under-constrain
     # ``inspect.signature`` — jnp.where defaults x/y to None (1- and 2-arg
     # calls bind, then crash inside the jit batch) and the minimum/maximum
     # ufunc wrappers report zero required positionals — so _check_arity
-    # could not reject ``where(cond)`` / ``min(x)`` at compile time
+    # could not reject ``where(cond)`` / ``min(x)`` at compile time.
+    # power is wrapped too, pre-emptively: its jnp signature is exact in
+    # the installed JAX, but a ufunc conversion upstream (exactly what
+    # happened to minimum/maximum) would silently void the compile-time
+    # guarantee with no test tripping
+    "power": lambda x, y: jnp.power(x, y),
     "min": lambda x, y: jnp.minimum(x, y),
     "max": lambda x, y: jnp.maximum(x, y),
     "where": lambda cond, x, y: jnp.where(cond, x, y),

@@ -156,10 +156,12 @@ def test_arity_checked_at_compile():
     # defaults x/y (1- and 2-arg calls bound, then crashed inside the jit
     # batch), the minimum/maximum ufunc wrappers report zero required args
     for bad in ("where(close > 0)", "where(close > 0, close)",
-                "where(close > 0, close, 0.0, 1.0)", "min(close)", "max()"):
+                "where(close > 0, close, 0.0, 1.0)", "min(close)", "max()",
+                "power(close)", "power(close, 2.0, 3.0)"):
         with _pytest.raises(ValueError, match="argument"):
             compile_alpha(bad)
     compile_alpha("where(close > 0, close, -close)")  # the 3-arg contract
+    compile_alpha("power(close, 2.0)")                # the 2-arg contract
 
 
 def test_window_args_must_be_positive_int_constants():

@@ -7,8 +7,11 @@ round 4's second session — run these the moment it answers):
 2. scan-vs-block rolling kernels at CSI300 and all-A shapes (BASELINE.md's
    pending TPU numbers for the O(T*N) scan path)
 3. v_compose2 (round 4 third session): two vt row passes fused into one
-   4-term restack — bitwise-identical outputs (pinned in tests/test_eigh.py);
-   promote to the batched_eigh_weighted_diag default if it wins on hardware
+   4-term restack — bitwise-identical outputs in INTERPRET mode (pinned in
+   tests/test_eigh.py); Mosaic-compiled hardware may schedule the fused
+   restack differently, so the A/B below also checks hardware equality
+   (allclose at f32 ulp scale) before the variant may be promoted to the
+   batched_eigh_weighted_diag default
 """
 import sys
 import time
@@ -53,6 +56,21 @@ for vt, comp in ((False, False), (True, False), (True, True)):
                                       v_compose2=comp))))
     print(f"weighted kernel vt_rows={vt} v_compose2={comp}: "
           f"{t3(f, A, d0):.4f} s", flush=True)
+
+# hardware equality gate for v_compose2 (interpret-mode pins don't bind
+# Mosaic's schedule): the fused restack must match the two-pass variant on
+# THIS backend before it may become the default
+small = slice(0, 1390)  # one date-block is plenty for an equality verdict
+f2 = jax.jit(lambda A, d0, comp: jacobi_eigh_weighted_diag_tpu(
+    A, d0, sweeps=sweeps, vt_rows=True, v_compose2=comp),
+    static_argnums=2)
+ref_out = f2(A[small], d0[small], False)
+new_out = f2(A[small], d0[small], True)
+worst = max(float(jnp.max(jnp.abs(r - n)) / (jnp.max(jnp.abs(r)) + 1e-30))
+            for r, n in zip(ref_out, new_out))
+print(f"v_compose2 hardware equality vs two-pass: max_rel={worst:.3e} "
+      f"({'OK (promotable)' if worst < 1e-5 else 'MISMATCH — do not promote'})",
+      flush=True)
 
 # --- scan vs block rolling ---
 rng = np.random.default_rng(0)
