@@ -75,6 +75,133 @@ def test_truncated_capture_rejected(tool, tmp_path):
         tool.main(["compare", a, b])
 
 
+BUDGET = os.path.join(os.path.dirname(__file__), "..", "tools",
+                      "parity_budget.json")
+
+
+def _forge_platform(src, dst, platform):
+    """Clone a capture under a different platform marker so the budget
+    logic is testable hermetically (the identical-platform tripwire would
+    otherwise dominate every verdict on a CPU-only image)."""
+    import numpy as np
+
+    with np.load(src) as f:
+        data = {k: f[k] for k in f.files if k != "platform"}
+    np.savez(dst, platform=np.array(platform), **data)
+
+
+@pytest.fixture(scope="module")
+def risk_pair(tool, tmp_path_factory):
+    """One tiny CPU risk capture + a platform-forged twin ('tpu')."""
+    d = tmp_path_factory.mktemp("budget")
+    a = str(d / "cpu.npz")
+    tool.main(["run", "--out", a, "--dates", "40", "--stocks", "12",
+               "--industries", "3", "--styles", "2", "--sims", "4",
+               "--platform", "cpu"])
+    b = str(d / "tpu.npz")
+    _forge_platform(a, b, "tpu")
+    return a, b
+
+
+def test_budget_passes_on_agreeing_captures(tool, risk_pair, capsys):
+    a, b = risk_pair
+    with pytest.raises(SystemExit) as ei:
+        tool.main(["compare", a, b, "--budget", BUDGET])
+    lines = [json.loads(x) for x in capsys.readouterr().out.splitlines()]
+    verdict = lines[-1]
+    assert ei.value.code == 0
+    assert verdict["parity"] is True and verdict["budget"] == BUDGET
+    # every stage record carries its resolved budget ceiling
+    assert all("budget" in r for r in lines[:-1])
+
+
+def test_budget_fails_on_regressed_tail_and_median(tool, risk_pair, tmp_path,
+                                                   capsys):
+    """A drift regression in ONE stage must name that stage: a tail bump
+    beyond its max_rel ceiling, and separately a broad offset that moves
+    the median while staying under the tail ceiling."""
+    import numpy as np
+
+    a, b = risk_pair
+    with np.load(b) as f:
+        data = {k: f[k] for k in f.files}
+    scale = float(np.nanmax(np.abs(data["eigen_cov"])))
+    # tail regression: one element off by 100x the 5e-4 eigen budget — the
+    # LAST date's cell (early expanding-window dates are NaN and masked)
+    tail = dict(data)
+    tail["eigen_cov"] = data["eigen_cov"].copy()
+    tail["eigen_cov"][-1, 0, 0] += 5e-2 * scale
+    bad = str(tmp_path / "tail.npz")
+    np.savez(bad, **tail)
+    with pytest.raises(SystemExit) as ei:
+        tool.main(["compare", a, bad, "--budget", BUDGET])
+    verdict = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert ei.value.code == 1
+    assert verdict["failed"] == ["eigen_cov:max_rel"]
+
+    # median regression: every element off by 1e-4 of scale — under the
+    # 5e-4 tail ceiling, far over the 5e-6 median ceiling
+    med = dict(data)
+    med["eigen_cov"] = data["eigen_cov"] + 1e-4 * scale
+    bad2 = str(tmp_path / "med.npz")
+    np.savez(bad2, **med)
+    with pytest.raises(SystemExit) as ei:
+        tool.main(["compare", a, bad2, "--budget", BUDGET])
+    verdict = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert verdict["failed"] == ["eigen_cov:median_rel"]
+
+
+def test_low_sweep_count_fails_budget(tool, risk_pair, tmp_path, capsys):
+    """The scenario the budget exists for: a deliberately under-converged
+    Jacobi sweep count (1 sweep vs the solver default) produces eigen-stage
+    drift that MUST trip the eigen_cov budget — run through the real
+    compare path with the low-sweep covariances injected into a capture."""
+    import numpy as np
+    import jax.numpy as jnp
+    from mfm_tpu.ops.eigh_pallas import jacobi_eigh_weighted_diag_tpu
+
+    rng = np.random.default_rng(7)
+    n, M = 8, 3
+    X = rng.standard_normal((M, n, 64)).astype(np.float32)
+    C = np.einsum("mkt,mlt->mkl", X, X) / 64
+    d0 = np.abs(rng.normal(1.0, 0.3, (M, n))).astype(np.float32)
+    full = jacobi_eigh_weighted_diag_tpu(jnp.asarray(C), jnp.asarray(d0),
+                                         interpret=True)
+    low = jacobi_eigh_weighted_diag_tpu(jnp.asarray(C), jnp.asarray(d0),
+                                        sweeps=1, interpret=True)
+
+    def cov_like(w_h):
+        w = np.asarray(w_h[0], np.float64)
+        return np.einsum("mi,mj->mij", w, w)  # any smooth function of w
+
+    a, b = risk_pair
+    with np.load(a) as f:
+        base = {k: f[k] for k in f.files}
+    ca, cb = dict(base), dict(base)
+    ca["eigen_cov"] = cov_like(full)
+    cb["eigen_cov"] = cov_like(low)
+    cb["platform"] = np.array("tpu")
+    fa, fb = str(tmp_path / "full.npz"), str(tmp_path / "low.npz")
+    np.savez(fa, **ca)
+    np.savez(fb, **cb)
+    with pytest.raises(SystemExit) as ei:
+        tool.main(["compare", fa, fb, "--budget", BUDGET])
+    verdict = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert ei.value.code == 1
+    assert any(f.startswith("eigen_cov:") for f in verdict["failed"])
+
+
+def test_budget_file_must_cover_the_kind(tool, risk_pair, tmp_path):
+    import json as _json
+
+    a, b = risk_pair
+    empty = str(tmp_path / "empty_budget.json")
+    with open(empty, "w") as fh:
+        _json.dump({"factors": {"default": {"max_rel": 1e-3}}}, fh)
+    with pytest.raises(SystemExit, match="no 'risk' section"):
+        tool.main(["compare", a, b, "--budget", empty])
+
+
 def test_legacy_capture_compares_against_fresh_one(tool, tmp_path, capsys):
     """A pre-marker (legacy) risk capture stays comparable with a fresh one
     that carries the stage key; only genuinely different stages or data

@@ -6,10 +6,13 @@
 #   tools/refresh_hardware_evidence.sh [OUTDIR]
 #
 # Produces OUTDIR (default /tmp/hw_evidence) with the raw .npz captures and
-# OUTDIR/summary.json holding the three gate verdicts + the bench line:
+# OUTDIR/summary.json holding the gate verdicts + the bench line:
 #   - risk stack, float64, gate 1e-5   (the reference-precision contract)
 #   - factor pipeline, float64, gate 1e-5
-#   - factor pipeline, float32, gate 1e-3 (fast-path drift, measured)
+#   - risk stack, float32, per-stage budgets (tools/parity_budget.json)
+#   - factor pipeline, float32, per-stage budgets
+# The f32 budget gates bound the production fast path's drift between
+# backends so a kernel/layout experiment cannot silently regress the tails.
 # A dead tunnel fails fast at the probe instead of hanging.
 set -e
 cd "$(dirname "$0")/.."
@@ -34,7 +37,12 @@ python tools/tpu_parity.py run --stage factors --out "$out/fac_tpu32.npz"
 python tools/tpu_parity.py run --stage factors --platform cpu \
   --out "$out/fac_cpu32.npz"
 python tools/tpu_parity.py compare "$out/fac_tpu32.npz" "$out/fac_cpu32.npz" \
-  --gate 1e-3 > "$out/compare_factors32.json" || true
+  --budget tools/parity_budget.json > "$out/compare_factors32.json" || true
+
+python tools/tpu_parity.py run --out "$out/risk_tpu32.npz"
+python tools/tpu_parity.py run --platform cpu --out "$out/risk_cpu32.npz"
+python tools/tpu_parity.py compare "$out/risk_tpu32.npz" "$out/risk_cpu32.npz" \
+  --budget tools/parity_budget.json > "$out/compare_risk32.json" || true
 
 python bench.py > "$out/bench.json"
 
@@ -44,7 +52,8 @@ out = os.environ["OUT"]
 summary = {}
 for key, name in (("risk_f64_gate_1e-5", "compare_risk64.json"),
                   ("factors_f64_gate_1e-5", "compare_factors64.json"),
-                  ("factors_f32_gate_1e-3", "compare_factors32.json"),
+                  ("factors_f32_budget", "compare_factors32.json"),
+                  ("risk_f32_budget", "compare_risk32.json"),
                   ("bench", "bench.json")):
     with open(os.path.join(out, name)) as fh:
         recs = [json.loads(l) for l in fh.read().splitlines() if l.strip()]

@@ -22,7 +22,11 @@ the check that both backends really ran)
 ``compare`` prints one JSON line per stage with max/median relative
 difference over valid dates and exits nonzero if any stage exceeds
 ``--gate`` (default 1e-5, the framework's parity contract vs the float64
-reference; TPU-vs-CPU f32 differences sit well below it).
+reference; TPU-vs-CPU f32 differences sit well below it).  For the f32
+fast path — where drift is measured, not subject to the 1e-5 contract —
+``compare --budget tools/parity_budget.json`` gates each stage against its
+own frozen max_rel/median_rel ceiling instead, so a kernel or layout
+experiment cannot silently regress the tails.
 """
 
 from __future__ import annotations
@@ -124,6 +128,22 @@ REQUIRED_STAGES = {
 }
 
 
+def _load_budget(path, kind):
+    """Per-stage drift budgets (``tools/parity_budget.json``): frozen from
+    the measured f32 tails so kernel experiments cannot silently regress
+    accuracy.  Each capture kind's section must carry a ``default`` entry —
+    a budget file that silently skipped unknown stages would let a NEW
+    stage regress ungated."""
+    with open(path) as fh:
+        all_budgets = json.load(fh)
+    section = all_budgets.get(kind)
+    if not isinstance(section, dict) or "default" not in section:
+        raise SystemExit(
+            f"budget file {path} has no '{kind}' section with a 'default' "
+            "entry — nothing gated")
+    return section
+
+
 def _compare(args):
     a, b = np.load(args.a), np.load(args.b)
 
@@ -148,6 +168,7 @@ def _compare(args):
         # a gate over a truncated capture must not pass
         raise SystemExit(f"{kind} capture is missing stage(s) "
                          f"{sorted(missing)} — nothing gated")
+    budget = _load_budget(args.budget, kind) if args.budget else None
     # stage-agnostic diff: every saved array is a stage (validity masks are
     # exact-matched below) — the same compare serves risk and factor runs
     stages = sorted(k for k in a.files
@@ -166,7 +187,15 @@ def _compare(args):
         rec = {"stage": name, "n": int(m.sum()),
                "max_rel": float(d.max()) if d.size else 0.0,
                "median_rel": float(np.median(d)) if d.size else 0.0}
-        if rec["max_rel"] > args.gate:
+        if budget is not None:
+            lim = budget.get(name, budget["default"])
+            rec["budget"] = lim
+            if rec["max_rel"] > lim["max_rel"]:
+                failed.append(name + ":max_rel")
+            if (lim.get("median_rel") is not None
+                    and rec["median_rel"] > lim["median_rel"]):
+                failed.append(name + ":median_rel")
+        elif rec["max_rel"] > args.gate:
             failed.append(name)
         print(json.dumps(rec))
     for name in (k for k in a.files if k.endswith("_valid")):
@@ -176,8 +205,11 @@ def _compare(args):
     if plats[0] == plats[1]:
         # same backend twice proves determinism, not hardware parity
         failed.append("platforms:identical")
-    verdict = {"parity": not failed, "gate": args.gate, "failed": failed,
-               "platforms": plats}
+    verdict = {"parity": not failed, "failed": failed, "platforms": plats}
+    if budget is not None:
+        verdict["budget"] = args.budget
+    else:
+        verdict["gate"] = args.gate
     print(json.dumps(verdict))
     sys.exit(1 if failed else 0)
 
@@ -207,6 +239,11 @@ def main(argv=None):
     c.add_argument("a")
     c.add_argument("b")
     c.add_argument("--gate", type=float, default=1e-5)
+    c.add_argument("--budget", default=None, metavar="BUDGET_JSON",
+                   help="per-stage drift budgets (tools/parity_budget.json) "
+                        "instead of the flat --gate: each stage must meet "
+                        "its own max_rel AND median_rel ceiling, so kernel "
+                        "experiments cannot silently regress the f32 tails")
     c.set_defaults(fn=_compare)
     args = ap.parse_args(argv)
     args.fn(args)
