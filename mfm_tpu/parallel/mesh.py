@@ -58,6 +58,38 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def pad_to_mesh(x, mesh: Mesh, *, fill=0, rolling: bool = False):
+    """Pad a (T, N, ...) array's sharded axes up to mesh-divisible sizes.
+
+    ``jax.device_put`` requires each sharded dimension's global size to be
+    divisible by its mesh axis; real panels rarely oblige (CSI300's
+    T=1,390 divides neither 4 nor 8).  The framework's masked design makes
+    padding inert: pad ``valid``/observed masks with False and data with
+    ``fill`` — 0 for risk-stage arrays (their reductions multiply by the
+    mask) or NaN for FactorEngine fields (NaN already means missing/never
+    listed).  Time padding appends AFTER the last date, so every causal
+    stage (expanding/trailing windows, the NW and vol-regime scans) leaves
+    real-date outputs unchanged; crop outputs back with ``[:T]`` /
+    ``[:, :N]``.  Bool arrays always pad False regardless of ``fill``.
+    """
+    n_date, n_stock = mesh.shape["date"], mesh.shape["stock"]
+    if rolling:
+        pads = {1: n_date * n_stock} if x.ndim > 1 else {}
+    else:
+        pads = {0: n_date}
+        if x.ndim > 1:
+            pads[1] = n_stock
+    widths = [(0, 0)] * x.ndim
+    for ax, div in pads.items():
+        widths[ax] = (0, (-x.shape[ax]) % div)
+    if not any(w[1] for w in widths):
+        return x
+    import jax.numpy as jnp
+
+    v = False if x.dtype == bool else fill
+    return jnp.pad(x, widths, constant_values=v)
+
+
 def shard_panel(x, mesh: Mesh, *, rolling: bool = False):
     """device_put a (T, N, ...) array (or pytree of them) onto the mesh."""
     s = panel_sharding(mesh, rolling=rolling)

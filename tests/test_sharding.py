@@ -13,7 +13,9 @@ from mfm_tpu.data.barra import barra_frame_to_arrays
 from mfm_tpu.data.synthetic import synthetic_barra_table
 from mfm_tpu.models.risk_model import RiskModel
 from mfm_tpu.ops.rolling import rolling_beta_hsigma
-from mfm_tpu.parallel.mesh import make_mesh, panel_sharding, shard_panel
+from mfm_tpu.parallel.mesh import (
+    make_mesh, pad_to_mesh, panel_sharding, shard_panel,
+)
 
 
 @pytest.fixture(scope="module")
@@ -33,19 +35,19 @@ def _model(a, **kw):
     )
 
 
-def test_full_pipeline_sharded_matches_single_device(arrays):
-    assert len(jax.devices()) == 8, "tests expect the 8-device virtual CPU mesh"
-    a = arrays
+def _assert_pipeline_sharded_equal(a, n_date, n_stock):
     rm = _model(a)
+    T = rm.ret.shape[0]
     sim = jax.random.normal(jax.random.key(0), (8, rm.K, 100), jnp.float64)
     d = sim - sim.mean(axis=-1, keepdims=True)
     sim_covs = jnp.einsum("mkt,mlt->mkl", d, d) / 99.0
 
     base = rm.run(sim_covs=sim_covs)
 
-    mesh = make_mesh(4, 2)
-    ps = panel_sharding(mesh)
+    mesh = make_mesh(n_date, n_stock)
     args = (rm.ret, rm.cap, rm.styles, rm.industry, rm.valid)
+    # indivisible shapes pad (inertly — valid pads False) and crop back
+    args = tuple(pad_to_mesh(v, mesh) for v in args)
     sharded_args = shard_panel(args, mesh)
 
     def pipeline(ret, cap, styles, industry, valid, sim_covs):
@@ -56,14 +58,67 @@ def test_full_pipeline_sharded_matches_single_device(arrays):
     with jax.set_mesh(mesh):
         out = jax.jit(pipeline)(*sharded_args, sim_covs)
 
-    np.testing.assert_allclose(np.asarray(out.factor_ret),
+    np.testing.assert_allclose(np.asarray(out.factor_ret)[:T],
                                np.asarray(base.factor_ret), rtol=1e-9, atol=1e-12)
-    np.testing.assert_allclose(np.asarray(out.nw_cov), np.asarray(base.nw_cov),
+    np.testing.assert_allclose(np.asarray(out.nw_cov)[:T], np.asarray(base.nw_cov),
                                rtol=1e-8, atol=1e-14)
-    np.testing.assert_allclose(np.asarray(out.vr_cov), np.asarray(base.vr_cov),
+    np.testing.assert_allclose(np.asarray(out.vr_cov)[:T], np.asarray(base.vr_cov),
                                rtol=1e-7, atol=1e-13, equal_nan=True)
-    np.testing.assert_allclose(np.asarray(out.lamb), np.asarray(base.lamb),
+    np.testing.assert_allclose(np.asarray(out.lamb)[:T], np.asarray(base.lamb),
                                rtol=1e-8, atol=1e-12)
+
+
+def test_full_pipeline_sharded_matches_single_device(arrays):
+    assert len(jax.devices()) == 8, "tests expect the 8-device virtual CPU mesh"
+    _assert_pipeline_sharded_equal(arrays, 4, 2)
+
+
+def test_full_pipeline_sharded_uneven_shapes():
+    """Production shapes do NOT divide the mesh (CSI300's T=1,390 is not a
+    multiple of 4 or 8): uneven shards (XLA pads the trailing device) must
+    stay equal to the single-device run — on BOTH axes at once (T=67 on a
+    4-way date axis, N=45 on a 2-way stock axis)."""
+    df, style_names = synthetic_barra_table(T=67, N=45, P=5, Q=3, seed=11,
+                                            missing=0.04)
+    a = barra_frame_to_arrays(df, style_names=style_names)
+    _assert_pipeline_sharded_equal(a, 4, 2)
+
+
+def test_factor_engine_uneven_stock_shards():
+    """The row-space argsort/gather path with N % mesh != 0: 30 stocks over
+    8 devices (two devices get 3, six get 4 — XLA's padded layout)."""
+    from mfm_tpu.config import FactorConfig
+    from mfm_tpu.data.synthetic import (
+        panel_to_engine_fields, synthetic_market_panel,
+    )
+    from mfm_tpu.factors.engine import FactorEngine
+
+    data = synthetic_market_panel(T=70, N=30, n_industries=5, seed=4)
+    fields = panel_to_engine_fields(data, jnp.float64)
+    idx_close = jnp.asarray(data["index_close"], jnp.float64)
+
+    eng = FactorEngine(fields, idx_close, config=FactorConfig(), block=16)
+    base = {k: np.asarray(v) for k, v in eng.run().items()}
+
+    mesh = make_mesh(1, 8)
+    sharding = NamedSharding(mesh, P(None, "stock"))
+    # NaN fill = never-listed stocks; the int report id pads -1 (= none)
+    sh_fields = {
+        k: jax.device_put(
+            pad_to_mesh(v, mesh, rolling=True,
+                        fill=-1 if k == "end_date_code" else np.nan),
+            sharding)
+        for k, v in fields.items()
+    }
+    eng_sh = FactorEngine(sh_fields, idx_close, config=FactorConfig(),
+                          block=16)
+    with jax.set_mesh(mesh):
+        out = {k: np.asarray(v)[:, :30] for k, v in eng_sh.run().items()}
+
+    assert set(out) == set(base)
+    for k in base:
+        np.testing.assert_allclose(out[k], base[k], rtol=1e-7, atol=1e-10,
+                                   equal_nan=True, err_msg=k)
 
 
 def test_rolling_kernel_stock_sharded(arrays):
