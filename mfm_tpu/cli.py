@@ -128,6 +128,7 @@ def _risk(args):
     cfg = PipelineConfig(
         risk=RiskModelConfig(
             nw_lags=args.nw_lags, nw_half_life=args.nw_half_life,
+            nw_method=args.nw_method,
             eigen_n_sims=args.eigen_sims, eigen_scale_coef=args.eigen_scale,
             vol_regime_half_life=args.vr_half_life, seed=args.seed,
         ),
@@ -463,6 +464,7 @@ def _pipeline(args):
     cfg = PipelineConfig(
         risk=RiskModelConfig(
             nw_lags=args.nw_lags, nw_half_life=args.nw_half_life,
+            nw_method=args.nw_method,
             eigen_n_sims=args.eigen_sims, eigen_scale_coef=args.eigen_scale,
             vol_regime_half_life=args.vr_half_life, seed=args.seed,
         ),
@@ -887,6 +889,11 @@ def main(argv=None):
     r.add_argument("--out", default="results")
     r.add_argument("--nw-lags", type=int, default=2)
     r.add_argument("--nw-half-life", type=float, default=252.0)
+    r.add_argument("--nw-method", choices=["scan", "associative"],
+                   default="scan",
+                   help="expanding Newey-West evaluation: serial lax.scan "
+                        "(single-chip default) or associative_scan (O(log T) "
+                        "depth; keeps the date axis sharded on a mesh)")
     r.add_argument("--eigen-sims", type=int, default=100)
     r.add_argument("--eigen-scale", type=float, default=1.4)
     r.add_argument("--vr-half-life", type=float, default=42.0)
@@ -989,6 +996,11 @@ def main(argv=None):
                          "readable by `risk --barra-store`")
     pl.add_argument("--nw-lags", type=int, default=2)
     pl.add_argument("--nw-half-life", type=float, default=252.0)
+    pl.add_argument("--nw-method", choices=["scan", "associative"],
+                    default="scan",
+                    help="expanding Newey-West evaluation: serial lax.scan "
+                         "(single-chip default) or associative_scan "
+                         "(O(log T) depth; keeps the date axis sharded)")
     pl.add_argument("--eigen-sims", type=int, default=100)
     pl.add_argument("--eigen-scale", type=float, default=1.4)
     pl.add_argument("--vr-half-life", type=float, default=42.0)

@@ -111,6 +111,11 @@ class RiskModelConfig:
 
     nw_lags: int = 2
     nw_half_life: float = 252.0
+    #: expanding Newey-West evaluation: "scan" (O(T) serial lax.scan, the
+    #: single-chip default) or "associative" (lax.associative_scan — O(log T)
+    #: depth, the date axis stays sharded; the sequence-parallel choice for
+    #: long panels on a date-sharded mesh, models/newey_west.py:138-224)
+    nw_method: str = "scan"
     eigen_n_sims: int = 100
     eigen_scale_coef: float = 1.4
     eigen_sim_length: int | None = None  # None => use panel length T (MFM.py:119)
@@ -135,6 +140,11 @@ class RiskModelConfig:
             raise ValueError(
                 f"eigen_sim_sweeps must be an int >= 1, None, or 'auto'; "
                 f"got {s!r}"
+            )
+        if self.nw_method not in ("scan", "associative"):
+            raise ValueError(
+                f"nw_method must be 'scan' or 'associative', "
+                f"got {self.nw_method!r}"
             )
 
 

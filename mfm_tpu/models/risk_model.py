@@ -93,7 +93,7 @@ class RiskModel:
     def newey_west_by_time(self, factor_ret):
         return newey_west_expanding(
             factor_ret, q=self.config.nw_lags, half_life=self.config.nw_half_life,
-            min_valid=self.K,
+            min_valid=self.K, method=self.config.nw_method,
         )
 
     # -- stage 3 -----------------------------------------------------------

@@ -10,3 +10,13 @@ def test_rolling_impl_validated():
     assert PipelineConfig(rolling_impl="block").rolling_impl == "block"
     with pytest.raises(ValueError):
         PipelineConfig(rolling_impl="Scan")
+
+
+def test_nw_method_validated():
+    import pytest
+
+    from mfm_tpu.config import RiskModelConfig
+
+    with pytest.raises(ValueError, match="nw_method"):
+        RiskModelConfig(nw_method="typo")
+    assert RiskModelConfig(nw_method="associative").nw_method == "associative"

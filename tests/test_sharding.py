@@ -121,6 +121,43 @@ def test_factor_engine_uneven_stock_shards():
                                    equal_nan=True, err_msg=k)
 
 
+def test_full_pipeline_associative_nw_sharded_matches_scan(arrays):
+    """RiskModelConfig(nw_method='associative') end-to-end on a fully
+    date-sharded mesh == the serial-scan single-device run.  The NW stage
+    is the pipeline's only sequentially-dependent stage; the associative
+    form keeps the date axis sharded through it (sequence parallelism)."""
+    a = arrays
+    rm = _model(a)
+    sim = jax.random.normal(jax.random.key(0), (8, rm.K, 100), jnp.float64)
+    d = sim - sim.mean(axis=-1, keepdims=True)
+    sim_covs = jnp.einsum("mkt,mlt->mkl", d, d) / 99.0
+    base = rm.run(sim_covs=sim_covs)
+
+    cfg = RiskModelConfig(eigen_n_sims=8, eigen_sim_length=100,
+                          nw_method="associative")
+    mesh = make_mesh(8, 1)
+    args = shard_panel((rm.ret, rm.cap, rm.styles, rm.industry, rm.valid),
+                       mesh)
+
+    def pipeline(ret, cap, styles, industry, valid, sim_covs):
+        m = RiskModel(ret, cap, styles, industry, valid,
+                      n_industries=a.n_industries, config=cfg)
+        return m.run(sim_covs=sim_covs)
+
+    with jax.set_mesh(mesh):
+        out = jax.jit(pipeline)(*args, sim_covs)
+
+    np.testing.assert_array_equal(np.asarray(out.nw_valid),
+                                  np.asarray(base.nw_valid))
+    np.testing.assert_allclose(np.asarray(out.nw_cov),
+                               np.asarray(base.nw_cov), rtol=1e-8, atol=1e-14)
+    np.testing.assert_allclose(np.asarray(out.vr_cov),
+                               np.asarray(base.vr_cov), rtol=1e-7, atol=1e-13,
+                               equal_nan=True)
+    np.testing.assert_allclose(np.asarray(out.lamb), np.asarray(base.lamb),
+                               rtol=1e-8, atol=1e-12)
+
+
 def test_rolling_kernel_stock_sharded(arrays):
     rng = np.random.default_rng(0)
     T, N = 80, 64

@@ -72,6 +72,22 @@ print(f"v_compose2 hardware equality vs two-pass: max_rel={worst:.3e} "
       f"({'OK (promotable)' if worst < 1e-5 else 'MISMATCH — do not promote'})",
       flush=True)
 
+# --- Newey-West: serial scan vs associative (sequence-parallel) ---
+# single-chip A/B: the associative form's O(log T) depth trades more total
+# FLOPs for parallelism, so on ONE chip the serial scan usually wins; the
+# associative form's case is a date-sharded mesh (tests/test_sharding.py
+# pins equality there).  Record both at CSI300 and all-A T.
+from mfm_tpu.models.newey_west import newey_west_expanding  # noqa: E402
+
+for T, K in ((1390, 42), (2500, 42)):
+    f = jnp.asarray(np.random.default_rng(2).standard_normal((T, K)) * 0.01,
+                    jnp.float32)
+    for method in ("scan", "associative"):
+        g = jax.jit(lambda r, m=method: newey_west_expanding(r, 2, 252.0,
+                                                             method=m)[0])
+        print(f"newey_west[{method}] T={T} K={K}: {t3(g, f):.4f} s",
+              flush=True)
+
 # --- scan vs block rolling ---
 rng = np.random.default_rng(0)
 for T, N in ((1390, 300), (2500, 5000)):
