@@ -62,13 +62,18 @@ def winsorize_cs(x, n_std: float = 2.5, axis=-1):
     """Per-cross-section clip at mean +/- n_std * sample std (ddof=1).
 
     Contract: ``post_processing.py:12-15`` — pandas ``x.mean()/x.std()`` skip
-    NaN and use ddof=1; ``clip`` leaves NaN in place.
+    NaN and use ddof=1; ``clip`` leaves NaN in place.  A single-survivor
+    section has NaN sample std, and pandas ``clip`` IGNORES NaN thresholds —
+    the value passes through unclipped (jnp.clip would propagate the NaN;
+    divergence found by tools/crosscheck_golden.py at the first date a
+    factor's expanding window matures for exactly one stock).
     """
     m = jnp.isfinite(x)
     mu = masked_mean(x, m, axis=axis, keepdims=True)
     sd = masked_std(x, m, axis=axis, ddof=1, keepdims=True)
     lo, hi = mu - n_std * sd, mu + n_std * sd
-    return jnp.where(m, jnp.clip(x, lo, hi), x)
+    bounded = jnp.isfinite(lo) & jnp.isfinite(hi)
+    return jnp.where(m & bounded, jnp.clip(x, lo, hi), x)
 
 
 def zscore_cap_weighted(x, cap, mask=None, axis=-1):

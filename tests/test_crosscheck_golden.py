@@ -1,0 +1,27 @@
+"""The independent end-to-end crosscheck tool (tools/crosscheck_golden.py)
+must pass its own gates hermetically: framework factor table vs the
+pandas-only golden pipeline, both computed from the same raw synthetic
+store (the committed CROSSCHECK.json is the full-windows run of this)."""
+
+import importlib.util
+import json
+import os
+import sys
+
+
+def test_quick_profile_passes_gates(tmp_path, capsys):
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "crosscheck_golden.py")
+    spec = importlib.util.spec_from_file_location("crosscheck_golden", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = str(tmp_path / "crosscheck.json")
+    rc = mod.main(["--profile", "quick", "--out", out])
+    assert rc == 0
+    doc = json.load(open(out))
+    assert doc["verdict"]["parity"] is True and doc["failed"] == []
+    styles = {dst for _, dst in mod.BARRA_OUTPUT_STYLES}
+    assert styles <= set(doc["per_factor"])
+    for fac, r in doc["per_factor"].items():
+        assert r["n_overlap"] > 0, fac
+        assert r["pearson"] >= 0.9999, (fac, r)

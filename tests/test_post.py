@@ -66,3 +66,29 @@ def test_orthogonalize_too_few_valid_rows_all_nan():
     x = jnp.asarray(np.random.default_rng(1).standard_normal((T, N)))
     out = np.asarray(orthogonalize(y, [x]))
     assert np.all(np.isnan(out))
+
+
+def test_winsorize_single_survivor_section_passes_through():
+    """A cross-section with exactly one finite value has NaN sample std;
+    pandas clip ignores NaN thresholds so the value must survive UNCLIPPED
+    (reference post_processing.py:12-15 — divergence found by the
+    end-to-end crosscheck: the first date a factor's expanding window
+    matures for exactly one stock)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mfm_tpu.ops.masked import winsorize_cs
+
+    x = np.full((3, 4), np.nan)
+    x[0, 1] = 7.5            # single survivor
+    x[1, :] = [1.0, 1.0, 1.0, 1.0]   # zero-variance section: clips to mean
+    x[2, :] = [0.0, 1.0, 2.0, 50.0]  # normal section: outlier clips
+    got = np.asarray(winsorize_cs(jnp.asarray(x), n_std=2.5))
+    assert got[0, 1] == 7.5
+    assert np.isnan(got[0, [0, 2, 3]]).all()
+    np.testing.assert_allclose(got[1], 1.0)
+    import pandas as pd
+    s = pd.Series(x[2])
+    expect = s.clip(lower=s.mean() - 2.5 * s.std(),
+                    upper=s.mean() + 2.5 * s.std()).to_numpy()
+    np.testing.assert_allclose(got[2], expect)

@@ -1,0 +1,278 @@
+"""Independent end-to-end factor cross-validation -> CROSSCHECK.json.
+
+The reference's only external QC is a notebook comparison of its size /
+beta / momentum series against jqdatasdk's factor service
+(``/root/reference/beta.ipynb`` cells 29-30).  No vendor data can enter
+this image, so this tool closes the same loop with the strongest available
+independent producer: a PANDAS-ONLY pipeline built from the test-suite
+goldens (``tests/golden.py`` rolling/post functions + the
+``tests/test_prepare._golden_master`` merge_asof chain), computed
+end-to-end from the same raw synthetic store the framework reads — two
+implementations that share no arrays, no prepare code, and no kernels,
+meeting only at the raw collections.
+
+    python tools/crosscheck_golden.py --profile quick --out CROSSCHECK.json
+    python tools/crosscheck_golden.py --profile full  --out CROSSCHECK.json
+
+``full`` runs the reference's production windows (252/504-day) over a
+700-date store; ``quick`` is the hermetic CI profile (reduced windows,
+130 dates, ~30 s).  Exit 0 iff every factor passes the agreement gates.
+
+Real-data procedure (mirroring beta.ipynb cells 29-30): export the vendor
+table (jqdatasdk ``get_factor_values`` or a Barra delivery) to CSV with
+(trade_date, ts_code, factor...) columns, then
+
+    python -m mfm_tpu.cli crosscheck --ours results/barra_data.csv \
+        --external vendor.csv --date-col date --code-col stocknames
+
+and hold the report to the same gates this tool applies.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pandas as pd
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+import golden  # noqa: E402  (tests/golden.py — the independent implementation)
+from test_prepare import _golden_master  # noqa: E402
+
+from mfm_tpu.config import FactorConfig, PipelineConfig, RollingSpec  # noqa: E402
+from mfm_tpu.data.etl import PanelStore  # noqa: E402
+from mfm_tpu.data.prepare import (  # noqa: E402
+    latest_index_constituents, prepare_factor_inputs,
+)
+from mfm_tpu.data.synthetic import synthetic_collections  # noqa: E402
+from mfm_tpu.pipeline import BARRA_OUTPUT_STYLES, run_factor_pipeline  # noqa: E402
+from mfm_tpu.utils.crosscheck import crosscheck_factors  # noqa: E402
+
+SUB_FACTORS = ("SIZE", "BETA", "HSIGMA", "RSTR", "DASTD", "CMRA", "NLSIZE",
+               "BP", "STOM", "STOQ", "STOA", "CETOP", "ETOP", "YOYProfit",
+               "YOYSales", "MLEV", "DTOA", "BLEV")
+
+#: agreement gates: both sides are float64 and reproduce the same contract,
+#: so corr must be ~1 to the last digit and raw values must agree to fp
+#: noise; coverage differences would mean the two prepares disagree on
+#: which (date, stock) cells exist
+GATES = {"pearson": 0.9999, "rank_corr": 0.999, "mean_abs_diff": 1e-7,
+         "coverage": 0.999}
+
+
+def reduced_config() -> FactorConfig:
+    """The hermetic profile's windows (same shape the factor golden tests
+    use): every rolling factor reaches its valid regime within ~60 dates."""
+    return FactorConfig(
+        beta=RollingSpec(window=40, half_life=10, min_periods=8),
+        rstr_total=60, rstr_lag=5, rstr_half_life=15, rstr_min_periods=8,
+        dastd=RollingSpec(window=40, half_life=8, min_periods=8),
+        cmra_window=30,
+        stom=RollingSpec(window=10, min_periods=7),
+        stoq=RollingSpec(window=21, min_periods=14),
+        stoa=RollingSpec(window=42, min_periods=21),
+    )
+
+
+def golden_factor_table(store, cfg: FactorConfig,
+                        index_code: str = "000300.SH") -> pd.DataFrame:
+    """The pandas path: store -> merge_asof master -> per-stock rolling
+    goldens -> per-date post-processing -> barra output schema."""
+    uni = latest_index_constituents(store, index_code)
+    m = _golden_master(store, uni, index_code)
+
+    idx = store.read("index_daily_prices")
+    idx = idx[idx.ts_code == index_code].copy()
+    idx["trade_date"] = pd.to_datetime(idx.trade_date.astype(str),
+                                       format="%Y%m%d")
+    idx = idx.sort_values("trade_date")
+    mkt_by_date = dict(zip(idx.trade_date, idx.close.pct_change()))
+
+    frames = []
+    for code, g in m.groupby("ts_code", observed=True):
+        g = g.sort_values("trade_date").reset_index(drop=True)
+        close = g["close"]
+        ret = close.pct_change()
+        log_ret = np.log(close).diff()
+        market = pd.Series(g["trade_date"].map(mkt_by_date), dtype=float)
+
+        beta, hsigma = golden.golden_beta_hsigma(
+            ret, market, T=cfg.beta.window, hl=cfg.beta.half_life,
+            minp=cfg.beta.min_periods)
+        f = pd.DataFrame({
+            "trade_date": g["trade_date"], "ts_code": code,
+            "capital": g["circ_mv"].to_numpy(),
+            "next_ret": ret.shift(-1).to_numpy(),
+            "BETA": beta, "HSIGMA": hsigma,
+            "RSTR": golden.golden_rstr(
+                log_ret, T=cfg.rstr_total, L=cfg.rstr_lag,
+                hl=cfg.rstr_half_life, minp=cfg.rstr_min_periods),
+            "DASTD": golden.golden_dastd(
+                ret - market, T=cfg.dastd.window, hl=cfg.dastd.half_life,
+                minp=cfg.dastd.min_periods),
+            "CMRA": golden.golden_cmra(log_ret, T=cfg.cmra_window),
+            "SIZE": np.log(g["total_mv"].to_numpy()),
+        })
+        dtv = g["turnover_rate"] / 100.0
+        for name, spec in (("STOM", cfg.stom), ("STOQ", cfg.stoq),
+                           ("STOA", cfg.stoa)):
+            base = dtv.rolling(spec.window,
+                               min_periods=spec.min_periods).sum()
+            f[name] = np.log(base.replace(0, np.nan)).to_numpy()
+
+        pb = g["pb"].to_numpy()
+        f["BP"] = np.where(pb > 0, 1.0 / pb, np.nan)
+        pe = g["pe_ttm"].to_numpy()
+        f["ETOP"] = np.where(pe > 0, 1.0 / pe, np.nan)
+        f["YOYProfit"] = g["q_profit_yoy"].to_numpy() / 100.0
+        f["YOYSales"] = g["q_sales_yoy"].to_numpy() / 100.0
+        mv = g["total_mv"].to_numpy()
+        ncl = g["total_ncl"].to_numpy()
+        book = g["total_hldr_eqy_inc_min_int"].to_numpy()
+        mlev = (mv + ncl) / mv
+        f["MLEV"] = np.where(np.isinf(mlev), np.nan, mlev)
+        f["DTOA"] = g["debt_to_assets"].to_numpy()
+        f["BLEV"] = np.where(book > 0, (book + ncl) / book, np.nan)
+
+        # TTM cashflow: rolling-4 sum over DISTINCT reports, joined back by
+        # report period (factor_calculator.py:392-412)
+        rep = g.dropna(subset=["end_date"]).drop_duplicates("end_date")
+        ttm_by_rep = dict(zip(
+            rep["end_date"],
+            rep["n_cashflow_act"].rolling(4, min_periods=4).sum()))
+        ttm = g["end_date"].map(ttm_by_rep).to_numpy(float)
+        f["CETOP"] = np.where((mv > 0) & (ttm > 0), ttm / mv, np.nan)
+        frames.append(f)
+
+    # per-date stages need group order == row order: sort by date first
+    long = (pd.concat(frames, ignore_index=True)
+            .sort_values(["trade_date", "ts_code"], kind="stable")
+            .reset_index(drop=True))
+    long["NLSIZE"] = golden.golden_nlsize(long[["trade_date", "SIZE"]])
+
+    long = golden.golden_winsorize(long, list(SUB_FACTORS),
+                                   n_std=cfg.winsorize_n_std)
+    for name, comps, weights in cfg.composite:
+        long[name] = golden.golden_composite(long, list(comps), list(weights))
+    for target, regs in cfg.ortho_rules:
+        long[target] = golden.golden_ortho(long, target, list(regs))
+
+    out = long[["trade_date", "ts_code", "capital", "next_ret"]].rename(
+        columns={"trade_date": "date", "ts_code": "stocknames",
+                 "next_ret": "ret"})
+    for src, dst in BARRA_OUTPUT_STYLES:
+        out[dst] = long[src]
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="crosscheck_golden")
+    ap.add_argument("--profile", choices=["quick", "full"], default="full")
+    ap.add_argument("--out", default="CROSSCHECK.json")
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--platform", default="cpu", metavar="cpu|tpu",
+                    help="JAX platform for the framework side (default cpu: "
+                         "this is float64 QC — TPU has no native f64, and "
+                         "an unpinned default would hang on a dead tunnel)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    # config API, not the env var: site hooks that pre-register the TPU
+    # plugin override JAX_PLATFORMS (tools/tpu_parity.py, same pitfall)
+    jax.config.update("jax_platforms", args.platform)
+    # the comparison is float64-vs-float64 (the golden side is numpy f64);
+    # without x64 the framework would silently truncate to f32 and the
+    # mean_abs_diff gate would measure precision, not agreement
+    jax.config.update("jax_enable_x64", True)
+
+    if args.profile == "quick":
+        T, N, cfg = 130, 15, reduced_config()
+    else:
+        T, N, cfg = 700, 30, FactorConfig()
+
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory() as tmp:
+        store = PanelStore(os.path.join(tmp, "store"))
+        synthetic_collections(store, T=T, N=N, n_industries=5,
+                              seed=args.seed)
+
+        prep = prepare_factor_inputs(store)
+        ours, _ = run_factor_pipeline(
+            prep.fields, prep.index_close, prep.industry_l1, prep.dates,
+            prep.stocks,
+            PipelineConfig(factors=cfg, dtype="float64"))
+        ours = ours.copy()
+        ours["date"] = pd.to_datetime(ours["date"])
+
+        gold = golden_factor_table(store, cfg)
+
+    styles = [dst for _, dst in BARRA_OUTPUT_STYLES]
+    rep = crosscheck_factors(ours, gold, factors=styles + ["ret", "capital"],
+                             date_col="date", code_col="stocknames")
+
+    failures = []
+    for fac, r in rep.iterrows():
+        if r["n_overlap"] == 0:
+            failures.append(f"{fac}:no_overlap")
+            continue
+        if not r["pearson"] >= GATES["pearson"]:
+            failures.append(f"{fac}:pearson")
+        if not r["rank_corr"] >= GATES["rank_corr"]:
+            failures.append(f"{fac}:rank_corr")
+        if not r["mean_abs_diff"] <= GATES["mean_abs_diff"]:
+            failures.append(f"{fac}:mean_abs_diff")
+        if not min(r["coverage_ours"], r["coverage_ext"]) >= GATES["coverage"]:
+            failures.append(f"{fac}:coverage")
+
+    doc = {
+        "tool": "tools/crosscheck_golden.py",
+        "profile": args.profile,
+        "workload": {"dates": T, "stocks": N, "seed": args.seed,
+                     "windows": "reference defaults (252/504-day)"
+                     if args.profile == "full" else "reduced CI windows"},
+        "producers": {
+            "ours": "store -> mfm_tpu prepare (vectorized searchsorted PIT "
+                    "joins) -> FactorEngine (row-space scan kernels) -> "
+                    "post (winsorize/composite/ortho) -> barra table",
+            "external": "same store -> pandas merge_asof master "
+                        "(tests/test_prepare._golden_master) -> per-stock "
+                        "pandas rolling goldens (tests/golden.py) -> "
+                        "per-date pandas post -> barra schema",
+        },
+        "gates": GATES,
+        "per_factor": {
+            fac: {k: (None if isinstance(v, float) and not np.isfinite(v)
+                      else (float(v) if isinstance(v, (float, np.floating))
+                            else int(v)))
+                  for k, v in r.items()}
+            for fac, r in rep.iterrows()},
+        "failed": failures,
+        "verdict": {"parity": not failures},
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "real_data_procedure": (
+            "mirror /root/reference/beta.ipynb cells 29-30: export the "
+            "vendor factor table (jqdatasdk get_factor_values / Barra "
+            "delivery) to CSV, then `python -m mfm_tpu.cli crosscheck "
+            "--ours results/barra_data.csv --external vendor.csv` and hold "
+            "the report to the gates above (rank_corr tolerates vendor "
+            "winsorization/standardization differences; pearson and "
+            "mean_abs_diff only bind when normalizations match)"),
+    }
+    with open(args.out, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    print(json.dumps({"parity": not failures, "failed": failures,
+                      "out": args.out, "wall_s": doc["wall_s"]}))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
