@@ -47,6 +47,94 @@ def _time3(fn, *args):
     return min(times)
 
 
+#: public per-chip peaks: (MXU bf16 TFLOP/s, HBM GB/s).  The VPU peak is
+#: not published per chip; the scaling-book estimate is ~1/25 of the MXU
+#: bf16 number (8x128 lanes x 4 ALUs x FMA at ~0.94 GHz ~ 7.9 TFLOP/s on
+#: v5e), which is what the VPU-bound stages are held to below.
+_CHIP_PEAKS = {
+    "TPU v4": (275.0, 1228.0),
+    "TPU v5e": (197.0, 819.0),
+    "TPU v5 lite": (197.0, 819.0),
+    "TPU v5p": (459.0, 2765.0),
+    "TPU v6e": (918.0, 1640.0),
+    "TPU v6 lite": (918.0, 1640.0),
+}
+
+
+def _riskmodel_stage_models(T, N, P, Q, K, M, sweeps):
+    """Analytic FLOP + HBM-byte model per risk stage at f32 (the roofline
+    denominator: what the math REQUIRES, not what XLA emits).
+
+    regression — per date: masked normal equations X'WX / X'Wy (2NK^2 MXU
+    FLOPs), one K x K eigh-based pinv (~10K^3), constraint matmuls.
+    newey_west — EWMA scan: (2q+1) rank-1 K x K updates + normalization.
+    eigen — the dominant stage: T*M Jacobi eighs of K x K (weighted kernel:
+    ~5K^3 per sweep covering A-rotations + the fused weighted-V reduction)
+    plus the F0 decomposition and bias pairing (~2K^3 per date).  All
+    rotations are vector ops — VPU, not MXU.
+    vol_regime — elementwise (T, K, K) scaling: pure bandwidth.
+    """
+    f32 = 4
+    return {
+        "regression": {
+            "gflop": T * (2 * N * K * K + 2 * N * K + 10 * K**3) / 1e9,
+            "gbyte": (T * N * (Q + 4 + K) + T * (K * K + K)) * f32 / 1e9,
+            "bound": "mxu",
+        },
+        "newey_west": {
+            "gflop": T * (2 * 2 + 1 + 4) * 2 * K * K / 1e9,
+            "gbyte": T * K * K * 2 * f32 / 1e9,
+            "bound": "serial-scan (latency, not throughput)",
+        },
+        "eigen": {
+            "gflop": (T * M * sweeps * 5 * K**3 + T * 2 * K**3) / 1e9,
+            "gbyte": T * M * K * K * 2 * f32 / 1e9,
+            "bound": "vpu",
+        },
+        "vol_regime": {
+            "gflop": T * 6 * K * K / 1e9,
+            "gbyte": T * K * K * 3 * f32 / 1e9,
+            "bound": "hbm",
+        },
+    }
+
+
+def _roofline(stage_seconds, models):
+    """Achieved GFLOP/s / GB/s per stage + fraction of the detected chip's
+    peak for the stage's binding resource.  CPU or unknown chips report the
+    achieved numbers with null fractions (no published peak to hold to)."""
+    import jax
+
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", d.platform)
+    mxu_tflops, hbm_gbps = _CHIP_PEAKS.get(kind, (None, None))
+    vpu_tflops = mxu_tflops / 25.0 if mxu_tflops else None
+    out = {"device_kind": kind,
+           "peaks": {"mxu_bf16_tflops": mxu_tflops,
+                     "vpu_f32_tflops_est": vpu_tflops,
+                     "hbm_gbps": hbm_gbps}}
+    for name, s in stage_seconds.items():
+        m = models[name]
+        gflops = m["gflop"] / s
+        gbps = m["gbyte"] / s
+        rec = {"model_gflop": round(m["gflop"], 2),
+               "model_gbyte": round(m["gbyte"], 3),
+               "achieved_gflops": round(gflops, 1),
+               "achieved_gbps": round(gbps, 2),
+               "bound": m["bound"], "frac_of_peak": None,
+               "frac_of_hbm": None}
+        if hbm_gbps:
+            rec["frac_of_hbm"] = round(gbps / hbm_gbps, 4)
+            peak = {"mxu": mxu_tflops, "vpu": vpu_tflops}.get(
+                m["bound"], None)
+            if peak:
+                rec["frac_of_peak"] = round(gflops / (peak * 1e3), 4)
+            elif m["bound"] == "hbm":
+                rec["frac_of_peak"] = rec["frac_of_hbm"]
+        out[name] = rec
+    return out
+
+
 def bench_riskmodel():
     import jax
     import jax.numpy as jnp
@@ -108,6 +196,20 @@ def bench_riskmodel():
     vr_s = _time3(mk(lambda m, f, c, v: m.vol_regime_adj_by_time(f, c, v)),
                   *args, factor_ret, eigen_cov, eigen_valid)
 
+    prof_dir = os.environ.get("BENCH_PROFILE_DIR")
+    if prof_dir:
+        # one traced execution of the already-compiled e2e step: the
+        # committed profiler artifact for roofline inspection (xprof /
+        # tensorboard reads the dir)
+        with jax.profiler.trace(prof_dir):
+            _force(step(*args, sim_covs))
+
+    from mfm_tpu.models.eigen import sim_sweeps_for
+    stage_s = {"regression": reg_s, "newey_west": nw_s, "eigen": eig_s,
+               "vol_regime": vr_s}
+    models = _riskmodel_stage_models(
+        T, N, P, Q, K, M, sweeps=sim_sweeps_for(K, jnp.float32, T))
+
     cpu_s = _cpu_baseline_riskmodel((T, N, P, Q, K, M), args)
     return {"metric": "csi300_riskmodel_e2e_wall", "value": round(tpu_s, 4),
             "unit": "s", "vs_baseline": round(cpu_s / tpu_s, 2),
@@ -115,10 +217,8 @@ def bench_riskmodel():
             # metric — report it directly (T dates / regression-stage wall)
             "xreg_dates_per_sec": round(T / reg_s),
             "e2e_dates_per_sec": round(T / tpu_s),
-            "stages": {"regression": round(reg_s, 4),
-                       "newey_west": round(nw_s, 4),
-                       "eigen": round(eig_s, 4),
-                       "vol_regime": round(vr_s, 4)}}
+            "stages": {k: round(v, 4) for k, v in stage_s.items()},
+            "roofline": _roofline(stage_s, models)}
 
 
 def _cpu_baseline_riskmodel(shape, args):
@@ -287,14 +387,13 @@ def bench_alla():
                        "risk_stack": round(risk_s, 4)}}
 
 
-def bench_alpha():
+def bench_alpha(T=1390, N=300, label="alpha_1000_exprs_csi300_wall"):
     import jax
     import jax.numpy as jnp
     from mfm_tpu.alpha.dsl import compile_alpha_batch
     from mfm_tpu.alpha.metrics import alpha_summary
 
     rng = np.random.default_rng(0)
-    T, N = 1390, 300
     close = np.exp(np.cumsum(0.02 * rng.standard_normal((T, N)), axis=0))
     panel = {
         "close": jnp.asarray(close, jnp.float32),
@@ -329,7 +428,56 @@ def bench_alpha():
     _force(run(dict(panel), fwd))
     compile_s = time.perf_counter() - t0
     tpu_s = _time3(run, dict(panel), fwd)
-    return {"metric": "alpha_1000_exprs_csi300_wall", "value": round(tpu_s, 4),
+    return {"metric": label, "value": round(tpu_s, 4),
+            "unit": "s", "vs_baseline": None,
+            "compile_s": round(compile_s, 2)}
+
+
+def bench_alpha_alla():
+    """Config 5 at all-A scale (2500 x 5000): the (E, T, N) tensor would be
+    50 GB, so this path uses the fused evaluate+score chunks
+    (alpha/dsl.py::compile_alpha_scores — live HBM = chunk x 50 MB panels
+    + one (T, W, N) window transient; chunk=50 -> ~2.5 GB)."""
+    import jax
+    import jax.numpy as jnp
+    from mfm_tpu.alpha.dsl import compile_alpha_scores
+
+    rng = np.random.default_rng(0)
+    T, N = 2500, 5000
+    close = np.exp(np.cumsum(0.02 * rng.standard_normal((T, N)),
+                             axis=0)).astype(np.float32)
+    panel = {
+        "close": jnp.asarray(close),
+        "volume": jnp.asarray(
+            np.exp(rng.normal(10, 1, (T, N))).astype(np.float32)),
+        "ret": jnp.asarray(np.vstack([np.full((1, N), np.nan, np.float32),
+                                      close[1:] / close[:-1] - 1])),
+    }
+    templates = [
+        "cs_rank(delta(close, {d}))",
+        "-ts_corr(close, volume, {w})",
+        "cs_zscore(ts_std(ret, {w}))",
+        "decay_linear(cs_demean(ret), {w}) * {c}",
+        "where(ret > 0, cs_rank(volume), -cs_rank(ts_mean(volume, {d})))",
+        "ts_rank(close, {w}) - cs_rank(delta(volume, {d}))",
+    ]
+    exprs = [templates[i % len(templates)].format(
+        d=2 + i % 9, w=5 + i % 20, c=round(0.5 + (i % 10) / 10, 2))
+        for i in range(1000)]
+    fwd = jnp.concatenate([panel["ret"][1:],
+                           jnp.full((1, N), jnp.nan, jnp.float32)], axis=0)
+    score = compile_alpha_scores(exprs, chunk=50)
+
+    def run(p, fwd):
+        s = score(p, fwd)
+        return sum(jnp.sum(jnp.where(jnp.isfinite(v), v, 0.0))
+                   for v in s.values())
+
+    t0 = time.perf_counter()
+    _force(run(dict(panel), fwd))
+    compile_s = time.perf_counter() - t0
+    tpu_s = _time3(run, dict(panel), fwd)
+    return {"metric": "alpha_1000_exprs_alla_wall", "value": round(tpu_s, 4),
             "unit": "s", "vs_baseline": None,
             "compile_s": round(compile_s, 2)}
 
@@ -340,6 +488,7 @@ CONFIGS = {
     "factors": bench_factors,
     "alla": bench_alla,
     "alpha": bench_alpha,
+    "alpha_alla": bench_alpha_alla,
 }
 
 
@@ -419,7 +568,15 @@ def _inner_main(args):
         import jax
         # the config API wins over the axon site hook's env pin
         jax.config.update("jax_platforms", args.platform)
+    from mfm_tpu.utils.cache import enable_persistent_compilation_cache
+
+    # cross-process XLA cache: a rerun's "compile_s" measures the cache-hit
+    # path (deserialize instead of compile) — the per-machine number
+    # BASELINE.md documents next to the cold compile
+    cache_dir = enable_persistent_compilation_cache()
     rec = CONFIGS[args.config]()
+    if "compile_s" in rec:
+        rec["compilation_cache"] = cache_dir
     import jax
     rec["backend"] = jax.devices()[0].platform
     print(json.dumps(rec))
@@ -434,7 +591,14 @@ def main():
                     help="pin a JAX platform (e.g. cpu) before running")
     ap.add_argument("--timeout", type=float, default=2400.0,
                     help="per-attempt subprocess timeout, seconds")
+    ap.add_argument("--profile-dir", default=None, metavar="DIR",
+                    help="config-1 only: capture one jax.profiler trace of "
+                         "the compiled e2e step into DIR (the roofline "
+                         "evidence artifact; view with xprof/tensorboard)")
     args = ap.parse_args()
+    if args.profile_dir:
+        # inherited by the inner bench subprocess
+        os.environ["BENCH_PROFILE_DIR"] = os.path.abspath(args.profile_dir)
 
     if args.inner:
         _inner_main(args)

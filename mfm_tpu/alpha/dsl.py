@@ -602,6 +602,47 @@ def compile_alpha_batch(sources: Sequence[str], chunk: int = 1000) -> Callable:
     return run_all
 
 
+def compile_alpha_scores(sources: Sequence[str], chunk: int = 50) -> Callable:
+    """Compile expressions into a fused ``(panel, fwd_ret) -> summary``
+    callable that never materializes the full (E, T, N) alpha tensor.
+
+    The all-A memory plan (BASELINE config 5 at 2500 x 5000): one alpha
+    panel is T*N*4 = 50 MB, so 1,000 stacked alphas are 50 GB — far past a
+    single chip's HBM — and the window ops (``_windows``: ts_rank/ts_min/
+    ts_corr materialize (T, W, N)) add up to W x 50 MB of transient per
+    expression.  Scoring INSIDE each chunk's jit reduces every alpha to its
+    (E,)-shaped summary stats before the next chunk runs, so live HBM is
+    ``chunk`` panels + one window buffer: chunk=50 keeps it ~2.5 GB + the
+    largest (T, W, N) transient.  Returns a dict of (E,) arrays in source
+    order (:func:`mfm_tpu.alpha.metrics.alpha_summary` keys).
+
+    Like :func:`compile_alpha_batch`: do NOT wrap the result in an outer
+    ``jax.jit`` — tracing would inline every chunk into one program.
+    """
+    from mfm_tpu.alpha.metrics import alpha_summary
+
+    exprs = [compile_alpha(s) for s in sources]
+    chunk = len(exprs) if not chunk else chunk
+    groups = [exprs[i:i + chunk] for i in range(0, len(exprs), chunk)]
+
+    def make_run(es):
+        @jax.jit
+        def run(p, fwd):
+            return alpha_summary(jnp.stack([e(p) for e in es], axis=0), fwd)
+        return run
+
+    runs = [make_run(es) for es in groups]
+    if len(runs) == 1:
+        return runs[0]
+
+    def run_all(p, fwd):
+        outs = [r(p, fwd) for r in runs]
+        return {k: jnp.concatenate([o[k] for o in outs], axis=0)
+                for k in outs[0]}
+
+    return run_all
+
+
 def evaluate_alphas(
     sources: Sequence[str],
     panel: Mapping[str, jax.Array],

@@ -1180,6 +1180,12 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    # pay pipeline-scale XLA compiles (the 32.5 s config-5 alpha batch,
+    # the risk step) once per MACHINE, not once per process
+    # (MFM_COMPILATION_CACHE=off disables, =DIR relocates)
+    from mfm_tpu.utils.cache import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
     args.fn(args)
 
 

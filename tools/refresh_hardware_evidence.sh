@@ -44,7 +44,7 @@ python tools/tpu_parity.py run --platform cpu --out "$out/risk_cpu32.npz"
 python tools/tpu_parity.py compare "$out/risk_tpu32.npz" "$out/risk_cpu32.npz" \
   --budget tools/parity_budget.json > "$out/compare_risk32.json" || true
 
-python bench.py > "$out/bench.json"
+python bench.py --profile-dir "$out/trace" > "$out/bench.json"
 
 OUT="$out" python - <<'EOF'
 import json, os, sys

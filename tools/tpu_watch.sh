@@ -39,6 +39,8 @@ python bench.py --config alla 2> "$out/alla.err" | tail -1 > "$out/config4_alla.
   || echo "alla bench FAILED (see alla.err)" >> "$out/status"
 python bench.py --config alpha 2> "$out/alpha.err" | tail -1 > "$out/config5_alpha.json" \
   || echo "alpha bench FAILED (see alpha.err)" >> "$out/status"
+python bench.py --config alpha_alla 2> "$out/alpha_alla.err" | tail -1 > "$out/config5_alpha_alla.json" \
+  || echo "alpha_alla bench FAILED (see alpha_alla.err)" >> "$out/status"
 # a capture that fell back to CPU is NOT evidence — flag it
 grep -L '"backend": "tpu"' "$out"/config*.json 2>/dev/null \
   | sed 's/$/: backend is not tpu/' >> "$out/status"
