@@ -766,6 +766,23 @@ def _report(args):
     print(json.dumps(summary))
 
 
+def _etl_xlsx(args):
+    """Static-workbook ingestion: the reference ships data/index_list.xlsx
+    (tushare index_basic export) and data/industry_index_data.xlsx (Wind
+    EDB export of CITIC/SW L1 industry index closes) as pipeline inputs
+    (SURVEY.md "Static data"); this loads them into store collections with
+    the same idempotent-insert discipline as the API collections."""
+    from mfm_tpu.data.etl import PanelStore
+    from mfm_tpu.data.xlsx import ingest_workbooks
+
+    counts = ingest_workbooks(
+        PanelStore(args.store), index_list=args.index_list,
+        industry_index=args.industry_index,
+        industry_sheets=tuple(int(s) for s in args.sheets.split(",")),
+    )
+    print(json.dumps(counts))
+
+
 def _etl_update(args):
     """Calendar-driven refresh of every collection — the reference's
     ``update_mongo_db.py:__main__`` chain (``:579-614``), against the
@@ -1114,6 +1131,18 @@ def main(argv=None):
     rp.add_argument("--roll-window", type=int, default=63,
                     help="rolling window (days) for the R² mean")
     rp.set_defaults(fn=_report)
+
+    ex = sub.add_parser("etl-xlsx",
+                        help="ingest the shipped static workbooks "
+                             "(index_list.xlsx / industry_index_data.xlsx "
+                             "Wind EDB export) into store collections")
+    ex.add_argument("--store", required=True)
+    ex.add_argument("--index-list", default=None, metavar="XLSX")
+    ex.add_argument("--industry-index", default=None, metavar="XLSX")
+    ex.add_argument("--sheets", default="0,1",
+                    help="industry workbook sheet indices (default: CITIC "
+                         "and SW L1)")
+    ex.set_defaults(fn=_etl_xlsx)
 
     eu = sub.add_parser("etl-update",
                         help="calendar-driven refresh of all collections "

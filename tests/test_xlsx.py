@@ -1,0 +1,133 @@
+"""Static-workbook ingestion (mfm_tpu/data/xlsx.py): the dependency-free
+reader must handle the cell forms the reference's two shipped workbooks use
+(shared strings, inline strings, cached formula strings, numbers, absent
+cells) and the Wind EDB banner/header/meta/data layout.  Fixtures are
+written by a minimal in-test xlsx writer — same zip+XML subset."""
+
+import zipfile
+
+import pytest
+
+from mfm_tpu.data.etl import PanelStore
+from mfm_tpu.data.xlsx import (
+    excel_serial_to_date,
+    ingest_workbooks,
+    read_index_list,
+    read_industry_index_prices,
+    read_xlsx,
+)
+
+_WB_XML = """<?xml version="1.0" encoding="UTF-8" standalone="yes"?>
+<workbook xmlns="http://schemas.openxmlformats.org/spreadsheetml/2006/main"
+ xmlns:r="http://schemas.openxmlformats.org/officeDocument/2006/relationships">
+<sheets>{sheets}</sheets></workbook>"""
+_RELS = """<?xml version="1.0" encoding="UTF-8" standalone="yes"?>
+<Relationships xmlns="http://schemas.openxmlformats.org/package/2006/relationships">
+{rels}</Relationships>"""
+
+
+def _cell(ref, v, strings):
+    if isinstance(v, str):
+        if v not in strings:
+            strings.append(v)
+        return f'<c r="{ref}" t="s"><v>{strings.index(v)}</v></c>'
+    if isinstance(v, bool):
+        return f'<c r="{ref}" t="b"><v>{int(v)}</v></c>'
+    return f'<c r="{ref}"><v>{v!r}</v></c>'
+
+
+def write_xlsx(path, sheets):
+    """sheets: list of (name, rows) — rows are lists of str/float/bool/None."""
+    strings: list = []
+    sheet_xml = []
+    for _, rows in sheets:
+        body = []
+        for ri, row in enumerate(rows, 1):
+            cells = [
+                _cell(f"{chr(ord('A') + ci)}{ri}", v, strings)
+                for ci, v in enumerate(row) if v is not None
+            ]
+            body.append(f'<row r="{ri}">{"".join(cells)}</row>')
+        sheet_xml.append(
+            '<worksheet xmlns="http://schemas.openxmlformats.org/'
+            'spreadsheetml/2006/main"><sheetData>'
+            + "".join(body) + "</sheetData></worksheet>")
+    ss = ('<sst xmlns="http://schemas.openxmlformats.org/spreadsheetml/'
+          '2006/main">' + "".join(f"<si><t>{s}</t></si>" for s in strings)
+          + "</sst>")
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("xl/workbook.xml", _WB_XML.format(sheets="".join(
+            f'<sheet name="{n}" sheetId="{i+1}" r:id="rId{i+1}"/>'
+            for i, (n, _) in enumerate(sheets))))
+        z.writestr("xl/_rels/workbook.xml.rels", _RELS.format(rels="".join(
+            f'<Relationship Id="rId{i+1}" Type="http://schemas.'
+            f'openxmlformats.org/officeDocument/2006/relationships/'
+            f'worksheet" Target="worksheets/sheet{i+1}.xml"/>'
+            for i in range(len(sheets)))))
+        for i, xml in enumerate(sheet_xml):
+            z.writestr(f"xl/worksheets/sheet{i+1}.xml", xml)
+        z.writestr("xl/sharedStrings.xml", ss)
+
+
+def test_grid_reader_cell_forms(tmp_path):
+    p = str(tmp_path / "t.xlsx")
+    write_xlsx(p, [("S1", [["a", 1.5, True], [None, "b", None]])])
+    grid = read_xlsx(p, sheet=0)
+    assert grid == [["a", 1.5, True], [None, "b", None]]
+    assert read_xlsx(p, sheet="S1") == grid
+    with pytest.raises(ValueError, match="no sheet named"):
+        read_xlsx(p, sheet="nope")
+
+
+def test_excel_serial_epoch():
+    assert excel_serial_to_date(38352).isoformat() == "2004-12-31"
+    # the 1899-12-30 epoch bakes in the phantom 1900-02-29 (correct for
+    # every post-1900-03-01 serial — all real data); pin a known modern one
+    assert excel_serial_to_date(45658).isoformat() == "2025-01-01"
+
+
+def test_index_list_and_edb_layout(tmp_path):
+    il = str(tmp_path / "index_list.xlsx")
+    write_xlsx(il, [("Sheet1", [
+        ["ts_code", "name", "base_point"],
+        ["000300.SH", "CSI300", 1000.0],
+        ["000905.SH", "CSI500", 1000.0],
+    ])])
+    df = read_index_list(il)
+    assert list(df.columns) == ["ts_code", "name", "base_point"]
+    assert len(df) == 2
+
+    edb = str(tmp_path / "edb.xlsx")
+    rows = [
+        ["Wind"],                                        # banner
+        ["指标名称", "中信行业指数:计算机", "中信行业指数:银行"],  # header
+        ["频率", "日", "日"],                              # meta
+        ["单位", "点", "点"],
+        [38352.0, 1000.0, 1000.0],
+        [38356.0, 997.85, None],                         # absent cell
+    ]
+    write_xlsx(edb, [("中信行业指数", rows)])
+    long = read_industry_index_prices(edb, sheet=0)
+    assert set(long.columns) == {"index_name", "trade_date", "close"}
+    assert len(long) == 3  # the absent cell drops, not zero-fills
+    assert set(long.trade_date) == {"20041231", "20050104"}
+
+    with pytest.raises(ValueError, match="指标名称"):
+        read_industry_index_prices(il, sheet=0)
+
+
+def test_ingest_is_idempotent(tmp_path):
+    edb = str(tmp_path / "edb.xlsx")
+    write_xlsx(edb, [("中信行业指数", [
+        ["指标名称", "中信行业指数:计算机"],
+        [38352.0, 1000.0],
+        [38356.0, 997.85],
+    ])])
+    store = PanelStore(str(tmp_path / "store"))
+    counts = ingest_workbooks(store, industry_index=edb,
+                              industry_sheets=(0,))
+    assert counts == {"industry_index_prices": 2}
+    # re-ingest: duplicate-tolerant, nothing added
+    again = ingest_workbooks(store, industry_index=edb, industry_sheets=(0,))
+    assert again == {"industry_index_prices": 0}
+    assert store.last_date("industry_index_prices") == "20050104"
