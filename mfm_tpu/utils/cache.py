@@ -42,4 +42,12 @@ def enable_persistent_compilation_cache(
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       min_compile_secs)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    try:
+        # jax initializes the cache object lazily ONCE per process; a dir
+        # configured after some earlier compile already initialized it
+        # would silently keep writing to the old location
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.reset_cache()
+    except Exception:
+        pass  # older jax: no reset hook; the config alone suffices there
     return path

@@ -24,6 +24,9 @@ def test_cache_dir_populated_and_off_switch(tmp_path, monkeypatch):
         assert enable_persistent_compilation_cache() is None
     finally:
         # tmp_path is deleted after the test — the global config must not
-        # keep pointing the rest of the suite's compiles at it
+        # keep pointing the rest of the suite's compiles at it, and the
+        # initialized cache OBJECT must be dropped too (it holds the dir)
         jax.config.update("jax_compilation_cache_dir", None)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.reset_cache()

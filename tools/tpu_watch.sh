@@ -41,6 +41,13 @@ python bench.py --config alpha 2> "$out/alpha.err" | tail -1 > "$out/config5_alp
   || echo "alpha bench FAILED (see alpha.err)" >> "$out/status"
 python bench.py --config alpha_alla 2> "$out/alpha_alla.err" | tail -1 > "$out/config5_alpha_alla.json" \
   || echo "alpha_alla bench FAILED (see alpha_alla.err)" >> "$out/status"
+# cache-hit rerun: same config in a FRESH process — compile_s now measures
+# the persistent-cache deserialization path (BASELINE.md config-5 policy)
+python bench.py --config alpha 2> "$out/alpha2.err" | tail -1 > "$out/config5_alpha_rerun.json" \
+  || echo "alpha cache-hit rerun FAILED (see alpha2.err)" >> "$out/status"
+# kernel A/B queue: v_compose2 promotion decision + NW scan-vs-associative
+python tools/kernel_ab.py > "$out/kernel_ab.log" 2>&1 \
+  || echo "kernel_ab FAILED (see kernel_ab.log)" >> "$out/status"
 # a capture that fell back to CPU is NOT evidence — flag it
 grep -L '"backend": "tpu"' "$out"/config*.json 2>/dev/null \
   | sed 's/$/: backend is not tpu/' >> "$out/status"
