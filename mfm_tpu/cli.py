@@ -1211,10 +1211,14 @@ def main(argv=None):
         jax.config.update("jax_platforms", args.platform)
     # pay pipeline-scale XLA compiles (the 32.5 s config-5 alpha batch,
     # the risk step) once per MACHINE, not once per process
-    # (MFM_COMPILATION_CACHE=off disables, =DIR relocates)
-    from mfm_tpu.utils.cache import enable_persistent_compilation_cache
+    # (MFM_COMPILATION_CACHE=off disables, =DIR relocates).  Only for the
+    # subcommands that actually jit: the data-only paths (etl-*, report,
+    # crosscheck) must not pay the jax import or touch the cache dir.
+    if args.cmd in ("risk", "factors", "demo", "prepare", "pipeline",
+                    "alpha"):
+        from mfm_tpu.utils.cache import enable_persistent_compilation_cache
 
-    enable_persistent_compilation_cache()
+        enable_persistent_compilation_cache()
     args.fn(args)
 
 

@@ -110,10 +110,22 @@ def read_xlsx(path: str, sheet=0) -> List[List[object]]:
         root = ElementTree.fromstring(z.read(_sheet_path(z, sheet)))
         rows: Dict[int, Dict[int, object]] = {}
         for row in root.iter(f"{_NS}row"):
-            r = int(row.get("r")) - 1
+            rr = row.get("r")
+            if rr is None:
+                raise ValueError(f"{path}: <row> without an r attribute — "
+                                 "implied-position rows are not supported")
+            r = int(rr) - 1
             cells: Dict[int, object] = {}
             for c in row.iter(f"{_NS}c"):
-                ci = _col_index(c.get("r", ""))
+                ref = c.get("r")
+                if not ref:
+                    # spec-legal implied positions (some writers omit r on
+                    # re-save) would land at index -1 and silently vanish
+                    # from the grid — refuse loudly instead
+                    raise ValueError(f"{path}: <c> without an r attribute "
+                                     f"in row {r + 1} — implied-position "
+                                     "cells are not supported")
+                ci = _col_index(ref)
                 t = c.get("t", "n")
                 if t == "inlineStr":
                     is_el = c.find(f"{_NS}is")

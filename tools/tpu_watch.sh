@@ -37,13 +37,21 @@ bash tools/refresh_hardware_evidence.sh > "$out/refresh.log" 2>&1 \
   || echo "refresh_hardware_evidence FAILED (see refresh.log)" >> "$out/status"
 python bench.py --config alla 2> "$out/alla.err" | tail -1 > "$out/config4_alla.json" \
   || echo "alla bench FAILED (see alla.err)" >> "$out/status"
-python bench.py --config alpha 2> "$out/alpha.err" | tail -1 > "$out/config5_alpha.json" \
+# cold-vs-cache-hit discipline: a machine that benched before has a warm
+# ~/.cache/mfm_tpu/xla, which would silently turn the "cold" compile_s into
+# a deserialization number — so the cold run gets a FRESH cache dir (cold
+# compile, populates it) and the rerun reuses that same dir (true cache hit)
+fresh_cache="$out/xla_cache_fresh"
+rm -rf "$fresh_cache"
+MFM_COMPILATION_CACHE="$fresh_cache" python bench.py --config alpha \
+  2> "$out/alpha.err" | tail -1 > "$out/config5_alpha.json" \
   || echo "alpha bench FAILED (see alpha.err)" >> "$out/status"
 python bench.py --config alpha_alla 2> "$out/alpha_alla.err" | tail -1 > "$out/config5_alpha_alla.json" \
   || echo "alpha_alla bench FAILED (see alpha_alla.err)" >> "$out/status"
-# cache-hit rerun: same config in a FRESH process — compile_s now measures
-# the persistent-cache deserialization path (BASELINE.md config-5 policy)
-python bench.py --config alpha 2> "$out/alpha2.err" | tail -1 > "$out/config5_alpha_rerun.json" \
+# cache-hit rerun: same config + same cache dir in a FRESH process —
+# compile_s now measures the persistent-cache deserialization path
+MFM_COMPILATION_CACHE="$fresh_cache" python bench.py --config alpha \
+  2> "$out/alpha2.err" | tail -1 > "$out/config5_alpha_rerun.json" \
   || echo "alpha cache-hit rerun FAILED (see alpha2.err)" >> "$out/status"
 # kernel A/B queue: v_compose2 promotion decision + NW scan-vs-associative
 python tools/kernel_ab.py > "$out/kernel_ab.log" 2>&1 \
