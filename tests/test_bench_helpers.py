@@ -1,0 +1,71 @@
+"""bench.py's roofline accounting: the analytic FLOP/byte models and the
+peak-fraction arithmetic must stay self-consistent (they are the r5
+"achieved-vs-peak" evidence fields in every driver BENCH record)."""
+
+import importlib.util
+import os
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def bench():
+    path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    spec = importlib.util.spec_from_file_location("bench_mod", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_stage_models_positive_and_eigen_dominant(bench):
+    m = bench._riskmodel_stage_models(1390, 300, 31, 10, 42, 100, sweeps=4)
+    assert set(m) == {"regression", "newey_west", "eigen", "vol_regime"}
+    for name, rec in m.items():
+        assert rec["gflop"] > 0 and rec["gbyte"] > 0, name
+    # the eigen MC is the workload's FLOP center of mass by orders of
+    # magnitude — if a model edit breaks that, the roofline story is wrong
+    assert m["eigen"]["gflop"] > 50 * m["regression"]["gflop"]
+
+
+def test_roofline_fractions_on_known_chip(bench, monkeypatch):
+    class Dev:
+        platform = "tpu"
+        device_kind = "TPU v5e"
+
+    import jax
+
+    monkeypatch.setattr(jax, "devices", lambda *a: [Dev()])
+    models = bench._riskmodel_stage_models(1390, 300, 31, 10, 42, 100, 4)
+    out = bench._roofline({"regression": 0.05, "newey_west": 0.07,
+                           "eigen": 0.68, "vol_regime": 0.07}, models)
+    assert out["device_kind"] == "TPU v5e"
+    assert out["peaks"]["mxu_bf16_tflops"] == 197.0
+    # mxu-bound stage: fraction = gflops / (mxu peak)
+    # fractions are rounded to 4 decimals in the record — compare at that
+    # granularity
+    reg = out["regression"]
+    assert reg["frac_of_peak"] == pytest.approx(
+        reg["achieved_gflops"] / 197e3, abs=5.1e-5)
+    # vpu-bound stage: held to the 1/25 estimate
+    eig = out["eigen"]
+    assert eig["frac_of_peak"] == pytest.approx(
+        eig["achieved_gflops"] / (197e3 / 25), abs=5.1e-5)
+    # hbm-bound stage: fraction mirrors the bandwidth fraction
+    vr = out["vol_regime"]
+    assert vr["frac_of_peak"] == vr["frac_of_hbm"]
+    # serial-scan stage: no peak to hold to
+    assert out["newey_west"]["frac_of_peak"] is None
+
+
+def test_roofline_unknown_chip_reports_null_fractions(bench, monkeypatch):
+    class Dev:
+        platform = "cpu"
+        device_kind = "cpu"
+
+    import jax
+
+    monkeypatch.setattr(jax, "devices", lambda *a: [Dev()])
+    models = bench._riskmodel_stage_models(700, 300, 31, 10, 42, 40, 4)
+    out = bench._roofline({"eigen": 1.0}, {"eigen": models["eigen"]})
+    assert out["eigen"]["frac_of_peak"] is None
+    assert out["eigen"]["achieved_gflops"] > 0
