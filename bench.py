@@ -395,11 +395,11 @@ def bench_alla():
                        "risk_stack": round(risk_s, 4)}}
 
 
-def bench_alpha(T=1390, N=300, label="alpha_1000_exprs_csi300_wall"):
-    import jax
+def _alpha_workload(T, N, n_exprs=1000):
+    """The config-5 synthetic workload: price/volume/ret panel + templated
+    expression batch + forward returns (shared by the CSI300 and all-A
+    alpha benches so the two never drift apart)."""
     import jax.numpy as jnp
-    from mfm_tpu.alpha.dsl import compile_alpha_batch
-    from mfm_tpu.alpha.metrics import alpha_summary
 
     rng = np.random.default_rng(0)
     close = np.exp(np.cumsum(0.02 * rng.standard_normal((T, N)), axis=0))
@@ -419,9 +419,19 @@ def bench_alpha(T=1390, N=300, label="alpha_1000_exprs_csi300_wall"):
     ]
     exprs = [templates[i % len(templates)].format(
         d=2 + i % 9, w=5 + i % 20, c=round(0.5 + (i % 10) / 10, 2))
-        for i in range(1000)]
+        for i in range(n_exprs)]
     fwd = jnp.concatenate([panel["ret"][1:],
                            jnp.full((1, N), jnp.nan, jnp.float32)], axis=0)
+    return panel, exprs, fwd
+
+
+def bench_alpha(T=1390, N=300, label="alpha_1000_exprs_csi300_wall"):
+    import jax
+    import jax.numpy as jnp
+    from mfm_tpu.alpha.dsl import compile_alpha_batch
+    from mfm_tpu.alpha.metrics import alpha_summary
+
+    panel, exprs, fwd = _alpha_workload(T, N)
     batch = compile_alpha_batch(exprs)  # one jit at E=1000; chunks above
     summ = jax.jit(lambda out, fwd: jnp.sum(jnp.where(
         jnp.isfinite(alpha_summary(out, fwd)["mean_ic"]),
@@ -450,30 +460,7 @@ def bench_alpha_alla():
     import jax.numpy as jnp
     from mfm_tpu.alpha.dsl import compile_alpha_scores
 
-    rng = np.random.default_rng(0)
-    T, N = 2500, 5000
-    close = np.exp(np.cumsum(0.02 * rng.standard_normal((T, N)),
-                             axis=0)).astype(np.float32)
-    panel = {
-        "close": jnp.asarray(close),
-        "volume": jnp.asarray(
-            np.exp(rng.normal(10, 1, (T, N))).astype(np.float32)),
-        "ret": jnp.asarray(np.vstack([np.full((1, N), np.nan, np.float32),
-                                      close[1:] / close[:-1] - 1])),
-    }
-    templates = [
-        "cs_rank(delta(close, {d}))",
-        "-ts_corr(close, volume, {w})",
-        "cs_zscore(ts_std(ret, {w}))",
-        "decay_linear(cs_demean(ret), {w}) * {c}",
-        "where(ret > 0, cs_rank(volume), -cs_rank(ts_mean(volume, {d})))",
-        "ts_rank(close, {w}) - cs_rank(delta(volume, {d}))",
-    ]
-    exprs = [templates[i % len(templates)].format(
-        d=2 + i % 9, w=5 + i % 20, c=round(0.5 + (i % 10) / 10, 2))
-        for i in range(1000)]
-    fwd = jnp.concatenate([panel["ret"][1:],
-                           jnp.full((1, N), jnp.nan, jnp.float32)], axis=0)
+    panel, exprs, fwd = _alpha_workload(T=2500, N=5000)
     score = compile_alpha_scores(exprs, chunk=50)
 
     def run(p, fwd):

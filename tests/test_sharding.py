@@ -84,25 +84,30 @@ def test_full_pipeline_sharded_uneven_shapes():
     _assert_pipeline_sharded_equal(a, 4, 2)
 
 
-def test_factor_engine_uneven_stock_shards():
-    """The row-space argsort/gather path with N % mesh != 0: 30 stocks over
-    8 devices (two devices get 3, six get 4 — XLA's padded layout)."""
+def _assert_engine_sharded_equal(T, N, seed):
+    """Full 16-factor engine — row-space argsort/gather/scatter included —
+    stock-sharded over all 8 devices must equal the single-device run.
+    pad_to_mesh is a no-op at divisible N and pads inertly (NaN = never
+    listed; the int report id pads -1) at uneven N; outputs crop back.
+
+    float64: sharding changes the reduction order of the cross-sectional
+    sums (NLSIZE's per-date OLS especially), which in f32 drifts ~1e-5 —
+    an arithmetic artifact, not a layout bug; f64 pins it to ~1e-13."""
     from mfm_tpu.config import FactorConfig
     from mfm_tpu.data.synthetic import (
         panel_to_engine_fields, synthetic_market_panel,
     )
     from mfm_tpu.factors.engine import FactorEngine
 
-    data = synthetic_market_panel(T=70, N=30, n_industries=5, seed=4)
+    data = synthetic_market_panel(T=T, N=N, n_industries=5, seed=seed)
     fields = panel_to_engine_fields(data, jnp.float64)
     idx_close = jnp.asarray(data["index_close"], jnp.float64)
 
     eng = FactorEngine(fields, idx_close, config=FactorConfig(), block=16)
     base = {k: np.asarray(v) for k, v in eng.run().items()}
 
-    mesh = make_mesh(1, 8)
+    mesh = make_mesh(1, 8)  # all 8 devices on the stock axis
     sharding = NamedSharding(mesh, P(None, "stock"))
-    # NaN fill = never-listed stocks; the int report id pads -1 (= none)
     sh_fields = {
         k: jax.device_put(
             pad_to_mesh(v, mesh, rolling=True,
@@ -113,12 +118,20 @@ def test_factor_engine_uneven_stock_shards():
     eng_sh = FactorEngine(sh_fields, idx_close, config=FactorConfig(),
                           block=16)
     with jax.set_mesh(mesh):
-        out = {k: np.asarray(v)[:, :30] for k, v in eng_sh.run().items()}
+        out = {k: np.asarray(v)[:, :N] for k, v in eng_sh.run().items()}
 
     assert set(out) == set(base)
     for k in base:
+        # NLSIZE's SIZE^3-on-SIZE normal equations amplify the sharded
+        # reduction-order drift to ~8e-9 relative even in f64
         np.testing.assert_allclose(out[k], base[k], rtol=1e-7, atol=1e-10,
                                    equal_nan=True, err_msg=k)
+
+
+def test_factor_engine_uneven_stock_shards():
+    """The row-space argsort/gather path with N % mesh != 0: 30 stocks over
+    8 devices (two devices get 3, six get 4 — XLA's padded layout)."""
+    _assert_engine_sharded_equal(T=70, N=30, seed=4)
 
 
 def test_full_pipeline_associative_nw_sharded_matches_scan(arrays):
@@ -207,39 +220,7 @@ def test_regression_date_and_stock_sharded_2d(arrays):
 
 
 def test_factor_engine_stock_sharded_matches_single_device():
-    """The full 16-factor engine — row-space argsort/gather/scatter included
-    — is embarrassingly parallel over stocks: sharding the stock axis must
-    not change a single output."""
-    from mfm_tpu.config import FactorConfig
-    from mfm_tpu.data.synthetic import synthetic_market_panel
-    from mfm_tpu.factors.engine import FactorEngine
-
-    data = synthetic_market_panel(T=80, N=32, n_industries=5, seed=3)
-    # float64: sharding changes the reduction order of the cross-sectional
-    # sums (NLSIZE's per-date OLS especially), which in f32 drifts ~1e-5 —
-    # an arithmetic artifact, not a layout bug; f64 pins it to ~1e-13
-    from mfm_tpu.data.synthetic import panel_to_engine_fields
-
-    fields = panel_to_engine_fields(data, jnp.float64)
-    idx_close = jnp.asarray(data["index_close"], jnp.float64)
-
-    eng = FactorEngine(fields, idx_close, config=FactorConfig(), block=16)
-    base = {k: np.asarray(v) for k, v in eng.run().items()}
-
-    mesh = make_mesh(1, 8)  # all 8 devices on the stock axis
-    sharding = NamedSharding(mesh, P(None, "stock"))
-    sh_fields = {k: jax.device_put(v, sharding) for k, v in fields.items()}
-    eng_sh = FactorEngine(sh_fields, idx_close, config=FactorConfig(),
-                          block=16)
-    with jax.set_mesh(mesh):
-        out = {k: np.asarray(v) for k, v in eng_sh.run().items()}
-
-    assert set(out) == set(base)
-    for k in base:
-        # NLSIZE's SIZE^3-on-SIZE normal equations amplify the sharded
-        # reduction-order drift to ~8e-9 relative even in f64
-        np.testing.assert_allclose(out[k], base[k], rtol=1e-7, atol=1e-10,
-                                   equal_nan=True, err_msg=k)
+    _assert_engine_sharded_equal(T=80, N=32, seed=3)
 
 
 def test_portfolio_bias_sharded_matches_single_device():
