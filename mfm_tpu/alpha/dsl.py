@@ -582,6 +582,10 @@ def compile_alpha_batch(sources: Sequence[str], chunk: int = 1000) -> Callable:
     matters — tracing would inline every chunk back into one program.
     ``chunk=None`` forces the single-jit behavior regardless of size.
     """
+    if not sources:
+        # fail at compile time with a real message — an empty list would
+        # otherwise surface as chunk=0 slicing or an IndexError below
+        raise ValueError("no sources")
     exprs = [compile_alpha(s) for s in sources]
     chunk = len(exprs) if not chunk else chunk
     groups = [exprs[i:i + chunk] for i in range(0, len(exprs), chunk)]
@@ -621,6 +625,8 @@ def compile_alpha_scores(sources: Sequence[str], chunk: int = 50) -> Callable:
     """
     from mfm_tpu.alpha.metrics import alpha_summary
 
+    if not sources:
+        raise ValueError("no sources")
     exprs = [compile_alpha(s) for s in sources]
     chunk = len(exprs) if not chunk else chunk
     groups = [exprs[i:i + chunk] for i in range(0, len(exprs), chunk)]

@@ -130,6 +130,7 @@ def _risk(args):
             nw_lags=args.nw_lags, nw_half_life=args.nw_half_life,
             nw_method=args.nw_method,
             eigen_n_sims=args.eigen_sims, eigen_scale_coef=args.eigen_scale,
+            eigen_chunk=args.eigen_chunk,
             vol_regime_half_life=args.vr_half_life, seed=args.seed,
         ),
         dtype=args.dtype,
@@ -466,6 +467,7 @@ def _pipeline(args):
             nw_lags=args.nw_lags, nw_half_life=args.nw_half_life,
             nw_method=args.nw_method,
             eigen_n_sims=args.eigen_sims, eigen_scale_coef=args.eigen_scale,
+            eigen_chunk=args.eigen_chunk,
             vol_regime_half_life=args.vr_half_life, seed=args.seed,
         ),
         dtype=args.dtype,
@@ -934,6 +936,21 @@ def main(argv=None):
             raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
         return iv
 
+    def _eigen_chunk(v):
+        if v == "auto":
+            return "auto"
+        if v in ("none", "full"):
+            return None
+        return _positive_int(v)
+
+    _eigen_chunk_help = (
+        "date-chunk size for the eigen Monte-Carlo stream (bounds its "
+        "(chunk, M, K, K) transient); 'auto' (default) sizes it from live "
+        "memory headroom, 'none' forces the single full batch, an int "
+        "pins it.  Results are identical either way")
+    r.add_argument("--eigen-chunk", type=_eigen_chunk, default="auto",
+                   metavar="N|auto|none", help=_eigen_chunk_help)
+
     r.add_argument("--save-outputs", action="store_true",
                    help="also write OUT/risk_outputs.npz (every stage "
                         "output incl. the full covariance series — the "
@@ -1020,6 +1037,8 @@ def main(argv=None):
                          "(O(log T) depth; keeps the date axis sharded)")
     pl.add_argument("--eigen-sims", type=int, default=100)
     pl.add_argument("--eigen-scale", type=float, default=1.4)
+    pl.add_argument("--eigen-chunk", type=_eigen_chunk, default="auto",
+                    metavar="N|auto|none", help=_eigen_chunk_help)
     pl.add_argument("--vr-half-life", type=float, default=42.0)
     pl.add_argument("--seed", type=int, default=0)
     pl.add_argument("--dtype", default="float32")

@@ -128,6 +128,17 @@ class RiskModelConfig:
     # falls back to XLA/LAPACK).  The F0 decomposition always runs at full
     # precision.
     eigen_sim_sweeps: int | str | None = "auto"
+    #: date-chunk size for the eigen Monte-Carlo stream (models/eigen.py):
+    #: the (T, M, K, K) simulated-covariance transient — the pipeline's
+    #: largest allocation at production scale — is never materialized;
+    #: lax.map runs the sim eighs over (chunk, M, K, K) slabs instead.
+    #: "auto" (default) sizes the chunk from backend memory headroom at
+    #: trace time and keeps the full batch when it fits
+    #: (models.eigen.auto_eigen_chunk); None => always full batch; an
+    #: int >= 1 pins the slab size.  Chunked and full-batch results are
+    #: identical (same per-date op sequence, chunk-invariant solver
+    #: dispatch).
+    eigen_chunk: int | str | None = "auto"
     vol_regime_half_life: float = 42.0
     seed: int = 0
 
@@ -145,6 +156,14 @@ class RiskModelConfig:
             raise ValueError(
                 f"nw_method must be 'scan' or 'associative', "
                 f"got {self.nw_method!r}"
+            )
+        c = self.eigen_chunk
+        ok = c is None or c == "auto" or (
+            isinstance(c, int) and not isinstance(c, bool) and c >= 1
+        )
+        if not ok:
+            raise ValueError(
+                f"eigen_chunk must be an int >= 1, None, or 'auto'; got {c!r}"
             )
 
 

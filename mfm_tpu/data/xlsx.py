@@ -161,6 +161,8 @@ def read_index_list(path: str):
     if pd is None:  # pragma: no cover
         raise ImportError("pandas required")
     grid = read_xlsx(path, sheet=0)
+    if not grid:
+        raise ValueError(f"{path}: sheet 0 is empty — no header row to read")
     header = [str(h) for h in grid[0]]
     return pd.DataFrame(grid[1:], columns=header)
 
@@ -184,11 +186,14 @@ def read_industry_index_prices(path: str, sheet=0):
             if isinstance(first, str) and first.strip() == "指标名称":
                 header = [str(h) if h is not None else "" for h in row[1:]]
             continue
-        if not isinstance(first, (int, float)):
-            continue  # meta rows (frequency/unit) between header and data
+        if isinstance(first, bool) or not isinstance(first, (int, float)):
+            # meta rows (frequency/unit) between header and data; bool is
+            # an int subclass, and a stray TRUE cell is not a date serial
+            continue
         date = excel_serial_to_date(first).strftime("%Y%m%d")
         for name, val in zip(header, row[1:]):
-            if name and isinstance(val, (int, float)):
+            if name and isinstance(val, (int, float)) \
+                    and not isinstance(val, bool):
                 records.append({"index_name": name, "trade_date": date,
                                 "close": float(val)})
     if header is None:

@@ -97,6 +97,59 @@ def simulated_eigen_covs(
     return jnp.einsum("mkt,mlt->mkl", d, d) / (sim_length - 1)
 
 
+# working-set accounting for the chunked Monte-Carlo: the G tensor itself
+# plus XLA's eigh scratch (QDWH workspace is a few copies of the batch)
+_CHUNK_WORKSPACE_FACTOR = 4
+# host backends get a hard transient cap: LAPACK streams through chunks at
+# identical total FLOPs, and a bounded working set keeps huge histories from
+# thrashing the page cache (tools/eigh_cpu_ab.py for the solver A/B)
+_CHUNK_HOST_BUDGET_BYTES = 256 * 1024 * 1024
+
+
+def _memory_headroom_bytes(backend: str) -> int | None:
+    """Free memory on the compute device (HBM stats) or host (MemAvailable)."""
+    if backend in ("tpu", "axon", "gpu", "cuda", "rocm"):
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:
+            stats = None
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"]) - int(stats.get("bytes_in_use", 0))
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return None
+
+
+def auto_eigen_chunk(T: int, n_sims: int, n_factors: int, itemsize: int = 4,
+                     backend: str | None = None) -> int | None:
+    """Resolve ``eigen_chunk="auto"``: a date-chunk size for the eigen
+    Monte-Carlo, or None to run the full (T, M) batch in one shot.
+
+    The streamed transient is O(chunk * M * K^2); this sizes chunk from the
+    backend's live memory headroom (device HBM stats on accelerators, host
+    MemAvailable otherwise), keeping the full batch whenever it fits the
+    budget.  Resolved at trace time — the decision is baked into the
+    compiled program, like every other shape decision.
+    """
+    backend = backend or jax.default_backend()
+    per_date = n_sims * n_factors * n_factors * itemsize * _CHUNK_WORKSPACE_FACTOR
+    head = _memory_headroom_bytes(backend)
+    if backend in ("tpu", "axon", "gpu", "cuda", "rocm"):
+        # accelerator HBM: fit-or-chunk against half the free device memory
+        budget = head // 2 if head else 4 * 1024 ** 3
+    else:
+        budget = (min(head // 4, _CHUNK_HOST_BUDGET_BYTES) if head
+                  else _CHUNK_HOST_BUDGET_BYTES)
+    if T * per_date <= budget:
+        return None
+    return int(max(1, min(T, budget // per_date)))
+
+
 @highest_matmul_precision
 def eigen_risk_adjust_by_time(
     covs: jax.Array,
@@ -106,6 +159,7 @@ def eigen_risk_adjust_by_time(
     prefer_pallas: bool | None = None,
     sim_sweeps: int | None = None,
     sim_length: int | None = None,
+    chunk: int | None = None,
 ):
     """Batched adjustment over the date axis.
 
@@ -134,9 +188,21 @@ def eigen_risk_adjust_by_time(
     near-degenerate eigenvalues (round-1 advisor finding).  The eigenvector
     batch itself is never sorted (that would be a full HBM round trip over
     (T*M, K, K)); only two (T, M, K) value tensors are.
+
+    ``chunk`` streams the Monte-Carlo over the date axis: the (T, M, K, K)
+    G transient — by far the largest allocation of the whole pipeline at
+    production scale — is never materialized; instead ``lax.map`` runs the
+    sim eighs over (chunk, M, K, K) slabs and accumulates only the (T, K)
+    bias ratios.  ``None`` (or chunk >= T) keeps the single full batch.
+    The per-date math is identical either way (same op sequence per slab,
+    and ``batch_hint`` pins the solver dispatch to the full T*M batch size
+    regardless of chunking), so chunked == unchunked exactly on the XLA
+    path.  Use :func:`auto_eigen_chunk` to size it from live memory.
     """
     dtype = covs.dtype
+    T = covs.shape[0]
     K = covs.shape[-1]
+    M = sim_covs.shape[0]
     if sim_sweeps is None and sim_length is not None:
         sim_sweeps = sim_sweeps_for(K, dtype, sim_length)
     eye = jnp.eye(K, dtype=dtype)
@@ -154,29 +220,61 @@ def eigen_risk_adjust_by_time(
     # reads it back; pairing is restored below by sorting the scalar
     # (Dm, Dm_hat) pairs.  Signs square away in W*W.
     # D_hat = diag(U_m' F0 U_m) with U_m = U0 W  ->  sum_k W_ki^2 D0_k
-    G = s[:, None, :, None] * sim_covs[None] * s[:, None, None, :]
-    Dm, Dm_hat = batched_eigh_weighted_diag(
-        G, D0[:, None, :], prefer_pallas=prefer_pallas, sweeps=sim_sweeps)
-    # rank pairing, order-invariant across backends: i-th smallest sim
-    # eigenvalue pairs with the i-th smallest D0 (D0 is already ascending).
-    # One variadic key-value sort: ~3x cheaper on TPU than argsort + two
-    # take_along_axis gathers over the same (T, M, K) tensors (measured
-    # 0.15 s at CSI300 scale); is_stable matches jnp.argsort's tie order.
-    Dm, Dm_hat = jax.lax.sort((Dm, Dm_hat), dimension=-1, num_keys=1,
-                              is_stable=True)
-    # A numerically-zero sim eigenvalue (rank-deficient covariance: D0_k = 0
-    # zeroes G's k-th row/column, and LAPACK/Jacobi may emit 0 or -eps there)
-    # would make the ratio 0/0 or a huge spurious value — substitute ratio 1
-    # wherever |Dm| is below eps * lambda_max.  The substituted value only
-    # shifts v in directions the rebuild then scales by D0 ~ 0.
-    eps = jnp.asarray(jnp.finfo(dtype).eps, dtype)
-    thr = eps * jnp.max(jnp.abs(Dm), axis=-1, keepdims=True)
-    degenerate = jnp.abs(Dm) <= thr
-    ratio = jnp.where(degenerate, 1.0,
-                      Dm_hat / jnp.where(degenerate, 1.0, Dm))
-    # clamp: tiny-negative Dm just above thr could still push the mean
-    # negative, and sqrt of a negative poisons the whole date with NaN
-    v2 = jnp.maximum(jnp.mean(ratio, axis=1), 0.0)  # (T, K)
+    def _sim_bias_v2(s_c, d0_c):
+        """(c, K) sqrt-eigvals + eigvals -> (c, K) mean bias ratios v^2.
+
+        The whole per-date Monte-Carlo for a slab of dates — the one body
+        both the full-batch and the chunked path run, so their per-date op
+        sequence (and hence their result) is identical by construction.
+        """
+        G = s_c[:, None, :, None] * sim_covs[None] * s_c[:, None, None, :]
+        Dm, Dm_hat = batched_eigh_weighted_diag(
+            G, d0_c[:, None, :], prefer_pallas=prefer_pallas,
+            sweeps=sim_sweeps, batch_hint=T * M)
+        # rank pairing, order-invariant across backends: i-th smallest sim
+        # eigenvalue pairs with the i-th smallest D0 (D0 is already
+        # ascending).  One variadic key-value sort: ~3x cheaper on TPU than
+        # argsort + two take_along_axis gathers over the same (c, M, K)
+        # tensors (measured 0.15 s at CSI300 scale); is_stable matches
+        # jnp.argsort's tie order.
+        Dm, Dm_hat = jax.lax.sort((Dm, Dm_hat), dimension=-1, num_keys=1,
+                                  is_stable=True)
+        # A numerically-zero sim eigenvalue (rank-deficient covariance:
+        # D0_k = 0 zeroes G's k-th row/column, and LAPACK/Jacobi may emit 0
+        # or -eps there) would make the ratio 0/0 or a huge spurious value —
+        # substitute ratio 1 wherever |Dm| is below eps * lambda_max.  The
+        # substituted value only shifts v in directions the rebuild then
+        # scales by D0 ~ 0.
+        eps = jnp.asarray(jnp.finfo(dtype).eps, dtype)
+        thr = eps * jnp.max(jnp.abs(Dm), axis=-1, keepdims=True)
+        degenerate = jnp.abs(Dm) <= thr
+        ratio = jnp.where(degenerate, 1.0,
+                          Dm_hat / jnp.where(degenerate, 1.0, Dm))
+        # clamp: tiny-negative Dm just above thr could still push the mean
+        # negative, and sqrt of a negative poisons the whole date with NaN
+        return jnp.maximum(jnp.mean(ratio, axis=1), 0.0)  # (c, K)
+
+    if chunk is None or chunk >= T:
+        v2 = _sim_bias_v2(s, D0)  # (T, K)
+    else:
+        # stream: pad T up to a chunk multiple (padded dates carry s = 0,
+        # whose G is all-zero -> every ratio hits the degenerate guard ->
+        # v2 = 1; cropped below regardless), then map the slab body.  The
+        # (T, K)-sized map operands/outputs are pinned replicated under any
+        # ambient mesh — the serial stream gains nothing from sharding and
+        # scan-stacked sharded outputs trip the s64/s32 partitioner bug
+        # (see vol_regime.py).
+        from mfm_tpu.parallel.mesh import replicate_under_mesh
+
+        pad = (-T) % chunk
+        s_p = jnp.pad(s, ((0, pad), (0, 0)))
+        d0_p = jnp.pad(D0, ((0, pad), (0, 0)))
+        n_chunks = (T + pad) // chunk
+        s_p, d0_p = replicate_under_mesh((
+            s_p.reshape(n_chunks, chunk, K), d0_p.reshape(n_chunks, chunk, K)))
+        v2 = jax.lax.map(lambda args: _sim_bias_v2(*args), (s_p, d0_p))
+        v2 = replicate_under_mesh(v2.reshape(n_chunks * chunk, K)[:T])
+
     v = scale_coef * (jnp.sqrt(v2) - 1.0) + 1.0
 
     out = jnp.einsum("tik,tk,tjk->tij", U0, v * v * D0, U0)

@@ -131,8 +131,30 @@ def newey_west_expanding(
         tuple(zK for _ in range(q)),
         tuple(zK for _ in range(q)),
     )
-    _, (covs, valid) = jax.lax.scan(step, init, ret)
-    return covs, valid
+    # the serial recursion gains nothing from a sharded date axis (use the
+    # associative method for that); pin its input and stacked outputs
+    # replicated per the layout doctrine
+    from mfm_tpu.parallel.mesh import replicate_under_mesh
+
+    ret_r = replicate_under_mesh(ret)
+
+    # s32-indexed fori_loop rather than lax.scan: scan's stacked-output
+    # counter canonicalizes to s64 under x64 and trips the spmd partitioner's
+    # s32 offset math when the stacking axis ends up sharded (see
+    # vol_regime.py); the step math is unchanged, so V_t stays bitwise equal
+    def body(i, state):
+        carry, covs_acc, valid_acc = state
+        xt = jax.lax.dynamic_index_in_dim(ret_r, i, 0, keepdims=False)
+        carry, (V, v_ok) = step(carry, xt)
+        covs_acc = jax.lax.dynamic_update_index_in_dim(covs_acc, V, i, 0)
+        valid_acc = jax.lax.dynamic_update_index_in_dim(valid_acc, v_ok, i, 0)
+        return carry, covs_acc, valid_acc
+
+    _, covs, valid = jax.lax.fori_loop(
+        jnp.int32(0), jnp.int32(T), body,
+        (init, jnp.zeros((T, K, K), dtype), jnp.zeros((T,), bool)),
+    )
+    return replicate_under_mesh((covs, valid))
 
 
 def newey_west_expanding_associative(

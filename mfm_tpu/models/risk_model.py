@@ -21,6 +21,7 @@ turning the normal-equation reductions into XLA psums over ICI.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import NamedTuple, Sequence
 
 import jax
@@ -29,6 +30,7 @@ import numpy as np
 
 from mfm_tpu.config import RiskModelConfig
 from mfm_tpu.models.eigen import (
+    auto_eigen_chunk,
     eigen_risk_adjust_by_time,
     simulated_eigen_covs,
 )
@@ -120,7 +122,20 @@ class RiskModel:
         return eigen_risk_adjust_by_time(
             nw_cov, nw_valid, sim_covs, self.config.eigen_scale_coef,
             sim_sweeps=sweeps, sim_length=sim_len,
+            chunk=self._resolve_eigen_chunk(sim_covs.shape[0],
+                                            nw_cov.dtype.itemsize),
         )
+
+    def _resolve_eigen_chunk(self, n_sims: int, itemsize: int) -> int | None:
+        """config.eigen_chunk -> a concrete date-chunk size (or None).
+
+        "auto" consults live memory headroom, so resolution happens at trace
+        time, once per compile (models.eigen.auto_eigen_chunk).
+        """
+        c = self.config.eigen_chunk
+        if c == "auto":
+            return auto_eigen_chunk(self.T, n_sims, self.K, itemsize)
+        return c
 
     # -- stage 4 -----------------------------------------------------------
     def vol_regime_adj_by_time(self, factor_ret, eigen_cov, eigen_valid):
@@ -142,6 +157,44 @@ class RiskModel:
             nw_cov, nw_valid, eigen_cov, eigen_valid, vr_cov, lamb,
         )
 
+    def run_fused(self, key=None, sim_covs=None, sim_length=None) -> RiskModelOutputs:
+        """The whole four-stage pipeline as ONE jitted XLA program.
+
+        Same math and outputs as :meth:`run`, but regression, Newey-West,
+        eigen adjustment and vol regime fuse into a single compiled step —
+        no host round-trips between stages, and the five panel inputs are
+        donated so XLA reuses their buffers for intermediates/outputs (on
+        backends that support donation; CPU ignores it with a warning,
+        which we silence).  After a donating call the instance's panel
+        arrays may be invalidated on device backends — treat ``run_fused``
+        as consuming the model.
+
+        ``sim_covs`` is resolved on the host first (one tiny (M, K, K)
+        computation), so the compiled program is a pure function of the
+        panel — the jit cache keys only on shapes, config and sim_length.
+        """
+        sim_len = sim_length
+        if sim_covs is None:
+            if key is None:
+                key = jax.random.key(self.config.seed)
+            sim_len = self.config.eigen_sim_length or self.T
+            sim_covs = simulated_eigen_covs(
+                key, self.K, sim_len, self.config.eigen_n_sims,
+                dtype=self.ret.dtype,
+            )
+        import warnings
+
+        with warnings.catch_warnings():
+            # CPU has no donation support; the "donated buffers were not
+            # usable" warning is expected there, not actionable
+            warnings.filterwarnings(
+                "ignore", message=".*[Dd]onat.*", category=UserWarning)
+            return _fused_risk_step(
+                self.ret, self.cap, self.styles, self.industry, self.valid,
+                sim_covs, n_industries=self.n_industries, config=self.config,
+                sim_length=sim_len,
+            )
+
     def bias_stat(self, covs, valid, factor_ret, predlen: int = 1):
         """Eigenfactor bias statistic (``MFM.py:203-204``)."""
         return eigenfactor_bias_stat(covs, valid, factor_ret, predlen)
@@ -155,3 +208,20 @@ class RiskModel:
             + [f"industry_{i}" for i in range(self.n_industries)]
             + [f"style_{i}" for i in range(self.Q)]
         )
+
+
+# module-level so the compile cache is shared across RiskModel instances of
+# the same shape/config; RiskModelConfig is frozen-hashable by design
+# (config.py), making it a valid static argument.  The five panel operands
+# are donated — the regression consumes them in one pass, so XLA can retire
+# their buffers into the (T, N)-sized outputs instead of holding both.
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_industries", "config", "sim_length"),
+    donate_argnums=(0, 1, 2, 3, 4),
+)
+def _fused_risk_step(ret, cap, styles, industry, valid, sim_covs, *,
+                     n_industries, config, sim_length):
+    m = RiskModel(ret, cap, styles, industry, valid,
+                  n_industries=n_industries, config=config)
+    return m.run(sim_covs=sim_covs, sim_length=sim_length)

@@ -41,17 +41,33 @@ def vol_regime_adjust_by_time(
     B2z = jnp.where(ok, B2, 0.0)
     okf = ok.astype(dtype)
 
-    def step(carry, inp):
-        num, den = carry
-        b2, okv = inp
+    # tiny (T,) series: replicated per the layout doctrine (see mesh.py) —
+    # the serial recursion cannot use a sharded date axis anyway
+    from mfm_tpu.parallel.mesh import replicate_under_mesh
+
+    B2z, okf = replicate_under_mesh((B2z, okf))
+    T = B2z.shape[0]
+
+    # s32-indexed fori_loop rather than lax.scan: scan's stacked-output
+    # counter canonicalizes to s64 under x64, and XLA's spmd partitioner
+    # emits s32 shard-offset math around the dynamic_update_slice — the HLO
+    # verifier rejects the mixed compare when the stacking axis is sharded
+    def body(i, state):
+        num, den, out = state
+        b2 = jax.lax.dynamic_index_in_dim(B2z, i, 0, keepdims=False)
+        okv = jax.lax.dynamic_index_in_dim(okf, i, 0, keepdims=False)
         num = lam * num + okv * b2
         den = lam * den + okv
         # before any valid date numpy sums over empty arrays yield 0.0
         # (MFM.py:159-160), not NaN
-        return (num, den), jnp.where(den > 0, num / den, 0.0)
+        val = jnp.where(den > 0, num / den, 0.0)
+        return num, den, jax.lax.dynamic_update_index_in_dim(out, val, i, 0)
 
-    _, fvm2 = jax.lax.scan(
-        step, (jnp.asarray(0.0, dtype), jnp.asarray(0.0, dtype)), (B2z, okf)
+    _, _, fvm2 = jax.lax.fori_loop(
+        jnp.int32(0), jnp.int32(T), body,
+        (jnp.asarray(0.0, dtype), jnp.asarray(0.0, dtype),
+         jnp.zeros((T,), dtype)),
     )
+    fvm2 = replicate_under_mesh(fvm2)
     lamb = jnp.sqrt(fvm2)
     return covs * fvm2[:, None, None], lamb

@@ -26,10 +26,14 @@ magnitude component positive) makes results reproducible across backends.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
+import jax.extend as jex
 import jax.numpy as jnp
+from jax.interpreters import mlir
 
 
 def _brent_luk_perms(n: int):
@@ -190,20 +194,113 @@ def _pallas_eligible(A) -> bool:
     return A.dtype != jnp.float64 and n % 2 == 0 and n <= 128
 
 
-def _dispatch_eigh(operands: tuple, prefer_pallas, pallas_fn, xla_fn):
+# --- lowering-time platform selection -------------------------------------
+#
+# ``lax.platform_dependent`` is the obvious tool, but on this JAX it stages
+# every branch into a ``switch`` and lowers them ALL for the target platform
+# before the constant platform index can prune anything — so the Pallas
+# branch reaches pallas_call's CPU lowering rule and dies with "Only
+# interpret mode is supported on CPU backend".  Instead the selection is a
+# tiny primitive with per-platform lowering rules: the TPU rule lowers the
+# Pallas branch, the default rule lowers the fallback, and a non-TPU program
+# never contains the Pallas call at all.  No ``jax.devices()`` query happens
+# at trace time (the dryrun_multichip gate relies on that), and AOT export
+# for ("tpu",) from a CPU host still picks the Pallas rule.
+
+_platform_select_p = jex.core.Primitive("mfm_eigh_platform_select")
+_platform_select_p.multiple_results = True
+
+
+def _psel_run(fn, treedef, flat):
+    args = jax.tree_util.tree_unflatten(treedef, flat)
+    return jax.tree_util.tree_leaves(fn(*args))
+
+
+@_platform_select_p.def_impl
+def _psel_impl(*flat, treedef, tpu_fn, default_fn):
+    # eager execution: the computation runs on the process-default backend
+    fn = tpu_fn if jax.default_backend() in ("tpu", "axon") else default_fn
+    return _psel_run(fn, treedef, flat)
+
+
+@_platform_select_p.def_abstract_eval
+def _psel_abstract(*flat, treedef, tpu_fn, default_fn):
+    import jax.core as jax_core
+
+    args = jax.tree_util.tree_unflatten(treedef, flat)
+    outs = jax.eval_shape(default_fn, *args)
+    return [jax_core.ShapedArray(o.shape, o.dtype)
+            for o in jax.tree_util.tree_leaves(outs)]
+
+
+def _psel_lowering(which: str):
+    def fn(*flat, treedef, tpu_fn, default_fn):
+        return _psel_run(tpu_fn if which == "tpu" else default_fn,
+                         treedef, flat)
+
+    return mlir.lower_fun(fn, multiple_results=True)
+
+
+mlir.register_lowering(_platform_select_p, _psel_lowering("default"))
+for _plat in ("tpu", "axon"):
+    # 'axon' mirrors the tunnelled-TPU plugin name: device.platform reports
+    # 'tpu' there (PARITY_TPU.json), so 'tpu' is the rule that matches in
+    # practice; the alias is insurance against the plugin ever surfacing its
+    # own name as the lowering platform.
+    try:
+        mlir.register_lowering(_platform_select_p, _psel_lowering("tpu"),
+                               platform=_plat)
+    except Exception:
+        pass
+
+
+def _platform_select(operands: tuple, tpu_fn, default_fn):
+    flat, treedef = jax.tree_util.tree_flatten(tuple(operands))
+    outs = _platform_select_p.bind(*flat, treedef=treedef, tpu_fn=tpu_fn,
+                                   default_fn=default_fn)
+    out_tree = jax.tree_util.tree_structure(
+        jax.eval_shape(default_fn, *operands))
+    return jax.tree_util.tree_unflatten(out_tree, outs)
+
+
+def cpu_jacobi_batch_threshold() -> int | None:
+    """Batch size at which non-TPU backends route to the pure-JAX Jacobi.
+
+    ``MFM_EIGH_CPU_JACOBI_BATCH=<int>`` opts in; unset/empty/non-positive
+    means never.  The default is OFF because the A/B micro-bench
+    (``tools/eigh_cpu_ab.py``) shows multithreaded LAPACK beating the
+    vectorized Jacobi at every batch size on the dev host (K=42 f32:
+    0.36s vs 4.4s at B=1024) — the switch exists for hosts where LAPACK
+    dispatch overhead dominates, and it is the only CPU path that honors
+    the ``sweeps`` cap.
+    """
+    raw = os.environ.get("MFM_EIGH_CPU_JACOBI_BATCH", "").strip()
+    if not raw:
+        return None
+    thr = int(raw)
+    return thr if thr > 0 else None
+
+
+def _dispatch_eigh(operands: tuple, prefer_pallas, pallas_fn, xla_fn,
+                   jacobi_fn=None, batch_hint: int | None = None,
+                   cpu_jacobi: bool | None = None):
     """Shared backend dispatch for the batched eigh entry points.
 
     ``operands[0]`` is the matrix batch; extra operands ride along to the
-    branch functions.  ``prefer_pallas=None`` resolves the backend at
-    LOWERING time via ``lax.platform_dependent`` — not by querying
-    ``jax.devices()`` at trace time.  The trace-time query is wrong whenever
-    the computation targets a different backend than the process default: a
-    TPU-attached process jitting onto a virtual CPU mesh (the driver's
-    ``dryrun_multichip`` gate) would bake the Pallas branch into a CPU
-    program and die in lowering.  With ``platform_dependent`` the same
-    traced program lowers the Pallas branch on TPU and the XLA eigh
-    anywhere else; for single-platform lowering the choice is made before
-    the compiler ever sees a conditional.
+    branch functions.  ``prefer_pallas=None`` resolves Pallas-vs-fallback at
+    LOWERING time (see ``_platform_select_p``), never by querying
+    ``jax.devices()`` at trace time — the trace-time query is wrong whenever
+    the computation targets a different backend than the process default
+    (the driver's ``dryrun_multichip`` gate jits onto a virtual CPU mesh
+    from a TPU-attached process).
+
+    The non-Pallas branch picks between XLA's eigh and the pure-JAX Jacobi
+    (``jacobi_fn``) by static batch size: ``cpu_jacobi`` forces the choice,
+    otherwise batches of at least :func:`cpu_jacobi_batch_threshold` take
+    the Jacobi.  ``batch_hint`` overrides the batch size used for that
+    decision — the chunked eigen Monte-Carlo passes its full-run batch so
+    the solver choice (and thus the numbers) cannot depend on the chunk
+    size.
     """
     if not _pallas_eligible(operands[0]):
         if prefer_pallas:
@@ -213,31 +310,40 @@ def _dispatch_eigh(operands: tuple, prefer_pallas, pallas_fn, xla_fn):
                 f"handle dtype={A.dtype}, n={A.shape[-1]} (needs non-f64, "
                 "even n <= 128) — an explicit pin must not silently "
                 "measure the XLA fallback")
-        return xla_fn(*operands)
+        prefer_pallas = False
+
+    default_fn = xla_fn
+    if jacobi_fn is not None:
+        if cpu_jacobi is None:
+            thr = cpu_jacobi_batch_threshold()
+            batch = batch_hint if batch_hint is not None else int(
+                np.prod(operands[0].shape[:-2], dtype=np.int64))
+            cpu_jacobi = thr is not None and batch >= thr
+        if cpu_jacobi:
+            default_fn = jacobi_fn
+
     if prefer_pallas is None:
-        # 'axon' mirrors the tunnelled-TPU plugin name: device.platform
-        # reports 'tpu' there (PARITY_TPU.json), so 'tpu' is the branch that
-        # matches in practice; the alias is insurance against the plugin
-        # ever surfacing its own name as the lowering platform.
-        return jax.lax.platform_dependent(*operands, tpu=pallas_fn,
-                                          axon=pallas_fn, default=xla_fn)
-    return (pallas_fn if prefer_pallas else xla_fn)(*operands)
+        return _platform_select(operands, pallas_fn, default_fn)
+    return (pallas_fn if prefer_pallas else default_fn)(*operands)
 
 
 def batched_eigh(A, *, prefer_pallas: bool | None = None,
                  canonical_signs: bool = True, sort: bool = True,
-                 sweeps: int | None = None):
+                 sweeps: int | None = None, batch_hint: int | None = None,
+                 cpu_jacobi: bool | None = None):
     """Backend-aware batched eigh for (B, n, n) symmetric matrices.
 
     On TPU with even n <= 128 the VMEM-resident Pallas Jacobi kernel is ~8x
     XLA's QDWH eigh at the risk model's scale (139k 42x42 matrices: 1.77s
-    measured vs 14.2s); elsewhere XLA/LAPACK eigh wins.  Signs are
-    canonicalized either way so both paths produce identical decompositions
-    (eigenvalues ascending, leading component positive).
+    measured vs 14.2s); elsewhere XLA/LAPACK eigh wins by default, with huge
+    batches optionally routed to the pure-JAX Jacobi (``cpu_jacobi`` /
+    ``MFM_EIGH_CPU_JACOBI_BATCH``, see :func:`cpu_jacobi_batch_threshold`).
+    Signs are canonicalized either way so all paths produce identical
+    decompositions (eigenvalues ascending, leading component positive).
 
-    ``sweeps`` caps the Jacobi sweep count on the Pallas path only; the
-    XLA/LAPACK fallback (CPU, or odd/large n) always solves to full
-    precision and silently ignores it.
+    ``sweeps`` caps the Jacobi sweep count on the Pallas and pure-JAX Jacobi
+    paths; the XLA/LAPACK fallback always solves to full precision and
+    silently ignores it.
     """
     def _pallas(A):
         from mfm_tpu.ops.eigh_pallas import jacobi_eigh_tpu
@@ -253,11 +359,18 @@ def batched_eigh(A, *, prefer_pallas: bool | None = None,
             return canonicalize_signs(w, V)
         return w, V
 
-    return _dispatch_eigh((A,), prefer_pallas, _pallas, _xla)
+    def _jacobi(A):
+        return jacobi_eigh(A, sweeps=sweeps, canonical_signs=canonical_signs)
+
+    return _dispatch_eigh((A,), prefer_pallas, _pallas, _xla,
+                          jacobi_fn=_jacobi, batch_hint=batch_hint,
+                          cpu_jacobi=cpu_jacobi)
 
 
 def batched_eigh_weighted_diag(A, d0, *, prefer_pallas: bool | None = None,
-                               sweeps: int | None = None):
+                               sweeps: int | None = None,
+                               batch_hint: int | None = None,
+                               cpu_jacobi: bool | None = None):
     """Eigenvalues plus D0-weighted squared-eigenvector diagonal, batched.
 
     Returns ``(w, h)`` with ``h_i = sum_k V_ki^2 d0_k`` for symmetric
@@ -291,7 +404,16 @@ def batched_eigh_weighted_diag(A, d0, *, prefer_pallas: bool | None = None,
         w, V = jnp.linalg.eigh(A)
         return w, jnp.einsum("...ki,...k->...i", V * V, d0b)
 
-    return _dispatch_eigh((A, d0b), prefer_pallas, _pallas, _xla)
+    def _jacobi(A, d0b):
+        # honors the ``sweeps`` cap (sim matrices are near-diagonal, see
+        # models/eigen.py::sim_sweeps_for) — the one thing the LAPACK
+        # fallback cannot do
+        w, V = jacobi_eigh(A, sweeps=sweeps, canonical_signs=False)
+        return w, jnp.einsum("...ki,...k->...i", V * V, d0b)
+
+    return _dispatch_eigh((A, d0b), prefer_pallas, _pallas, _xla,
+                          jacobi_fn=_jacobi, batch_hint=batch_hint,
+                          cpu_jacobi=cpu_jacobi)
 
 
 def pinv_psd(G: jax.Array, *, rcond: float | None = None,

@@ -27,6 +27,53 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def use_mesh(mesh: Mesh):
+    """Ambient-mesh context across JAX versions.
+
+    ``jax.set_mesh`` (new API) when available, ``jax.sharding.use_mesh``
+    (transitional) otherwise, falling back to entering the ``Mesh`` itself —
+    the legacy context manager that sets the same ambient mesh for
+    ``NamedSharding``/shard_map resolution.
+    """
+    set_mesh = getattr(jax, "set_mesh", None)
+    if set_mesh is not None:
+        return set_mesh(mesh)
+    um = getattr(jax.sharding, "use_mesh", None)
+    if um is not None:
+        return um(mesh)
+    return mesh
+
+
+def _ambient_mesh() -> Mesh | None:
+    """The mesh set by ``use_mesh`` (or a legacy ``with mesh:``), if any."""
+    try:
+        from jax._src import mesh as _mesh_lib
+
+        m = _mesh_lib.thread_resources.env.physical_mesh
+        return None if m.empty else m
+    except Exception:
+        return None
+
+
+def replicate_under_mesh(x):
+    """Constrain a pytree of small arrays to the ambient mesh's replicated
+    layout; no-op when no mesh is active.
+
+    The expanding scans (Newey-West, vol-regime) stack tiny (K,)/(K, K)
+    per-date outputs; letting GSPMD shard that stacking axis buys nothing —
+    the layout doctrine replicates tiny per-date series — and trips an XLA
+    partitioner bug under x64 (the scan counter lowers as s64 while the
+    shard-offset math in the rewritten dynamic_update_slice stays s32, which
+    the HLO verifier rejects after spmd-partitioning).
+    """
+    m = _ambient_mesh()
+    if m is None:
+        return x
+    s = NamedSharding(m, P())
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.with_sharding_constraint(a, s), x)
+
+
 def make_mesh(
     n_date: int | None = None,
     n_stock: int = 1,

@@ -322,6 +322,7 @@ def run_risk_pipeline(
     industry_codes=None,
     sim_covs=None,
     sim_length: int | None = None,
+    fused: bool = True,
 ) -> RiskPipelineResult:
     """Barra table -> full risk model (the ``demo.py`` path).
 
@@ -330,6 +331,12 @@ def run_risk_pipeline(
     ``sim_covs`` set falls back to the conservative full-sweep count.
     (Without ``sim_covs`` the draws are generated internally and
     ``config.risk.eigen_sim_length`` already declares their count.)
+
+    ``fused`` (default) runs all four stages as one jitted program with
+    donated panel inputs (:meth:`RiskModel.run_fused`); the panels here are
+    fresh per-call copies, so donation costs callers nothing.  ``False``
+    keeps the stage-by-stage dispatch (e.g. to inspect intermediates under
+    a debugger).
     """
     config = config or PipelineConfig()
     if arrays is None:
@@ -341,7 +348,8 @@ def run_risk_pipeline(
         jnp.asarray(arrays.valid), n_industries=arrays.n_industries,
         config=config.risk, factor_names=arrays.factor_names(),
     )
-    out = rm.run(sim_covs=sim_covs, sim_length=sim_length)
+    run = rm.run_fused if fused else rm.run
+    out = run(sim_covs=sim_covs, sim_length=sim_length)
     return RiskPipelineResult(outputs=out, arrays=arrays, model=rm)
 
 

@@ -101,6 +101,37 @@ def determinism_check(fn: Callable, *args, atol: float = 0.0) -> bool:
     return True
 
 
+def compiled_memory(fn: Callable, *args, static_argnames=()) -> dict:
+    """Peak-memory breakdown of ``fn`` compiled for ``args``, in bytes.
+
+    Lowers and compiles ``jax.jit(fn)`` (hits the persistent compile cache
+    when warm) and reads XLA's buffer-assignment totals: ``temp_bytes`` is
+    the transient high-water mark — the scratch the program needs beyond
+    its inputs and outputs, exactly the quantity the eigen Monte-Carlo's
+    chunked stream is designed to bound — and ``peak_bytes`` adds the
+    argument/output residency for the whole-program figure.  Static
+    analysis, so it costs a compile but no execution.
+    """
+    compiled = jax.jit(fn, static_argnames=static_argnames).lower(
+        *args).compile()
+    m = compiled.memory_analysis()
+    if m is None:  # backends without buffer-assignment stats
+        return {}
+    temp = int(m.temp_size_in_bytes)
+    arg = int(m.argument_size_in_bytes)
+    out = int(m.output_size_in_bytes)
+    alias = int(m.alias_size_in_bytes)
+    return {
+        "temp_bytes": temp,
+        "argument_bytes": arg,
+        "output_bytes": out,
+        "alias_bytes": alias,
+        "generated_code_bytes": int(m.generated_code_size_in_bytes),
+        # aliased bytes live in the argument total; don't double-count them
+        "peak_bytes": temp + arg + out - alias,
+    }
+
+
 @contextlib.contextmanager
 def trace_annotation(name: str):
     """Named span visible in jax.profiler traces."""

@@ -1,0 +1,196 @@
+"""The chunked eigen Monte-Carlo stream and the fused risk step.
+
+Contract under test (models/eigen.py): ``eigen_risk_adjust_by_time`` with
+any ``chunk`` setting — including chunk sizes that do not divide T, and an
+"auto"-resolved one — produces results identical to the full-batch path,
+because both run the same per-date op sequence and the solver dispatch is
+pinned chunk-invariant via ``batch_hint``.  Likewise ``RiskModel.run_fused``
+is the same four-stage math as ``run`` inside one jitted program, and the
+CPU Jacobi fallback (ops/eigh.py, ``cpu_jacobi=True``) agrees with LAPACK.
+"""
+
+import dataclasses
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mfm_tpu.config import RiskModelConfig
+from mfm_tpu.models.eigen import (
+    auto_eigen_chunk,
+    eigen_risk_adjust_by_time,
+    simulated_eigen_covs,
+)
+from mfm_tpu.models.risk_model import RiskModel
+
+
+def _cov_panel(T=37, K=8, M=12, seed=0, invalid_frac=0.15):
+    rng = np.random.default_rng(seed)
+    A = rng.normal(size=(T, 60, K))
+    covs = jnp.asarray(np.einsum("tnk,tnl->tkl", A, A) / 59.0)
+    valid = jnp.asarray(rng.random(T) > invalid_frac)
+    sim_covs = simulated_eigen_covs(jax.random.key(1), K, 100, M,
+                                    dtype=covs.dtype)
+    return covs, valid, sim_covs
+
+
+@functools.partial(jax.jit, static_argnames="chunk")
+def _adjust(covs, valid, sim_covs, chunk):
+    return eigen_risk_adjust_by_time(covs, valid, sim_covs, sim_length=100,
+                                     chunk=chunk)
+
+
+# 1 (degenerate slabs), 7 (37 % 7 != 0: exercises the padded tail), T
+# (exactly one slab), 64 (> T: must take the full-batch path)
+@pytest.mark.parametrize("chunk", [1, 7, 37, 64])
+def test_chunked_equals_full_batch_bitwise(chunk):
+    covs, valid, sim_covs = _cov_panel()
+    ref, ok_ref = _adjust(covs, valid, sim_covs, None)
+    out, ok = _adjust(covs, valid, sim_covs, chunk)
+    assert jnp.array_equal(ok, ok_ref)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_chunked_all_invalid_panel():
+    # every date invalid: the eigh runs on identity stand-ins, the output
+    # must be all-NaN/invalid — including inside padded slabs
+    covs, _, sim_covs = _cov_panel()
+    valid = jnp.zeros(covs.shape[0], bool)
+    out, ok = _adjust(covs, valid, sim_covs, 7)
+    assert not bool(ok.any())
+    assert bool(jnp.isnan(out).all())
+
+
+def test_auto_chunk_policy_shapes():
+    # tiny problem fits any budget -> full batch; absurdly large T must
+    # chunk, and the chunk must be a valid size
+    assert auto_eigen_chunk(16, 4, 8, itemsize=4) is None
+    c = auto_eigen_chunk(10**9, 100, 42, itemsize=4)
+    assert isinstance(c, int) and 1 <= c < 10**9
+
+
+def test_auto_chunk_matches_full_batch():
+    covs, valid, sim_covs = _cov_panel()
+    cfgs = [RiskModelConfig(eigen_chunk=ec, eigen_n_sims=sim_covs.shape[0])
+            for ec in ("auto", None, 7)]
+    outs = []
+    for cfg in cfgs:
+        rm = RiskModel(jnp.zeros((covs.shape[0], 4)),  # panels unused here
+                       jnp.ones((covs.shape[0], 4)),
+                       jnp.zeros((covs.shape[0], 4, 1)),
+                       jnp.zeros((covs.shape[0], 4), int),
+                       jnp.ones((covs.shape[0], 4), bool),
+                       n_industries=2, config=cfg)
+        outs.append(rm.eigen_risk_adj_by_time(
+            covs, valid, sim_covs=sim_covs, sim_length=100))
+    for out, ok in outs[1:]:
+        # eager stage dispatch: same math, compiled per chunk setting —
+        # f64 keeps any fusion-order difference at the noise floor
+        np.testing.assert_allclose(np.asarray(out), np.asarray(outs[0][0]),
+                                   rtol=1e-12, atol=1e-12)
+        assert jnp.array_equal(ok, outs[0][1])
+
+
+def test_eigen_chunk_config_validation():
+    for bad in (0, -3, True, 1.5, "sometimes"):
+        with pytest.raises((ValueError, TypeError)):
+            RiskModelConfig(eigen_chunk=bad)
+    for good in (None, "auto", 1, 64):
+        RiskModelConfig(eigen_chunk=good)
+
+
+def _risk_panel(T=48, N=24, P=4, Q=3, seed=0):
+    rng = np.random.default_rng(seed)
+    ret = jnp.asarray(rng.normal(0, 0.02, (T, N)))
+    cap = jnp.asarray(rng.lognormal(10, 1, (T, N)))
+    styles = jnp.asarray(rng.normal(size=(T, N, Q)))
+    industry = jnp.asarray(rng.integers(0, P, (T, N)))
+    valid = jnp.asarray(rng.random((T, N)) > 0.05)
+    return ret, cap, styles, industry, valid
+
+
+def test_run_fused_matches_run():
+    panels = _risk_panel()
+    cfg = RiskModelConfig(eigen_n_sims=8, eigen_sim_length=48)
+    ref = RiskModel(*panels, n_industries=4, config=cfg).run()
+    out = RiskModel(*panels, n_industries=4, config=cfg).run_fused()
+    for name, a, b in zip(ref._fields, ref, out):
+        a, b = np.asarray(a), np.asarray(b)
+        # one fused XLA program vs per-stage dispatch: same math, different
+        # fusion boundaries — x64 keeps the drift at the noise floor
+        np.testing.assert_allclose(b, a, rtol=1e-10, atol=1e-12,
+                                   equal_nan=True, err_msg=name)
+
+
+def test_run_fused_compile_cache_shared_across_instances():
+    # the fused step is a module-level jit: a second instance with the same
+    # shapes and config must not retrace
+    from mfm_tpu.models.risk_model import _fused_risk_step
+
+    panels = _risk_panel()
+    cfg = RiskModelConfig(eigen_n_sims=4, eigen_sim_length=32)
+    RiskModel(*panels, n_industries=4, config=cfg).run_fused()
+    n0 = _fused_risk_step._cache_size()
+    RiskModel(*_risk_panel(seed=1), n_industries=4, config=cfg).run_fused()
+    assert _fused_risk_step._cache_size() == n0
+
+
+def test_cpu_jacobi_parity_with_lapack():
+    # the forced CPU Jacobi path (the batch-threshold escape hatch,
+    # ops/eigh.py::cpu_jacobi_batch_threshold) must agree with LAPACK
+    from mfm_tpu.ops.eigh import batched_eigh, batched_eigh_weighted_diag
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((64, 10, 10))
+    A = jnp.asarray((x + x.transpose(0, 2, 1)) / 2)
+    w_l, v_l = batched_eigh(A)
+    w_j, v_j = batched_eigh(A, cpu_jacobi=True)
+    np.testing.assert_allclose(np.asarray(w_j), np.asarray(w_l),
+                               rtol=1e-10, atol=1e-10)
+    # eigenvectors compare through their projectors (signs/degenerate
+    # subspaces are gauge); canonical_signs makes columns comparable here
+    np.testing.assert_allclose(np.asarray(v_j), np.asarray(v_l),
+                               rtol=1e-8, atol=1e-8)
+
+    d0 = jnp.asarray(rng.random((64, 10)) + 0.5)
+    wd_l, h_l = batched_eigh_weighted_diag(A, d0)
+    wd_j, h_j = batched_eigh_weighted_diag(A, d0, cpu_jacobi=True)
+    np.testing.assert_allclose(np.asarray(wd_j), np.asarray(wd_l),
+                               rtol=1e-10, atol=1e-10)
+    np.testing.assert_allclose(np.asarray(h_j), np.asarray(h_l),
+                               rtol=1e-8, atol=1e-8)
+
+
+def test_cpu_jacobi_batch_threshold_env(monkeypatch):
+    from mfm_tpu.ops import eigh as eigh_mod
+
+    monkeypatch.delenv("MFM_EIGH_CPU_JACOBI_BATCH", raising=False)
+    assert eigh_mod.cpu_jacobi_batch_threshold() is None
+    monkeypatch.setenv("MFM_EIGH_CPU_JACOBI_BATCH", "4096")
+    assert eigh_mod.cpu_jacobi_batch_threshold() == 4096
+    monkeypatch.setenv("MFM_EIGH_CPU_JACOBI_BATCH", "0")
+    assert eigh_mod.cpu_jacobi_batch_threshold() is None
+
+
+def test_compiled_memory_reports_chunk_savings():
+    # the observability helper must see the stream shrinking the transient:
+    # chunk=1 keeps one (1, M, K, K) slab live instead of (T, M, K, K)
+    from mfm_tpu.utils.obs import compiled_memory
+
+    covs, valid, sim_covs = _cov_panel(T=64, K=8, M=16)
+
+    def stage(chunk):
+        def f(c, v, s):
+            out, ok = eigen_risk_adjust_by_time(c, v, s, sim_length=100,
+                                                chunk=chunk)
+            return jnp.sum(jnp.where(jnp.isfinite(out), out, 0.0))
+        return f
+
+    full = compiled_memory(stage(None), covs, valid, sim_covs)
+    tiny = compiled_memory(stage(1), covs, valid, sim_covs)
+    if not full or not tiny:
+        pytest.skip("backend reports no memory_analysis")
+    assert tiny["temp_bytes"] < full["temp_bytes"]

@@ -135,7 +135,9 @@ def test_platform_dependent_lowerings_pick_the_right_branch():
     # the suite conftest enables x64 for golden parity; Mosaic lowering
     # rejects the weak-f64 literals that mode creates, and production
     # (pipeline fast path) runs with x64 off anyway
-    with jax.enable_x64(False):
+    from jax.experimental import disable_x64
+
+    with disable_x64():
         A = jnp.asarray(np.eye(42, dtype=np.float32)[None].repeat(2, 0))
         f = jax.jit(lambda A: batched_eigh(A))
         tpu_mod = str(export.export(f, platforms=("tpu",))(A).mlir_module())

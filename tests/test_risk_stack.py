@@ -202,14 +202,14 @@ def test_newey_west_associative_matches_scan(fret):
 def test_newey_west_associative_date_sharded(fret):
     """The sequence-parallel path with the date axis sharded over 8 devices."""
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from mfm_tpu.parallel.mesh import make_mesh
+    from mfm_tpu.parallel.mesh import make_mesh, use_mesh
 
     f = jnp.asarray(np.tile(fret, (1, 2)))  # K=10
     f = jnp.concatenate([f] * 2, axis=0)    # T=180... keep divisible by 8
     f = f[:176]
     mesh = make_mesh(8, 1)
     fs = jax.device_put(f, NamedSharding(mesh, P("date", None)))
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         covs, valid = jax.jit(
             lambda r: newey_west_expanding(r, 2, 252.0, method="associative")
         )(fs)

@@ -14,7 +14,7 @@ from mfm_tpu.data.synthetic import synthetic_barra_table
 from mfm_tpu.models.risk_model import RiskModel
 from mfm_tpu.ops.rolling import rolling_beta_hsigma
 from mfm_tpu.parallel.mesh import (
-    make_mesh, pad_to_mesh, panel_sharding, shard_panel,
+    make_mesh, pad_to_mesh, panel_sharding, shard_panel, use_mesh,
 )
 
 
@@ -55,7 +55,7 @@ def _assert_pipeline_sharded_equal(a, n_date, n_stock):
                       n_industries=a.n_industries, config=rm.config)
         return m.run(sim_covs=sim_covs)
 
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         out = jax.jit(pipeline)(*sharded_args, sim_covs)
 
     np.testing.assert_allclose(np.asarray(out.factor_ret)[:T],
@@ -117,7 +117,7 @@ def _assert_engine_sharded_equal(T, N, seed):
     }
     eng_sh = FactorEngine(sh_fields, idx_close, config=FactorConfig(),
                           block=16)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         out = {k: np.asarray(v)[:, :N] for k, v in eng_sh.run().items()}
 
     assert set(out) == set(base)
@@ -157,7 +157,7 @@ def test_full_pipeline_associative_nw_sharded_matches_scan(arrays):
                       n_industries=a.n_industries, config=cfg)
         return m.run(sim_covs=sim_covs)
 
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         out = jax.jit(pipeline)(*args, sim_covs)
 
     np.testing.assert_array_equal(np.asarray(out.nw_valid),
@@ -213,7 +213,7 @@ def test_regression_date_and_stock_sharded_2d(arrays):
                       n_industries=a.n_industries, config=rm.config)
         return m.reg_by_time()[0]
 
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         out = jax.jit(reg)(*args)
     np.testing.assert_allclose(np.asarray(out), np.asarray(base),
                                rtol=1e-9, atol=1e-12)
@@ -251,7 +251,7 @@ def test_portfolio_bias_sharded_matches_single_device():
     sharded = [jax.device_put(v, dsh)
                for v in (X, dval, covs, cov_valid, spec, ret)]
 
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         z, ok = jax.jit(portfolio_bias_stat)(*sharded, weights)
         got = np.asarray(bias_std(z, ok))
 

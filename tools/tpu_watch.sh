@@ -46,7 +46,13 @@ rm -rf "$fresh_cache"
 MFM_COMPILATION_CACHE="$fresh_cache" python bench.py --config alpha \
   2> "$out/alpha.err" | tail -1 > "$out/config5_alpha.json" \
   || echo "alpha bench FAILED (see alpha.err)" >> "$out/status"
-python bench.py --config alpha_alla 2> "$out/alpha_alla.err" | tail -1 > "$out/config5_alpha_alla.json" \
+# same cold-compile discipline as the alpha bench above: its own fresh
+# cache dir, so a previously-warmed ~/.cache/mfm_tpu/xla can't turn this
+# compile_s into a silent deserialization number
+fresh_cache_alla="$out/xla_cache_fresh_alla"
+rm -rf "$fresh_cache_alla"
+MFM_COMPILATION_CACHE="$fresh_cache_alla" python bench.py --config alpha_alla \
+  2> "$out/alpha_alla.err" | tail -1 > "$out/config5_alpha_alla.json" \
   || echo "alpha_alla bench FAILED (see alpha_alla.err)" >> "$out/status"
 # cache-hit rerun: same config + same cache dir in a FRESH process —
 # compile_s now measures the persistent-cache deserialization path
