@@ -171,6 +171,30 @@ def bench_riskmodel():
 
     tpu_s = _time3(fused_step)
 
+    # the daily-serving path: resumable state over the first T-1 dates, then
+    # ONE donated update step appending the last date — what a production
+    # deployment pays per new date instead of the full-rebuild e2e above.
+    # Each timed call copies the state + slab first (update donates both;
+    # a real serving loop donates the old state and keeps the returned one,
+    # so the copies are overhead the metric charges itself, not the user).
+    def _prefix(a):
+        return jnp.array(a[:-1], copy=True)
+
+    rm_hist = RiskModel(*[_prefix(a) for a in args], n_industries=P,
+                        config=cfg)
+    _, state0 = rm_hist.init_state(sim_covs=jnp.array(sim_covs, copy=True),
+                                   sim_length=T)
+
+    def update_step():
+        st = jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True),
+                                    state0)
+        fresh = [jnp.array(a[-1:], copy=True) for a in args]
+        m = RiskModel(*fresh, n_industries=P, config=cfg)
+        out, _ = m.update(st)
+        return _checksum(out)
+
+    upd_s = _time3(update_step)
+
     # per-stage split (VERDICT r3 weak #4): each stage jitted alone with its
     # real inputs passed as jit ARGUMENTS (closed-over arrays would embed as
     # constants and invite compile-time folding), so drift in any one stage
@@ -274,7 +298,19 @@ def bench_riskmodel():
             # metric — report it directly (T dates / regression-stage wall)
             "xreg_dates_per_sec": round(T / reg_s),
             "e2e_dates_per_sec": round(T / tpu_s),
-            "stages": {k: round(v, 4) for k, v in stage_s.items()},
+            # the incremental serving metrics: latency of appending ONE date
+            # to a (T-1)-date resumable state (RiskModel.update) vs
+            # rebuilding the whole history (the e2e number above)
+            "daily_update_latency_s": round(upd_s, 4),
+            "update_dates_per_sec": round(1.0 / upd_s),
+            "update_speedup_vs_e2e": round(tpu_s / upd_s, 1),
+            # each stage timed as its OWN jitted program (intermediates
+            # materialized at stage boundaries), so the sum exceeds the
+            # fused e2e wall above — the gap IS the fusion win, not noise
+            "stages_unfused": {k: round(v, 4) for k, v in stage_s.items()},
+            "stages_note": "independently jitted per-stage walls; their sum "
+                           "> e2e wall because the fused path elides the "
+                           "stage-boundary materialization",
             "memory": mem_rec,
             "roofline": _roofline(stage_s, models)}
 

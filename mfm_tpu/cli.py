@@ -118,12 +118,23 @@ def _write_result_tables(res, out: str, specific_risk: bool) -> None:
 
 def _risk(args):
     import numpy as np
+    import pandas as pd
     from mfm_tpu.config import PipelineConfig, RiskModelConfig
-    from mfm_tpu.data.barra import load_barra_csv
+    from mfm_tpu.data.barra import barra_frame_to_arrays
     from mfm_tpu.pipeline import run_risk_pipeline
 
     if args.bias_plot:
         _require_matplotlib("--bias-plot")  # before the pipeline runs
+    if args.update and args.save_state:
+        raise SystemExit("--update advances its checkpoint FILE in place; "
+                         "drop --save-state")
+    if (args.update or args.save_state) and args.nw_method != "scan":
+        raise SystemExit("the resumable state is the serial scan's carry; "
+                         "--save-state/--update need --nw-method scan")
+    if args.update and (args.bias_plot or args.portfolio_bias):
+        # bias statistics need history; an appended slab has none
+        raise SystemExit("--update serves new dates only — run the bias "
+                         "acceptance tests on a full-history run instead")
 
     cfg = PipelineConfig(
         risk=RiskModelConfig(
@@ -131,6 +142,7 @@ def _risk(args):
             nw_method=args.nw_method,
             eigen_n_sims=args.eigen_sims, eigen_scale_coef=args.eigen_scale,
             eigen_chunk=args.eigen_chunk,
+            eigen_sim_length=args.eigen_sim_length,
             vol_regime_half_life=args.vr_half_life, seed=args.seed,
         ),
         dtype=args.dtype,
@@ -139,7 +151,6 @@ def _risk(args):
         # the demo.ipynb variant: barra table from the store's
         # ``barra_factors`` collection (written by ``pipeline --to-store``,
         # the reference's main.py:144-155 Mongo save) instead of a CSV
-        from mfm_tpu.data.barra import barra_frame_to_arrays
         from mfm_tpu.data.etl import PanelStore
 
         st = PanelStore(args.barra_store)
@@ -150,8 +161,6 @@ def _risk(args):
         if args.industry_info:
             # an explicit file wins over the store's own collection (same
             # role as on the CSV path: fix the one-hot code order)
-            import pandas as pd
-
             codes = pd.read_csv(args.industry_info)["code"].to_numpy()
         else:
             info = st.read("sw_industry_info_for_factors")
@@ -163,15 +172,56 @@ def _risk(args):
                     "collection — rerun `pipeline --to-store`, or pass the "
                     "code list explicitly with --industry-info")
             codes = info["code"].to_numpy()
-        arrays = barra_frame_to_arrays(df, industry_codes=codes)
     else:
-        arrays = load_barra_csv(args.barra, args.industry_info)
+        df = pd.read_csv(args.barra)
+        codes = (pd.read_csv(args.industry_info)["code"].to_numpy()
+                 if args.industry_info else None)
+
+    if args.update:
+        # incremental serving: one O(slab) update step from the checkpoint
+        # instead of the O(T) full-history rebuild — same outputs, bitwise
+        from mfm_tpu.pipeline import (
+            append_risk_pipeline, date_stamp, save_pipeline_state,
+        )
+
+        t0 = time.perf_counter()
+        with _profile_ctx(args.profile):
+            try:
+                res = append_risk_pipeline(args.update, df, config=cfg)
+            except ValueError as err:
+                raise SystemExit(f"--update: {err}") from err
+        _write_result_tables(res, args.out, args.specific_risk)
+        save_pipeline_state(args.update, res)  # advance the checkpoint
+        wall = time.perf_counter() - t0
+        if args.save_outputs:
+            _save_outputs_npz(res, args.out,
+                              args.barra or args.barra_store)
+        _maybe_portfolio_risk(res, args)
+        print(json.dumps({
+            "appended_dates": [date_stamp(d) for d in res.arrays.dates],
+            "stocks": int(res.arrays.ret.shape[1]),
+            "factors": len(res.arrays.factor_names()),
+            "update_wall_s": round(wall, 3),
+            "mean_r2": float(np.nanmean(np.asarray(res.outputs.r2))),
+            "state": args.update,
+        }))
+        return
+
+    arrays = barra_frame_to_arrays(df, industry_codes=codes)
     t0 = time.perf_counter()
     # the reported wall_s includes the profiler overhead when --profile is on
     with _profile_ctx(args.profile):
-        res = run_risk_pipeline(arrays=arrays, config=cfg)
+        res = run_risk_pipeline(arrays=arrays, config=cfg,
+                                with_state=bool(args.save_state))
     _write_result_tables(res, args.out, args.specific_risk)
     wall = time.perf_counter() - t0
+    if args.save_state:
+        # checkpoint the resumable scan state (outside the timed region,
+        # like the artifact/plot writes below); `risk --update FILE` serves
+        # the next dates from it in O(1) each
+        from mfm_tpu.pipeline import save_pipeline_state
+
+        save_pipeline_state(args.save_state, res)
     if args.save_outputs:
         # the full (T, K, K) covariance series + every stage output as one
         # artifact (the CSV tables only carry the last date's covariance,
@@ -451,10 +501,122 @@ def _append_alpha_styles(args, sources, barra, prep):
     return barra, report
 
 
+def _check_append_prefix_unrevised(prev_barra, barra, last_date, dtype):
+    """Refuse an append whose refreshed factor table rewrote history.
+
+    Compares the rows at or before the checkpoint's last date between the
+    table the prior run persisted and the refreshed one, on the columns
+    both have, AT THE RISK COMPUTE DTYPE: the factor stage's f64
+    intermediates jitter in the last ulp when the history length changes
+    (XLA re-tiles the reductions), but only what survives the cast to
+    ``dtype`` ever reached the checkpointed scan.  Dates normalize to the
+    checkpoint's 'YYYY-MM-DD' stamps so string ordering is chronological."""
+    import numpy as np
+    import pandas as pd
+
+    fdtype = np.dtype(dtype)
+
+    if prev_barra is None:
+        raise SystemExit("--append: the prior run's barra_data.csv is "
+                         "missing — run the pipeline once without --append "
+                         "first")
+
+    def norm(df):
+        df = df.copy()
+        df["date"] = pd.to_datetime(df["date"]).dt.strftime("%Y-%m-%d")
+        df = df[df["date"] <= last_date]
+        return df.sort_values(["date", "stocknames"]).reset_index(drop=True)
+
+    old, new = norm(prev_barra), norm(barra)
+    cols = [c for c in old.columns if c in set(new.columns)]
+    bad = None
+    if len(old) != len(new) or \
+            not old["date"].equals(new["date"]) or \
+            not old["stocknames"].astype(str).equals(
+                new["stocknames"].astype(str)):
+        bad = "row set"
+    else:
+        for c in cols:
+            oc, nc = old[c].to_numpy(), new[c].to_numpy()
+            if oc.dtype.kind == "f" and nc.dtype.kind == "f":
+                same = np.array_equal(oc.astype(fdtype), nc.astype(fdtype),
+                                      equal_nan=True)
+            else:
+                same = bool((old[c].astype(str) == new[c].astype(str)).all())
+            if not same:
+                bad = f"column {c!r}"
+                break
+    if bad is not None:
+        raise SystemExit(
+            f"--append: the refreshed factor table revised history at or "
+            f"before the checkpoint (last_date={last_date}, {bad} changed) "
+            "— typically a next-traded-day return label filling in across "
+            "a suspension gap.  The incremental path cannot reproduce a "
+            "revised prefix; rerun without --append")
+
+
+def _pipeline_append_stage(args, barra, cfg, prev_barra):
+    """``--append``'s risk stage: prior outputs artifact + checkpoint + ONE
+    :meth:`RiskModel.update` step over the dates past the checkpoint ->
+    a full-history result, bitwise what a from-scratch rerun would produce
+    for the risk stage.
+
+    The factor stage's rolling windows are causal, so style/cap rows at or
+    before the checkpoint cannot change — but the t+1 return label is NOT:
+    ``shift_ret_next_period`` gives each row the stock's *next traded day*
+    return, so a suspension gap straddling the checkpoint fills a prefix
+    label in once the stock trades again.  A from-scratch rerun would see
+    that revised history; the checkpoint didn't.  ``prev_barra`` (the table
+    the prior run wrote) lets us detect the revision and refuse rather than
+    silently diverge."""
+    import numpy as np
+    from mfm_tpu.data.artifacts import load_artifact, load_risk_outputs
+    from mfm_tpu.data.barra import barra_frame_to_arrays
+    from mfm_tpu.models.risk_model import RiskModelOutputs
+    from mfm_tpu.pipeline import (
+        RiskPipelineResult, append_risk_pipeline, date_stamp,
+    )
+
+    state_path = os.path.join(args.out, "risk_state.npz")
+    prev_path = os.path.join(args.out, "risk_outputs.npz")
+    for p in (state_path, prev_path):
+        if not os.path.exists(p):
+            raise SystemExit(f"--append: {p} not found — run the pipeline "
+                             "once without --append first")
+    prev, _ = load_risk_outputs(prev_path)
+    _, smeta = load_artifact(state_path)
+    _check_append_prefix_unrevised(prev_barra, barra, smeta["last_date"],
+                                   cfg.dtype)
+    t0 = time.perf_counter()
+    try:
+        app = append_risk_pipeline(state_path, barra, config=cfg)
+    except ValueError as err:
+        raise SystemExit(f"--append: {err}") from err
+    update_wall = time.perf_counter() - t0
+    # full-history arrays pinned to the checkpoint's axes, so the
+    # concatenated outputs' rows/columns line up with the new table exactly
+    full = barra_frame_to_arrays(
+        barra, industry_codes=app.arrays.industry_codes,
+        style_names=app.arrays.style_names, stocks=app.arrays.stocks)
+    T_prev = int(np.asarray(prev.r2).shape[0])
+    if T_prev + len(app.arrays.dates) != len(full.dates):
+        raise SystemExit(
+            f"--append: {prev_path} covers {T_prev} dates but the refreshed "
+            f"table has {len(full.dates)} with {len(app.arrays.dates)} new "
+            "— the history itself changed; rerun without --append")
+    cat = RiskModelOutputs(*[
+        np.concatenate([np.asarray(p), np.asarray(n)], axis=0)
+        for p, n in zip(prev, app.outputs)])
+    res = RiskPipelineResult(outputs=cat, arrays=full, state=app.state)
+    return res, [date_stamp(d) for d in app.arrays.dates], update_wall
+
+
 def _pipeline(args):
     """One-command end-to-end: raw store -> master panel -> factor table ->
     risk outputs (the reference's ``main.py`` + ``demo.py`` chain), with a
-    stage artifact between the factor and risk stages for resume."""
+    stage artifact between the factor and risk stages for resume, and a
+    risk-state checkpoint (``OUT/risk_state.npz``) for ``--append``'s
+    O(new-dates) daily refresh."""
     import numpy as np
     import pandas as pd
     from mfm_tpu.config import PipelineConfig, RiskModelConfig
@@ -462,12 +624,19 @@ def _pipeline(args):
     from mfm_tpu.data.prepare import prepare_factor_inputs
     from mfm_tpu.pipeline import run_factor_pipeline, run_risk_pipeline
 
+    if args.append and args.resume:
+        raise SystemExit("--append re-runs the factor stage over the "
+                         "refreshed store; drop --resume")
+    if args.append and args.nw_method != "scan":
+        raise SystemExit("the resumable state is the serial scan's carry; "
+                         "--append needs --nw-method scan")
     cfg = PipelineConfig(
         risk=RiskModelConfig(
             nw_lags=args.nw_lags, nw_half_life=args.nw_half_life,
             nw_method=args.nw_method,
             eigen_n_sims=args.eigen_sims, eigen_scale_coef=args.eigen_scale,
             eigen_chunk=args.eigen_chunk,
+            eigen_sim_length=args.eigen_sim_length,
             vol_regime_half_life=args.vr_half_life, seed=args.seed,
         ),
         dtype=args.dtype,
@@ -487,6 +656,10 @@ def _pipeline(args):
     alpha_sources = (_read_alpha_sources(args.alphas, llm=args.alphas_llm)
                      if args.alphas else None)
     prep = None
+    # the factor stage below overwrites barra_data.csv; --append's history-
+    # revision check needs the prior run's table, so read it first
+    prev_barra = (pd.read_csv(barra_path)
+                  if args.append and os.path.exists(barra_path) else None)
     with _profile_ctx(args.profile):
         if args.resume and os.path.exists(barra_path) \
                 and os.path.exists(industry_info_path):
@@ -540,16 +713,30 @@ def _pipeline(args):
                 json.dump(report, fh, indent=1)
 
         codes = info_df["code"].to_numpy()
-        res = run_risk_pipeline(barra_df=barra, config=cfg,
-                                industry_codes=codes)
+        appended = update_wall = None
+        if args.append:
+            res, appended, update_wall = _pipeline_append_stage(
+                args, barra, cfg, prev_barra)
+        else:
+            # capture the resumable scan state alongside the outputs (same
+            # fused math; the associative NW method has no serial carry to
+            # checkpoint, so no state there)
+            res = run_risk_pipeline(barra_df=barra, config=cfg,
+                                    industry_codes=codes,
+                                    with_state=cfg.risk.nw_method == "scan")
     _write_result_tables(res, args.out, args.specific_risk)
     wall = time.perf_counter() - t0
     _save_outputs_npz(res, args.out, args.store)  # outside the timed region
+    if res.state is not None:
+        # the daily-serving checkpoint `pipeline --append` resumes from
+        from mfm_tpu.pipeline import save_pipeline_state
+
+        save_pipeline_state(os.path.join(args.out, "risk_state.npz"), res)
     # acceptance-test compute stays OUT of the reported wall (same policy
     # as _risk's bias block)
     _maybe_portfolio_bias(res, args)
     _maybe_portfolio_risk(res, args)
-    print(json.dumps({
+    rec = {
         "rows": int(len(barra)),
         "dates": int(res.arrays.ret.shape[0]),
         "stocks": int(res.arrays.ret.shape[1]),
@@ -559,7 +746,11 @@ def _pipeline(args):
         "mean_r2": float(np.nanmean(np.asarray(res.outputs.r2))),
         "alpha_styles": n_alpha_styles,
         "out": args.out,
-    }))
+    }
+    if appended is not None:
+        rec["appended_dates"] = appended
+        rec["update_wall_s"] = round(update_wall, 3)
+    print(json.dumps(rec))
 
 
 def _alpha(args):
@@ -950,7 +1141,25 @@ def main(argv=None):
         "pins it.  Results are identical either way")
     r.add_argument("--eigen-chunk", type=_eigen_chunk, default="auto",
                    metavar="N|auto|none", help=_eigen_chunk_help)
+    _eigen_sim_length_help = (
+        "draw length behind each simulated covariance (default: the panel "
+        "length T).  Pin it when serving incrementally: a checkpoint "
+        "freezes its Monte-Carlo draws, and only a pinned length keeps a "
+        "from-scratch rerun on the same draws (bitwise comparability)")
+    r.add_argument("--eigen-sim-length", type=_positive_int, default=None,
+                   metavar="L", help=_eigen_sim_length_help)
 
+    r.add_argument("--save-state", default=None, metavar="FILE.npz",
+                   help="also checkpoint the resumable scan state (NW/vol-"
+                        "regime carries + frozen eigen draws) after the last "
+                        "date; `risk --update FILE.npz` then serves each new "
+                        "date in O(1) instead of an O(T) rebuild")
+    r.add_argument("--update", default=None, metavar="FILE.npz",
+                   help="incremental serve: load this checkpoint, run ONE "
+                        "update step over the barra table's dates after the "
+                        "checkpoint's last date, write tables for those "
+                        "dates only, and advance FILE in place.  Outputs "
+                        "are bitwise the full-history run's")
     r.add_argument("--save-outputs", action="store_true",
                    help="also write OUT/risk_outputs.npz (every stage "
                         "output incl. the full covariance series — the "
@@ -1023,6 +1232,13 @@ def main(argv=None):
     pl.add_argument("--fin-start", default="20190101")
     pl.add_argument("--resume", action="store_true",
                     help="reuse the barra_data.csv stage artifact if present")
+    pl.add_argument("--append", action="store_true",
+                    help="daily refresh: re-run the factor stage over the "
+                         "(updated) store, then serve only the dates past "
+                         "OUT/risk_state.npz's checkpoint with ONE update "
+                         "step and splice them onto OUT's artifacts — OUT "
+                         "ends up bitwise identical to a from-scratch risk "
+                         "stage, in O(new dates) instead of O(history)")
     pl.add_argument("--to-store", default=None, metavar="STORE",
                     help="also save barra_factors + "
                          "sw_industry_info_for_factors collections into this "
@@ -1039,6 +1255,8 @@ def main(argv=None):
     pl.add_argument("--eigen-scale", type=float, default=1.4)
     pl.add_argument("--eigen-chunk", type=_eigen_chunk, default="auto",
                     metavar="N|auto|none", help=_eigen_chunk_help)
+    pl.add_argument("--eigen-sim-length", type=_positive_int, default=None,
+                    metavar="L", help=_eigen_sim_length_help)
     pl.add_argument("--vr-half-life", type=float, default=42.0)
     pl.add_argument("--seed", type=int, default=0)
     pl.add_argument("--dtype", default="float32")

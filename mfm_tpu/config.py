@@ -142,6 +142,20 @@ class RiskModelConfig:
     vol_regime_half_life: float = 42.0
     seed: int = 0
 
+    def identity(self) -> tuple:
+        """The math identity of the covariance stack: every field that can
+        change the numbers.  ``eigen_chunk`` is excluded — chunked and
+        full-batch evaluation are bitwise identical (models/eigen.py), so it
+        is an execution knob, not a model parameter.  Stamped into
+        ``RiskModelState`` so a checkpoint refuses to resume under a config
+        that would silently change the math mid-history.
+        """
+        return (
+            self.nw_lags, self.nw_half_life, self.nw_method,
+            self.eigen_n_sims, self.eigen_scale_coef, self.eigen_sim_length,
+            self.eigen_sim_sweeps, self.vol_regime_half_life, self.seed,
+        )
+
     def __post_init__(self):
         s = self.eigen_sim_sweeps
         ok = s is None or s == "auto" or (

@@ -12,7 +12,7 @@
 - :mod:`mfm_tpu.data.prepare` — store -> master factor-input panel
   (``load_and_prepare_data`` path).
 - :mod:`mfm_tpu.data.artifacts` — stage-artifact checkpointing (npz +
-  schema stamp) and the compilation cache.
+  schema stamp), including the resumable risk-model state.
 - :mod:`mfm_tpu.data.mongo_store` — pymongo adapter with the PanelStore
   interface (import-guarded).
 - :mod:`mfm_tpu.data.tushare_source` — the Tushare Pro fetcher surface

@@ -3,7 +3,8 @@
 The reference checkpoints *data* between stages everywhere (Mongo collections
 with watermarks, intermediate CSVs — SURVEY.md §5 "Checkpoint / resume").
 Here every stage boundary can persist its arrays to an .npz artifact with a
-schema stamp, and jitted executables persist via JAX's compilation cache.
+schema stamp, and jitted executables persist via JAX's compilation cache
+(``mfm_tpu.utils.cache.enable_persistent_compilation_cache``).
 """
 
 from __future__ import annotations
@@ -13,8 +14,6 @@ import os
 from typing import Mapping
 
 import numpy as np
-
-import jax
 
 FORMAT_VERSION = 1
 
@@ -27,7 +26,17 @@ def save_artifact(path: str, arrays: Mapping[str, object], meta: dict | None = N
         json.dumps({"format": FORMAT_VERSION, **(meta or {})}).encode(), dtype=np.uint8
     )
     tmp = path + ".tmp.npz"  # savez appends .npz unless already present
-    np.savez_compressed(tmp, **payload)
+    try:
+        np.savez_compressed(tmp, **payload)
+    except BaseException:
+        # a failed write must not leave a half-written temp behind — the
+        # next save would os.replace over it, but stray .tmp.npz files in
+        # artifact dirs confuse globbing consumers and retention scripts
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
     os.replace(tmp, path)
 
 
@@ -63,14 +72,103 @@ def load_risk_outputs(path: str):
                                for f in RiskModelOutputs._fields}), meta
 
 
-def enable_compilation_cache(cache_dir: str | None = None):
-    """Persist jitted executables across processes (the reference's analogue
-    is nothing — every run recompiles pandas ops; here a second run of the
-    same pipeline skips XLA compilation entirely)."""
-    cache_dir = cache_dir or os.environ.get(
-        "MFM_COMPILE_CACHE", os.path.expanduser("~/.cache/mfm_tpu_xla")
+# -- risk-model state (the incremental daily-update checkpoint) --------------
+
+_NW_SCALARS = ("nw_t", "nw_S", "nw_A", "nw_Z")
+_NW_STACKED = ("nw_Ps", "nw_hs", "nw_gs", "nw_Slags", "nw_xlags")
+
+
+def save_risk_state(path: str, state, meta: dict | None = None):
+    """Persist a :class:`mfm_tpu.models.risk_model.RiskModelState`.
+
+    The Newey-West carry's per-lag tuples stack into ``(q, ...)`` arrays;
+    everything identity-like (static aux + caller alignment metadata such
+    as stocks / style names / last date) rides in the JSON ``__meta__``
+    buffer.  npz round-trips every dtype bit-exactly, so a rehydrated
+    state resumes the scans bitwise.
+    """
+    t, S, A, Z, Ps, hs, gs, Slags, xlags = state.nw_carry
+    arrays = {
+        "nw_t": np.asarray(t),
+        "nw_S": np.asarray(S),
+        "nw_A": np.asarray(A),
+        "nw_Z": np.asarray(Z),
+        "nw_Ps": np.stack([np.asarray(p) for p in Ps]) if Ps
+                 else np.zeros((0,) + np.asarray(A).shape, np.asarray(A).dtype),
+        "nw_hs": np.stack([np.asarray(h) for h in hs]) if hs
+                 else np.zeros((0,) + np.asarray(S).shape, np.asarray(S).dtype),
+        "nw_gs": np.stack([np.asarray(g) for g in gs]) if gs
+                 else np.zeros((0,), np.asarray(Z).dtype),
+        "nw_Slags": np.stack([np.asarray(s) for s in Slags]) if Slags
+                    else np.zeros((0,) + np.asarray(S).shape, np.asarray(S).dtype),
+        "nw_xlags": np.stack([np.asarray(x) for x in xlags]) if xlags
+                    else np.zeros((0,) + np.asarray(S).shape, np.asarray(S).dtype),
+        "vr_num": np.asarray(state.vr_num),
+        "vr_den": np.asarray(state.vr_den),
+        "sim_covs": np.asarray(state.sim_covs),
+    }
+    state_meta = {
+        "kind": "risk_state",
+        "nw_q": len(Ps),
+        "sim_length": state.sim_length,
+        "eigen_batch_hint": state.eigen_batch_hint,
+        "stamp": _stamp_to_json(state.stamp),
+        "last_date": state.last_date,
+    }
+    save_artifact(path, arrays, {**state_meta, **(meta or {})})
+
+
+def load_risk_state(path: str):
+    """Rehydrate a :func:`save_risk_state` artifact.
+
+    Returns ``(RiskModelState, meta)``; arrays come back as jax arrays with
+    their exact saved dtypes, so ``RiskModel.update`` from the loaded state
+    is bitwise the run that would have continued in-process.
+    """
+    import jax.numpy as jnp
+
+    from mfm_tpu.models.risk_model import RiskModelState
+
+    arrays, meta = load_artifact(path)
+    missing = (set(_NW_SCALARS) | set(_NW_STACKED)
+               | {"vr_num", "vr_den", "sim_covs"}) - set(arrays)
+    if meta.get("kind") != "risk_state" or missing:
+        raise ValueError(f"{path}: not a risk-state artifact"
+                         + (f" — missing field(s) {sorted(missing)}"
+                            if missing else ""))
+    q = int(meta["nw_q"])
+    unstack = lambda name: tuple(jnp.asarray(arrays[name][i]) for i in range(q))
+    nw_carry = (
+        jnp.asarray(arrays["nw_t"]),
+        jnp.asarray(arrays["nw_S"]),
+        jnp.asarray(arrays["nw_A"]),
+        jnp.asarray(arrays["nw_Z"]),
+        unstack("nw_Ps"), unstack("nw_hs"), unstack("nw_gs"),
+        unstack("nw_Slags"), unstack("nw_xlags"),
     )
-    os.makedirs(cache_dir, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    return cache_dir
+    state = RiskModelState(
+        nw_carry,
+        jnp.asarray(arrays["vr_num"]),
+        jnp.asarray(arrays["vr_den"]),
+        jnp.asarray(arrays["sim_covs"]),
+        sim_length=meta["sim_length"],
+        eigen_batch_hint=int(meta["eigen_batch_hint"]),
+        stamp=_stamp_from_json(meta["stamp"]),
+        last_date=meta.get("last_date"),
+    )
+    return state, meta
+
+
+def _stamp_to_json(obj):
+    """Nested tuples -> nested lists with a tag, reversibly (JSON has no
+    tuple; the stamp is compared with ``==`` against a live model's tuple
+    stamp, so the round trip must restore tuple-ness exactly)."""
+    if isinstance(obj, tuple):
+        return {"__tuple__": [_stamp_to_json(x) for x in obj]}
+    return obj
+
+
+def _stamp_from_json(obj):
+    if isinstance(obj, dict) and "__tuple__" in obj:
+        return tuple(_stamp_from_json(x) for x in obj["__tuple__"])
+    return obj

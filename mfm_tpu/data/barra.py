@@ -51,13 +51,19 @@ def barra_frame_to_arrays(
     style_names: Sequence[str] | None = None,
     drop_any_nan: bool = True,
     dtype=np.float64,
+    stocks: Sequence | None = None,
 ) -> BarraArrays:
     """Densify a barra-format long DataFrame.
 
     ``industry_codes`` fixes the one-hot column order (the reference reads it
     from ``industry_info.csv``, ``demo.py:32-35``); default: sorted unique
     codes present.  ``drop_any_nan`` applies the reference's row filter
-    (``demo.py:25-27``).
+    (``demo.py:25-27``).  ``stocks`` pins the stock axis to a given ordered
+    list (the incremental append path aligns a new-date slab to the
+    checkpoint's stock universe this way): stocks absent from ``df`` become
+    all-invalid columns, and stocknames outside the list raise — a new
+    listing silently dropped from a resumed history would desync every
+    column after it.
     """
     if pd is None:  # pragma: no cover
         raise ImportError("pandas required")
@@ -67,7 +73,17 @@ def barra_frame_to_arrays(
     if drop_any_nan:
         df = df.dropna(how="any")
     dates = np.sort(df["date"].unique())
-    stocks = np.sort(df["stocknames"].unique())
+    if stocks is None:
+        stocks = np.sort(df["stocknames"].unique())
+    else:
+        stocks = np.asarray(stocks)
+        unknown = np.setdiff1d(df["stocknames"].unique(), stocks)
+        if unknown.size:
+            raise ValueError(
+                f"stocknames not in the pinned stock axis: "
+                f"{list(unknown[:5])}{'...' if unknown.size > 5 else ''} — "
+                "a pinned (checkpoint-aligned) densification cannot admit "
+                "new stocks")
     if industry_codes is None:
         industry_codes = np.sort(df["industry"].unique())
     industry_codes = np.asarray(industry_codes)

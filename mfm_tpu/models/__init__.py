@@ -2,16 +2,29 @@
 (Newey-West, eigenfactor risk adjustment, volatility-regime adjustment,
 bias statistics, Bayesian shrinkage)."""
 
-from mfm_tpu.models.newey_west import newey_west, newey_west_expanding
+from mfm_tpu.models.newey_west import (
+    newey_west,
+    newey_west_expanding,
+    newey_west_expanding_resume,
+)
 from mfm_tpu.models.eigen import eigen_risk_adjust, eigen_risk_adjust_by_time
-from mfm_tpu.models.vol_regime import vol_regime_adjust_by_time
+from mfm_tpu.models.vol_regime import (
+    vol_regime_adjust_by_time,
+    vol_regime_adjust_resume,
+)
 from mfm_tpu.models.bias import eigenfactor_bias_stat, bayes_shrink
 from mfm_tpu.models.specific import ewma_specific_vol, specific_risk_by_time
-from mfm_tpu.models.risk_model import RiskModel, RiskModelOutputs
+from mfm_tpu.models.risk_model import (
+    RiskModel,
+    RiskModelOutputs,
+    RiskModelState,
+)
 
 __all__ = [
     "newey_west",
     "newey_west_expanding",
+    "newey_west_expanding_resume",
+    "vol_regime_adjust_resume",
     "eigen_risk_adjust",
     "eigen_risk_adjust_by_time",
     "vol_regime_adjust_by_time",
@@ -21,4 +34,5 @@ __all__ = [
     "specific_risk_by_time",
     "RiskModel",
     "RiskModelOutputs",
+    "RiskModelState",
 ]

@@ -160,6 +160,7 @@ def eigen_risk_adjust_by_time(
     sim_sweeps: int | None = None,
     sim_length: int | None = None,
     chunk: int | None = None,
+    batch_hint: int | None = None,
 ):
     """Batched adjustment over the date axis.
 
@@ -195,14 +196,21 @@ def eigen_risk_adjust_by_time(
     sim eighs over (chunk, M, K, K) slabs and accumulates only the (T, K)
     bias ratios.  ``None`` (or chunk >= T) keeps the single full batch.
     The per-date math is identical either way (same op sequence per slab,
-    and ``batch_hint`` pins the solver dispatch to the full T*M batch size
+    and the solver-dispatch batch is pinned to the full T*M batch size
     regardless of chunking), so chunked == unchunked exactly on the XLA
     path.  Use :func:`auto_eigen_chunk` to size it from live memory.
+
+    ``batch_hint`` overrides that dispatch pin (default T*M): the
+    incremental update path passes the INIT-time T*M so a one-date slab
+    dispatches its sim eighs exactly like the full history it extends —
+    slab-invariant the same way the chunk stream is chunk-invariant.
     """
     dtype = covs.dtype
     T = covs.shape[0]
     K = covs.shape[-1]
     M = sim_covs.shape[0]
+    if batch_hint is None:
+        batch_hint = T * M
     if sim_sweeps is None and sim_length is not None:
         sim_sweeps = sim_sweeps_for(K, dtype, sim_length)
     eye = jnp.eye(K, dtype=dtype)
@@ -230,7 +238,7 @@ def eigen_risk_adjust_by_time(
         G = s_c[:, None, :, None] * sim_covs[None] * s_c[:, None, None, :]
         Dm, Dm_hat = batched_eigh_weighted_diag(
             G, d0_c[:, None, :], prefer_pallas=prefer_pallas,
-            sweeps=sim_sweeps, batch_hint=T * M)
+            sweeps=sim_sweeps, batch_hint=batch_hint)
         # rank pairing, order-invariant across backends: i-th smallest sim
         # eigenvalue pairs with the i-th smallest D0 (D0 is already
         # ascending).  One variadic key-value sort: ~3x cheaper on TPU than

@@ -45,6 +45,27 @@ def newey_west(ret: jax.Array, q: int = 2, half_life: float = 252.0) -> jax.Arra
     return V
 
 
+def nw_init_carry(K: int, q: int, dtype) -> tuple:
+    """The scan state of :func:`newey_west_expanding_resume` before any date:
+    ``(t, S, A, Z, Ps, hs, gs, Slags, xlags)`` at t = 0.  This tuple IS the
+    resumable checkpoint of the expanding estimator — every sum it holds is
+    exact, so resuming from it reproduces the uninterrupted scan bitwise.
+    """
+    zK = jnp.zeros((K,), dtype)
+    zKK = jnp.zeros((K, K), dtype)
+    return (
+        jnp.asarray(0, jnp.int32),
+        zK,
+        zKK,
+        jnp.asarray(0.0, dtype),
+        tuple(zKK for _ in range(q)),
+        tuple(zK for _ in range(q)),
+        tuple(jnp.asarray(0.0, dtype) for _ in range(q)),
+        tuple(zK for _ in range(q)),
+        tuple(zK for _ in range(q)),
+    )
+
+
 @highest_matmul_precision
 def newey_west_expanding(
     ret: jax.Array, q: int = 2, half_life: float = 252.0,
@@ -78,6 +99,34 @@ def newey_west_expanding(
         return newey_west_expanding_associative(ret, q, half_life, min_valid)
     if method != "scan":
         raise ValueError(f"method must be 'scan' or 'associative', got {method!r}")
+    covs, valid, _ = newey_west_expanding_resume(ret, q, half_life, min_valid)
+    return covs, valid
+
+
+@highest_matmul_precision
+def newey_west_expanding_resume(
+    ret: jax.Array, q: int = 2, half_life: float = 252.0,
+    min_valid: int | None = None, carry: tuple | None = None,
+    dyn_length: jax.Array | None = None,
+):
+    """The "scan" method of :func:`newey_west_expanding`, checkpointable.
+
+    Returns ``(covs, valid, carry_out)``.  ``carry`` resumes the expanding
+    scan from a previous call's ``carry_out`` (default: the t = 0 state,
+    :func:`nw_init_carry`): because the carry holds the exact EWMA sums of
+    the recursion, running dates ``[0:T0]`` and then ``[T0:T]`` from the
+    returned carry produces bitwise the same covariances as one
+    uninterrupted pass — the incremental daily-update path of
+    :meth:`mfm_tpu.models.risk_model.RiskModel.update`.  ``q``,
+    ``half_life`` and ``min_valid`` must match across resumed calls (the
+    carry is only meaningful under the same recursion constants).
+
+    ``dyn_length`` (a traced s32 scalar equal to T) makes the loop bound
+    dynamic: XLA's while-loop simplifier inlines trip-count-1 loops into
+    the surrounding program, whose different fusion shifts the step math by
+    an ulp — a dynamic bound keeps the body its own computation at any T,
+    so a one-date update executes bitwise the same step as a long history.
+    """
     T, K = ret.shape
     dtype = ret.dtype
     lam = jnp.asarray(0.5, dtype) ** (1.0 / half_life)
@@ -118,19 +167,7 @@ def newey_west_expanding(
                      tuple(gs_new), Slags_new, xlags_new)
         return new_carry, (V, valid)
 
-    zK = jnp.zeros((K,), dtype)
-    zKK = jnp.zeros((K, K), dtype)
-    init = (
-        jnp.asarray(0, jnp.int32),
-        zK,
-        zKK,
-        jnp.asarray(0.0, dtype),
-        tuple(zKK for _ in range(q)),
-        tuple(zK for _ in range(q)),
-        tuple(jnp.asarray(0.0, dtype) for _ in range(q)),
-        tuple(zK for _ in range(q)),
-        tuple(zK for _ in range(q)),
-    )
+    init = nw_init_carry(K, q, dtype) if carry is None else carry
     # the serial recursion gains nothing from a sharded date axis (use the
     # associative method for that); pin its input and stacked outputs
     # replicated per the layout doctrine
@@ -150,11 +187,13 @@ def newey_west_expanding(
         valid_acc = jax.lax.dynamic_update_index_in_dim(valid_acc, v_ok, i, 0)
         return carry, covs_acc, valid_acc
 
-    _, covs, valid = jax.lax.fori_loop(
-        jnp.int32(0), jnp.int32(T), body,
+    hi = jnp.int32(T) if dyn_length is None else dyn_length.astype(jnp.int32)
+    carry_out, covs, valid = jax.lax.fori_loop(
+        jnp.int32(0), hi, body,
         (init, jnp.zeros((T, K, K), dtype), jnp.zeros((T,), bool)),
     )
-    return replicate_under_mesh((covs, valid))
+    covs, valid = replicate_under_mesh((covs, valid))
+    return covs, valid, replicate_under_mesh(carry_out)
 
 
 def newey_west_expanding_associative(
@@ -181,7 +220,10 @@ def newey_west_expanding_associative(
     dtype = ret.dtype
     lam = jnp.asarray(0.5, dtype) ** (1.0 / half_life)
     kmin = K if min_valid is None else min_valid
-    tgrid = jnp.arange(1, T + 1)
+    # s32, not the x64-default s64: the spmd partitioner's shard-offset math
+    # around a sharded date axis is s32, and mixed-width compares trip the
+    # HLO verifier — same hardening as the serial scans' fori_loop counters
+    tgrid = jnp.arange(1, T + 1, dtype=jnp.int32)
 
     def shift_rows(x, l):
         if l == 0:

@@ -34,8 +34,14 @@ from mfm_tpu.models.eigen import (
     eigen_risk_adjust_by_time,
     simulated_eigen_covs,
 )
-from mfm_tpu.models.newey_west import newey_west_expanding
-from mfm_tpu.models.vol_regime import vol_regime_adjust_by_time
+from mfm_tpu.models.newey_west import (
+    newey_west_expanding,
+    newey_west_expanding_resume,
+)
+from mfm_tpu.models.vol_regime import (
+    vol_regime_adjust_by_time,
+    vol_regime_adjust_resume,
+)
 from mfm_tpu.models.bias import eigenfactor_bias_stat
 from mfm_tpu.ops.xreg import regress_panel
 
@@ -50,6 +56,64 @@ class RiskModelOutputs(NamedTuple):
     eigen_valid: jax.Array       # (T,)
     vr_cov: jax.Array            # (T, K, K)
     lamb: jax.Array              # (T,) volatility multiplier series
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class RiskModelState:
+    """The resumable checkpoint of the whole risk stack at some date T0.
+
+    Holds the exact scan intermediates of the two recursive stages — the
+    Newey-West EWMA carry (``nw_init_carry``'s ``(t, S, A, Z, Ps, hs, gs,
+    Slags, xlags)`` tuple) and the vol-regime ``(num, den)`` EWMA sums —
+    plus the frozen eigen Monte-Carlo inputs (``sim_covs`` and its declared
+    ``sim_length``) so the simulated-covariance draw stays pinned as T grows
+    past init, and a config/shape identity stamp so a checkpoint refuses to
+    resume under a model that would silently change the math.  Because the
+    carries are exact, :meth:`RiskModel.update` from this state is bitwise
+    equal to the corresponding suffix of a full-history run.
+
+    Registered as a pytree: the array state (carries + sim_covs) flattens
+    into children, everything identity-like rides in static aux_data — so
+    ``jax.tree_util.tree_map`` copies work and jit cache keys stay stable.
+
+    ``eigen_batch_hint`` pins the simulated-eigh solver dispatch to the
+    init-time ``T * M`` batch (the "solver dispatch pinned at init"
+    doctrine): a one-date slab dispatches exactly like the history it
+    extends, and the hint never changes across updates so the update step
+    never retraces.  The bitwise contract is stated for the default solver
+    dispatch (``MFM_EIGH_CPU_JACOBI_BATCH`` unset); forcing a batch
+    threshold between slab and history sizes would flip the solver the way
+    it already does for the chunked stream.
+    """
+
+    nw_carry: tuple
+    vr_num: jax.Array
+    vr_den: jax.Array
+    sim_covs: jax.Array
+    sim_length: int | None
+    eigen_batch_hint: int
+    stamp: tuple
+    last_date: str | None = None
+
+    def tree_flatten(self):
+        children = (self.nw_carry, self.vr_num, self.vr_den, self.sim_covs)
+        aux = (self.sim_length, self.eigen_batch_hint, self.stamp,
+               self.last_date)
+        return children, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        nw_carry, vr_num, vr_den, sim_covs = children
+        sim_length, eigen_batch_hint, stamp, last_date = aux
+        return cls(nw_carry, vr_num, vr_den, sim_covs,
+                   sim_length=sim_length, eigen_batch_hint=eigen_batch_hint,
+                   stamp=stamp, last_date=last_date)
+
+    @property
+    def t(self) -> int:
+        """Number of dates folded into the state so far."""
+        return int(self.nw_carry[0])
 
 
 @dataclasses.dataclass
@@ -100,7 +164,7 @@ class RiskModel:
 
     # -- stage 3 -----------------------------------------------------------
     def eigen_risk_adj_by_time(self, nw_cov, nw_valid, key=None, sim_covs=None,
-                               sim_length=None):
+                               sim_length=None, batch_hint=None):
         # ``sim_length`` lets callers that inject sim_covs declare the draw
         # count behind them, enabling the production auto-sweep path (e.g.
         # tools/tpu_parity.py).  Undeclared (None) means full sweep count.
@@ -124,6 +188,7 @@ class RiskModel:
             sim_sweeps=sweeps, sim_length=sim_len,
             chunk=self._resolve_eigen_chunk(sim_covs.shape[0],
                                             nw_cov.dtype.itemsize),
+            batch_hint=batch_hint,
         )
 
     def _resolve_eigen_chunk(self, n_sims: int, itemsize: int) -> int | None:
@@ -195,6 +260,158 @@ class RiskModel:
                 sim_length=sim_len,
             )
 
+    # -- incremental daily-update path --------------------------------------
+    def _run_carried(self, sim_covs, sim_length, nw_carry=None, vr_carry=None,
+                     eigen_batch_hint=None, dyn_length=None):
+        """:meth:`run` with resumable scans: same four stages, but Newey-West
+        and vol-regime run through their ``*_resume`` forms so the exact EWMA
+        carries come out alongside the outputs.  With ``None`` carries this
+        IS the full-history run (the resume forms default to the empty-history
+        state); with carries from a previous call it continues that history,
+        bitwise."""
+        if self.T == 1:
+            # XLA collapses a unit date batch into a different (gemv)
+            # lowering of the residual matvec — 1 ulp off the batched
+            # program (any batch >= 2 matches the full history per-date).
+            # Duplicate the date and keep lane 0: vmapped lanes are
+            # independent, so this pins the batched lowering exactly.
+            dup = lambda a: jnp.concatenate([a, a], axis=0)
+            res = regress_panel(
+                dup(self.ret), dup(self.cap), dup(self.styles),
+                dup(self.industry), dup(self.valid),
+                n_industries=self.n_industries,
+            )
+            factor_ret, specific_ret, r2 = (
+                res.factor_ret[:1], res.specific_ret[:1], res.r2[:1])
+        else:
+            factor_ret, specific_ret, r2 = self.reg_by_time()
+        nw_cov, nw_valid, nw_carry_out = newey_west_expanding_resume(
+            factor_ret, q=self.config.nw_lags,
+            half_life=self.config.nw_half_life, min_valid=self.K,
+            carry=nw_carry, dyn_length=dyn_length,
+        )
+        if self.T == 1:
+            # same unit-batch pinning as the regression above, for the
+            # per-date eigen MC
+            eigen_cov, eigen_valid = self.eigen_risk_adj_by_time(
+                jnp.concatenate([nw_cov, nw_cov], axis=0),
+                jnp.concatenate([nw_valid, nw_valid], axis=0),
+                sim_covs=sim_covs, sim_length=sim_length,
+                batch_hint=eigen_batch_hint,
+            )
+            eigen_cov, eigen_valid = eigen_cov[:1], eigen_valid[:1]
+        else:
+            eigen_cov, eigen_valid = self.eigen_risk_adj_by_time(
+                nw_cov, nw_valid, sim_covs=sim_covs, sim_length=sim_length,
+                batch_hint=eigen_batch_hint,
+            )
+        vr_cov, lamb, vr_carry_out = vol_regime_adjust_resume(
+            factor_ret, eigen_cov, eigen_valid,
+            half_life=self.config.vol_regime_half_life, carry=vr_carry,
+            dyn_length=dyn_length,
+        )
+        outputs = RiskModelOutputs(
+            factor_ret, specific_ret, r2,
+            nw_cov, nw_valid, eigen_cov, eigen_valid, vr_cov, lamb,
+        )
+        return outputs, nw_carry_out, vr_carry_out
+
+    def _stamp(self) -> tuple:
+        """Identity of (shape, dtype, math config) a checkpoint must match."""
+        return (self.n_industries, self.Q, self.N, str(self.ret.dtype),
+                self.config.identity())
+
+    def _require_scan_method(self, what: str):
+        if self.config.nw_method != "scan":
+            raise ValueError(
+                f"{what} requires nw_method='scan' (the associative form has "
+                f"no resumable carry); got {self.config.nw_method!r}"
+            )
+
+    def init_state(self, key=None, sim_covs=None, sim_length=None,
+                   last_date: str | None = None):
+        """Full-history run that also returns the resumable checkpoint.
+
+        Returns ``(outputs, state)``: ``outputs`` is the same
+        :class:`RiskModelOutputs` as :meth:`run_fused` (one fused, donated
+        XLA program — treat the call as consuming the model's panels), and
+        ``state`` is the :class:`RiskModelState` from which
+        :meth:`update` appends further dates in O(1) per date.
+        """
+        self._require_scan_method("init_state")
+        sim_len = sim_length
+        if sim_covs is None:
+            if key is None:
+                key = jax.random.key(self.config.seed)
+            sim_len = self.config.eigen_sim_length or self.T
+            sim_covs = simulated_eigen_covs(
+                key, self.K, sim_len, self.config.eigen_n_sims,
+                dtype=self.ret.dtype,
+            )
+        hint = self.T * int(sim_covs.shape[0])
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message=".*[Dd]onat.*", category=UserWarning)
+            outputs, nw_carry, (vr_num, vr_den) = _fused_init_step(
+                self.ret, self.cap, self.styles, self.industry, self.valid,
+                sim_covs, n_industries=self.n_industries, config=self.config,
+                sim_length=sim_len, eigen_batch_hint=hint,
+            )
+        state = RiskModelState(
+            nw_carry, vr_num, vr_den, sim_covs,
+            sim_length=sim_len, eigen_batch_hint=hint,
+            stamp=self._stamp(), last_date=last_date,
+        )
+        return outputs, state
+
+    def update(self, state: RiskModelState, last_date: str | None = None):
+        """Append this model's panel — the new date(s) only — to ``state``.
+
+        The instance's ``(T, N)`` panels are the appended slab (one date or
+        several); ``state`` is the checkpoint from :meth:`init_state` or a
+        previous :meth:`update`.  Returns ``(outputs, new_state)`` where
+        ``outputs`` covers only the slab dates.  One jitted step, panels and
+        carries donated — the passed ``state``'s carry buffers may be
+        invalidated on device backends; use the returned state.
+
+        Because the carries are the exact scan intermediates and the eigen
+        MC is per-date given the frozen ``sim_covs``, the outputs are
+        **bitwise equal** to the corresponding suffix of a full-history run
+        over the concatenated panel (tests/test_risk_state.py).  Cost is
+        O(slab), independent of the history length already folded in.
+        """
+        self._require_scan_method("update")
+        expect = self._stamp()
+        if state.stamp != expect:
+            raise ValueError(
+                f"RiskModelState stamp mismatch: checkpoint carries "
+                f"{state.stamp}, this model is {expect} — refusing to resume "
+                f"under different shapes/dtype/math config"
+            )
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message=".*[Dd]onat.*", category=UserWarning)
+            outputs, nw_carry, (vr_num, vr_den) = _fused_update_step(
+                self.ret, self.cap, self.styles, self.industry, self.valid,
+                state.sim_covs, state.nw_carry, state.vr_num, state.vr_den,
+                jnp.asarray(self.T, jnp.int32),
+                n_industries=self.n_industries, config=self.config,
+                sim_length=state.sim_length,
+                eigen_batch_hint=state.eigen_batch_hint,
+            )
+        new_state = RiskModelState(
+            nw_carry, vr_num, vr_den, state.sim_covs,
+            sim_length=state.sim_length,
+            eigen_batch_hint=state.eigen_batch_hint,
+            stamp=state.stamp,
+            last_date=state.last_date if last_date is None else last_date,
+        )
+        return outputs, new_state
+
     def bias_stat(self, covs, valid, factor_ret, predlen: int = 1):
         """Eigenfactor bias statistic (``MFM.py:203-204``)."""
         return eigenfactor_bias_stat(covs, valid, factor_ret, predlen)
@@ -225,3 +442,45 @@ def _fused_risk_step(ret, cap, styles, industry, valid, sim_covs, *,
     m = RiskModel(ret, cap, styles, industry, valid,
                   n_industries=n_industries, config=config)
     return m.run(sim_covs=sim_covs, sim_length=sim_length)
+
+
+# the incremental path's two steps.  Same donation story as the fused step;
+# ``eigen_batch_hint`` is static because it gates solver dispatch
+# (ops/eigh.py) — it is frozen in the state at init, so the update step
+# compiles once per slab shape and never retraces as the history grows.
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_industries", "config", "sim_length",
+                     "eigen_batch_hint"),
+    donate_argnums=(0, 1, 2, 3, 4),
+)
+def _fused_init_step(ret, cap, styles, industry, valid, sim_covs, *,
+                     n_industries, config, sim_length, eigen_batch_hint):
+    m = RiskModel(ret, cap, styles, industry, valid,
+                  n_industries=n_industries, config=config)
+    return m._run_carried(sim_covs, sim_length,
+                          eigen_batch_hint=eigen_batch_hint)
+
+
+# carries are donated too (argnums 6-8): XLA retires the old state's buffers
+# straight into the new state's.  sim_covs (argnum 5) is NOT donated — the
+# host keeps the reference and threads it unchanged into every next update.
+# ``t_count`` (== T, the slab length) is a DEVICE operand, not static: its
+# only job is to make the scan trip counts dynamic so XLA cannot inline a
+# one-date loop body into the surrounding program (see
+# newey_west_expanding_resume's dyn_length).
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_industries", "config", "sim_length",
+                     "eigen_batch_hint"),
+    donate_argnums=(0, 1, 2, 3, 4, 6, 7, 8),
+)
+def _fused_update_step(ret, cap, styles, industry, valid, sim_covs,
+                       nw_carry, vr_num, vr_den, t_count, *,
+                       n_industries, config, sim_length, eigen_batch_hint):
+    m = RiskModel(ret, cap, styles, industry, valid,
+                  n_industries=n_industries, config=config)
+    return m._run_carried(sim_covs, sim_length,
+                          nw_carry=nw_carry, vr_carry=(vr_num, vr_den),
+                          eigen_batch_hint=eigen_batch_hint,
+                          dyn_length=t_count)

@@ -20,6 +20,13 @@ import jax
 import jax.numpy as jnp
 
 
+def vr_init_carry(dtype) -> tuple:
+    """The ``(num, den)`` EWMA state of the restricted renormalized weighted
+    sum before any date — the resumable checkpoint of this stage (both sums
+    are exact, so resuming reproduces the uninterrupted scan bitwise)."""
+    return (jnp.asarray(0.0, dtype), jnp.asarray(0.0, dtype))
+
+
 def vol_regime_adjust_by_time(
     factor_ret: jax.Array,
     covs: jax.Array,
@@ -32,6 +39,30 @@ def vol_regime_adjust_by_time(
       valid: (T,) validity of each covariance.
 
     Returns (adjusted_covs (T,K,K), lamb (T,)).
+    """
+    adj, lamb, _ = vol_regime_adjust_resume(factor_ret, covs, valid, half_life)
+    return adj, lamb
+
+
+def vol_regime_adjust_resume(
+    factor_ret: jax.Array,
+    covs: jax.Array,
+    valid: jax.Array,
+    half_life: float = 42.0,
+    carry: tuple | None = None,
+    dyn_length: jax.Array | None = None,
+):
+    """:func:`vol_regime_adjust_by_time`, checkpointable.
+
+    Returns ``(adjusted_covs, lamb, carry_out)``; ``carry`` resumes the
+    ``(num, den)`` EWMA recursion from a previous call's ``carry_out``
+    (default: the empty-history state, :func:`vr_init_carry`).  Because the
+    carry holds the exact scan sums, dates ``[0:T0]`` then ``[T0:T]`` from
+    the returned carry match one uninterrupted pass bitwise — the
+    incremental daily-update path.  ``half_life`` must match across resumed
+    calls.  ``dyn_length`` (traced s32 scalar == T) keeps the loop bound
+    dynamic so XLA cannot inline a trip-count-1 loop into the surrounding
+    program and shift the step math by an ulp (see newey_west.py).
     """
     dtype = factor_ret.dtype
     lam = jnp.asarray(0.5, dtype) ** (1.0 / half_life)
@@ -63,11 +94,12 @@ def vol_regime_adjust_by_time(
         val = jnp.where(den > 0, num / den, 0.0)
         return num, den, jax.lax.dynamic_update_index_in_dim(out, val, i, 0)
 
-    _, _, fvm2 = jax.lax.fori_loop(
-        jnp.int32(0), jnp.int32(T), body,
-        (jnp.asarray(0.0, dtype), jnp.asarray(0.0, dtype),
-         jnp.zeros((T,), dtype)),
+    num0, den0 = vr_init_carry(dtype) if carry is None else carry
+    hi = jnp.int32(T) if dyn_length is None else dyn_length.astype(jnp.int32)
+    num, den, fvm2 = jax.lax.fori_loop(
+        jnp.int32(0), hi, body,
+        (num0, den0, jnp.zeros((T,), dtype)),
     )
     fvm2 = replicate_under_mesh(fvm2)
     lamb = jnp.sqrt(fvm2)
-    return covs * fvm2[:, None, None], lamb
+    return covs * fvm2[:, None, None], lamb, replicate_under_mesh((num, den))

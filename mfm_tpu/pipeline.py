@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from mfm_tpu.config import PipelineConfig
 from mfm_tpu.data.barra import BarraArrays, barra_frame_to_arrays
 from mfm_tpu.factors.engine import FactorEngine, rowspace_index, gather_rows, scatter_rows
-from mfm_tpu.models.risk_model import RiskModel, RiskModelOutputs
+from mfm_tpu.models.risk_model import RiskModel, RiskModelOutputs, RiskModelState
 
 try:
     import pandas as pd
@@ -129,6 +129,11 @@ class RiskPipelineResult:
     #: rehydrated from artifacts (:func:`load_risk_pipeline_result`) — every
     #: result method works off outputs+arrays alone
     model: RiskModel | None = None
+    #: the resumable scan state after the last date, when the run was asked
+    #: for one (``run_risk_pipeline(with_state=True)`` or
+    #: :func:`append_risk_pipeline`); persist with
+    #: :func:`save_pipeline_state` to serve future dates in O(1) each
+    state: RiskModelState | None = None
     #: (half_life, ngroup, q, min_periods) -> (T, N) shrunk specific vol
     _spec_cache: dict = dataclasses.field(default_factory=dict, repr=False)
 
@@ -323,6 +328,7 @@ def run_risk_pipeline(
     sim_covs=None,
     sim_length: int | None = None,
     fused: bool = True,
+    with_state: bool = False,
 ) -> RiskPipelineResult:
     """Barra table -> full risk model (the ``demo.py`` path).
 
@@ -337,6 +343,10 @@ def run_risk_pipeline(
     fresh per-call copies, so donation costs callers nothing.  ``False``
     keeps the stage-by-stage dispatch (e.g. to inspect intermediates under
     a debugger).
+
+    ``with_state`` runs :meth:`RiskModel.init_state` instead (same fused
+    math, also returns the final scan carries) and sets ``result.state`` —
+    the checkpoint :func:`append_risk_pipeline` serves new dates from.
     """
     config = config or PipelineConfig()
     if arrays is None:
@@ -348,9 +358,90 @@ def run_risk_pipeline(
         jnp.asarray(arrays.valid), n_industries=arrays.n_industries,
         config=config.risk, factor_names=arrays.factor_names(),
     )
+    if with_state:
+        out, state = rm.init_state(
+            sim_covs=sim_covs, sim_length=sim_length,
+            last_date=date_stamp(arrays.dates[-1]))
+        return RiskPipelineResult(outputs=out, arrays=arrays, model=rm,
+                                  state=state)
     run = rm.run_fused if fused else rm.run
     out = run(sim_covs=sim_covs, sim_length=sim_length)
     return RiskPipelineResult(outputs=out, arrays=arrays, model=rm)
+
+
+def save_pipeline_state(path: str, result: RiskPipelineResult):
+    """Persist ``result.state`` with the alignment metadata an append in a
+    later process needs: the stock axis, style order, industry code list and
+    dtype the checkpoint's arrays were built against.  The append path pins
+    its slab densification to these, so row/column alignment is identical
+    to the run that produced the checkpoint."""
+    from mfm_tpu.data.artifacts import save_risk_state
+
+    if result.state is None:
+        raise ValueError("result has no state — run the pipeline with "
+                         "with_state=True (or append_risk_pipeline)")
+    a = result.arrays
+    save_risk_state(path, result.state, meta={
+        "stocks": np.asarray(a.stocks).astype(str).tolist(),
+        "style_names": list(map(str, a.style_names)),
+        "industry_codes": np.asarray(a.industry_codes).tolist(),
+        "dtype": str(np.asarray(result.outputs.factor_ret).dtype),
+        "n_dates": int(len(a.dates)),
+        "first_date": date_stamp(a.dates[0]),
+    })
+
+
+def append_risk_pipeline(
+    state_path: str,
+    barra_df,
+    config: PipelineConfig | None = None,
+) -> RiskPipelineResult:
+    """Serve the new date(s) of a barra table from a saved checkpoint.
+
+    Rehydrates the :func:`save_pipeline_state` artifact, selects the rows of
+    ``barra_df`` strictly after the checkpoint's last date, densifies them
+    pinned to the checkpoint's stock/style/industry axes, and runs ONE
+    O(slab) :meth:`RiskModel.update` step — no recompute of the history.
+    Returns a result covering only the appended dates, with ``result.state``
+    advanced past them (save it back with :func:`save_pipeline_state` to
+    continue tomorrow).  Outputs are bitwise what a full-history rerun would
+    produce for those dates.  Raises when the table holds no new dates.
+    """
+    from mfm_tpu.data.artifacts import load_risk_state
+
+    config = config or PipelineConfig()
+    state, meta = load_risk_state(state_path)
+    arrays = barra_frame_to_arrays(
+        barra_df,
+        industry_codes=np.asarray(meta["industry_codes"]),
+        style_names=list(meta["style_names"]),
+        stocks=np.asarray(meta["stocks"]),
+    )
+    last = state.last_date
+    keep = np.array([last is None or date_stamp(d) > last
+                     for d in arrays.dates], bool)
+    if not keep.any():
+        raise ValueError(
+            f"{state_path}: checkpoint already covers every date in the "
+            f"table (last_date={last!r})")
+    sl = arrays
+    slab = BarraArrays(
+        dates=sl.dates[keep], stocks=sl.stocks,
+        ret=sl.ret[keep], cap=sl.cap[keep], styles=sl.styles[keep],
+        industry=sl.industry[keep], valid=sl.valid[keep],
+        industry_codes=sl.industry_codes, style_names=sl.style_names,
+    )
+    dtype = jnp.float64 if config.dtype == "float64" else jnp.float32
+    rm = RiskModel(
+        jnp.asarray(slab.ret, dtype), jnp.asarray(slab.cap, dtype),
+        jnp.asarray(slab.styles, dtype), jnp.asarray(slab.industry),
+        jnp.asarray(slab.valid), n_industries=slab.n_industries,
+        config=config.risk, factor_names=slab.factor_names(),
+    )
+    outputs, new_state = rm.update(state,
+                                   last_date=date_stamp(slab.dates[-1]))
+    return RiskPipelineResult(outputs=outputs, arrays=slab, model=rm,
+                              state=new_state)
 
 
 def date_stamp(d) -> str:

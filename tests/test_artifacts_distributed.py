@@ -23,6 +23,22 @@ def test_artifact_roundtrip(tmp_path):
     assert meta["stage"] == "nw_cov" and meta["format"] == 1
 
 
+def test_save_artifact_failure_leaves_no_tmp_file(tmp_path, monkeypatch):
+    """A write that dies mid-savez must clean up its .tmp.npz and re-raise —
+    stray temp files confuse globbing consumers and retention scripts."""
+    import mfm_tpu.data.artifacts as art
+
+    def exploding_savez(tmp, **payload):
+        open(tmp, "wb").write(b"partial")  # half-written temp, then failure
+        raise OSError("disk full")
+
+    monkeypatch.setattr(art.np, "savez_compressed", exploding_savez)
+    p = str(tmp_path / "stage.npz")
+    with pytest.raises(OSError, match="disk full"):
+        save_artifact(p, {"a": np.ones(3)})
+    assert list(tmp_path.iterdir()) == []  # no stage.npz, no stage.npz.tmp.npz
+
+
 def test_risk_outputs_roundtrip(tmp_path):
     from mfm_tpu.config import RiskModelConfig
     from mfm_tpu.models.risk_model import RiskModel

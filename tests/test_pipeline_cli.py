@@ -346,3 +346,103 @@ def test_pipeline_alpha_styles_flag(store_dir, tmp_path, capsys):
         cli_main(["pipeline", "--store", store_dir, "--out", out,
                   "--eigen-sims", "4", "--start", "20200101",
                   "--resume", "--alphas", str(tmp_path / "nope.txt")])
+
+
+def test_pipeline_append_subprocess_matches_from_scratch(store_dir, tmp_path,
+                                                         capsys):
+    """The acceptance round trip: init a pipeline up to a cut date, append
+    the remaining store dates from a SEPARATE process (state rehydrated from
+    risk_state.npz only), and land bitwise on the from-scratch full run —
+    all five result tables, risk_outputs.npz, and the advanced checkpoint.
+    --eigen-sim-length is pinned so runs of different history lengths draw
+    the same Monte-Carlo sims (the default draw length is T)."""
+    import subprocess
+    import sys
+
+    import mfm_tpu
+    from mfm_tpu.data.artifacts import load_artifact
+
+    prices = PanelStore(store_dir).read("daily_prices")
+    counts = prices.groupby("trade_date")["ts_code"].nunique()
+    dates = sorted(counts.index)
+    # a revision-free cut: every stock trades on it, so no t+1 return label
+    # straddles the boundary (see _check_append_prefix_unrevised)
+    full_days = [d for d in dates[-12:-4]
+                 if counts[d] == prices["ts_code"].nunique()]
+    assert full_days, "store has no full-universe date near the end"
+    cut = pd.Timestamp(full_days[-1]).strftime("%Y%m%d")
+    common = ["--eigen-sims", "8", "--eigen-sim-length", "50",
+              "--start", "20200101"]
+
+    out = str(tmp_path / "out")
+    cli_main(["pipeline", "--store", store_dir, "--out", out,
+              *common, "--end", cut])
+    capsys.readouterr()
+    assert os.path.exists(os.path.join(out, "risk_state.npz"))
+
+    # conftest's XLA_FLAGS (8 virtual devices) rides along via os.environ;
+    # x64 it sets through jax.config, so mirror it explicitly
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(
+        mfm_tpu.__file__)))
+    env = {**os.environ, "PYTHONPATH": repo_root, "JAX_PLATFORMS": "cpu",
+           "JAX_ENABLE_X64": "1"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "mfm_tpu.cli", "pipeline", "--store",
+         store_dir, "--out", out, *common, "--append"],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert len(rec["appended_dates"]) >= 4
+    assert rec["update_wall_s"] > 0
+
+    ref = str(tmp_path / "ref")
+    cli_main(["pipeline", "--store", store_dir, "--out", ref, *common])
+    capsys.readouterr()
+
+    for name in RESULT_TABLES:
+        a = pd.read_csv(os.path.join(ref, name), index_col=0)
+        b = pd.read_csv(os.path.join(out, name), index_col=0)
+        pd.testing.assert_frame_equal(a, b, check_exact=True, obj=name)
+    xa, _ = load_artifact(os.path.join(ref, "risk_outputs.npz"))
+    xb, _ = load_artifact(os.path.join(out, "risk_outputs.npz"))
+    for k in xa:
+        np.testing.assert_array_equal(xa[k], xb[k], err_msg=k)
+    sa, ma = load_artifact(os.path.join(ref, "risk_state.npz"))
+    sb, mb = load_artifact(os.path.join(out, "risk_state.npz"))
+    for k in sa:
+        np.testing.assert_array_equal(sa[k], sb[k], err_msg=k)
+    assert ma["last_date"] == mb["last_date"]
+
+    # the checkpoint advanced past every store date, so appending again has
+    # nothing to do — that is an error, not a silent no-op
+    with pytest.raises(SystemExit, match="already covers every date"):
+        cli_main(["pipeline", "--store", store_dir, "--out", out,
+                  *common, "--append"])
+    capsys.readouterr()
+
+
+def test_pipeline_append_refuses_revised_history(store_dir, tmp_path,
+                                                 capsys):
+    """Cut at a date where some stock is suspended: the from-scratch rerun
+    fills that stock's t+1 return label in across the gap (next-traded-day
+    semantics), revising a prefix row the checkpoint already served.  The
+    append path must detect that and refuse, not silently diverge from a
+    full-history run."""
+    prices = PanelStore(store_dir).read("daily_prices")
+    counts = prices.groupby("trade_date")["ts_code"].nunique()
+    dates = sorted(counts.index)
+    gap_days = [d for d in dates[-8:-3]
+                if counts[d] < prices["ts_code"].nunique()]
+    assert gap_days, "store has no suspension near the end"
+    cut = pd.Timestamp(gap_days[-1]).strftime("%Y%m%d")
+    common = ["--eigen-sims", "8", "--eigen-sim-length", "50",
+              "--start", "20200101"]
+
+    out = str(tmp_path / "out")
+    cli_main(["pipeline", "--store", store_dir, "--out", out,
+              *common, "--end", cut])
+    capsys.readouterr()
+    with pytest.raises(SystemExit, match="revised history"):
+        cli_main(["pipeline", "--store", store_dir, "--out", out,
+                  *common, "--append"])
+    capsys.readouterr()

@@ -1,0 +1,206 @@
+"""The resumable risk-model state (incremental daily-update path).
+
+``RiskModel.init_state`` / ``RiskModel.update`` must continue the
+full-history run BITWISE — ``assert_array_equal``, not a tolerance — across
+warmup boundaries (t <= q, t <= K), single-date appends, multi-date slabs,
+the npz checkpoint round trip, and appended dates whose Newey-West
+covariance is non-PSD (the eigen-invalid path).  The final scan carries must
+agree bitwise too, so a resumed history can keep resuming forever.
+
+Donation discipline throughout: ``init_state``/``update`` donate their panel
+inputs and (for update) the state carries, so every call gets fresh arrays
+and states are copied before reuse.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mfm_tpu.config import RiskModelConfig
+from mfm_tpu.data.artifacts import load_risk_state, save_risk_state
+from mfm_tpu.models.risk_model import RiskModel
+
+T, N, P, Q = 48, 24, 4, 3
+K = 1 + P + Q
+CFG = RiskModelConfig(eigen_n_sims=8, eigen_sim_length=48)
+
+
+def _panels(seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.normal(0, 0.02, (T, N)),
+        rng.lognormal(10, 1, (T, N)),
+        rng.normal(size=(T, N, Q)),
+        rng.integers(0, P, (T, N)),
+        rng.random((T, N)) > 0.05,
+    )
+
+
+def _model(panels, sl=slice(None), cfg=CFG):
+    # fresh device arrays per call: init_state/update donate their inputs
+    return RiskModel(*(jnp.asarray(np.asarray(p)[sl]) for p in panels),
+                     n_industries=P, config=cfg)
+
+
+def _copy(state):
+    return jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), state)
+
+
+def _carries(state):
+    return jax.tree_util.tree_leaves(
+        (state.nw_carry, state.vr_num, state.vr_den))
+
+
+def _assert_outputs_equal(got, want, msg):
+    for i, name in enumerate(want._fields):
+        np.testing.assert_array_equal(np.asarray(got[i]),
+                                      np.asarray(want[i]),
+                                      err_msg=f"{msg}: {name}")
+
+
+def _assert_carries_equal(a, b, msg):
+    for x, y in zip(_carries(a), _carries(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=msg)
+
+
+@pytest.fixture(scope="module")
+def panels():
+    return _panels()
+
+
+@pytest.fixture(scope="module")
+def full(panels):
+    """Full-history reference: outputs + final state from one init_state."""
+    return _model(panels).init_state()
+
+
+# T0 = 1, 2 sit inside the q-lag warmup (q = 2); 5 inside the t <= K
+# invalid region (K = 8); 20/40 are plain mid-history cuts
+@pytest.mark.parametrize("T0", [1, 2, 5, 20, 40])
+def test_update_is_bitwise_suffix_of_full_run(panels, full, T0):
+    full_out, full_state = full
+    out0, st = _model(panels, slice(0, T0)).init_state()
+    _assert_outputs_equal(
+        out0, jax.tree_util.tree_map(lambda x: x[:T0], full_out),
+        f"T0={T0} prefix")
+
+    # one date at a time, the daily serving loop
+    st_seq = _copy(st)
+    rows = []
+    for t in range(T0, T):
+        o, st_seq = _model(panels, slice(t, t + 1)).update(st_seq)
+        rows.append(o)
+    got = type(full_out)(*[
+        np.concatenate([np.asarray(r[i]) for r in rows], axis=0)
+        for i in range(len(full_out))])
+    _assert_outputs_equal(
+        got, jax.tree_util.tree_map(lambda x: x[T0:], full_out),
+        f"T0={T0} sequential suffix")
+
+    # the whole remainder as ONE slab
+    o_slab, st_slab = _model(panels, slice(T0, T)).update(st)
+    _assert_outputs_equal(
+        o_slab, jax.tree_util.tree_map(lambda x: x[T0:], full_out),
+        f"T0={T0} slab suffix")
+
+    # N single-date appends, one slab, and the uninterrupted run all land
+    # on the SAME carry — resumability is closed under composition
+    _assert_carries_equal(st_seq, st_slab, f"T0={T0} seq-vs-slab carry")
+    _assert_carries_equal(st_slab, full_state, f"T0={T0} slab-vs-full carry")
+
+
+def test_state_npz_roundtrip_is_bitwise(panels, full, tmp_path):
+    """A checkpoint written to disk and rehydrated in (what could be) a new
+    process must continue exactly like the in-process state object."""
+    full_out, _ = full
+    T0 = 20
+    _, st = _model(panels, slice(0, T0)).init_state()
+    p = str(tmp_path / "state.npz")
+    save_risk_state(p, _copy(st), meta={"note": "test"})
+    loaded, meta = load_risk_state(p)
+    assert meta["note"] == "test" and meta["kind"] == "risk_state"
+    # identity must survive JSON (tuple-ness restored for the == check)
+    assert loaded.stamp == st.stamp
+    assert loaded.sim_length == st.sim_length
+    assert loaded.eigen_batch_hint == st.eigen_batch_hint
+    np.testing.assert_array_equal(np.asarray(loaded.sim_covs),
+                                  np.asarray(st.sim_covs))
+    _assert_carries_equal(loaded, st, "roundtrip carry")
+
+    o_mem, _ = _model(panels, slice(T0, T)).update(st)
+    o_disk, _ = _model(panels, slice(T0, T)).update(loaded)
+    _assert_outputs_equal(o_disk, o_mem, "disk-vs-memory update")
+    _assert_outputs_equal(
+        o_disk, jax.tree_util.tree_map(lambda x: x[T0:], full_out),
+        "disk update vs full run")
+
+
+def test_appended_date_with_non_psd_nw_cov(tmp_path):
+    """An appended date whose Newey-West covariance has a negative
+    eigenvalue takes the eigen-invalid path (nw_valid & ~eigen_valid,
+    vr_cov NaN) — and stays bitwise the full run, including the dates
+    around it.  A short NW half-life concentrates the EWMA on ~3 effective
+    samples against K=8 factors + 2 lag corrections, which is indefinite
+    at several dates (verified below, not assumed)."""
+    cfg = RiskModelConfig(eigen_n_sims=8, eigen_sim_length=48,
+                          nw_half_life=3.0)
+    panels = _panels(seed=2)
+    full_out, full_state = _model(panels, cfg=cfg).init_state()
+    nwv = np.asarray(full_out.nw_valid)
+    egv = np.asarray(full_out.eigen_valid)
+
+    T0 = 30
+    bad = np.nonzero(nwv[T0:] & ~egv[T0:])[0]
+    assert bad.size, "panel no longer exercises the non-PSD path"
+    assert egv[T0:].any(), "need valid dates around the invalid one"
+    t_bad = T0 + bad[0]
+    assert np.isnan(np.asarray(full_out.vr_cov)[t_bad]).all()
+
+    _, st = _model(panels, slice(0, T0), cfg=cfg).init_state()
+    o_slab, st_slab = _model(panels, slice(T0, T), cfg=cfg).update(st)
+    _assert_outputs_equal(
+        o_slab, jax.tree_util.tree_map(lambda x: x[T0:], full_out),
+        "slab across a non-PSD date")
+    _assert_carries_equal(st_slab, full_state, "carry across a non-PSD date")
+
+
+def test_update_rejects_mismatched_identity(panels):
+    """A checkpoint from one model identity must not silently continue
+    under another: changed config, changed universe width — both raise."""
+    T0 = 20
+    _, st = _model(panels, slice(0, T0)).init_state()
+
+    other_cfg = RiskModelConfig(eigen_n_sims=8, eigen_sim_length=48,
+                                nw_half_life=99.0)
+    with pytest.raises(ValueError, match="stamp"):
+        _model(panels, slice(T0, T), cfg=other_cfg).update(_copy(st))
+
+    narrow = tuple(np.asarray(p)[:, :-1] for p in _panels())
+    with pytest.raises(ValueError, match="stamp"):
+        _model(narrow, slice(T0, T)).update(_copy(st))
+
+
+def test_state_requires_scan_method(panels):
+    """The resumable carry is the serial scan's; the associative method has
+    no equivalent checkpoint, so both entry points refuse it."""
+    cfg = RiskModelConfig(eigen_n_sims=8, eigen_sim_length=48,
+                          nw_method="associative")
+    with pytest.raises(ValueError, match="scan"):
+        _model(panels, cfg=cfg).init_state()
+
+    _, st = _model(panels, slice(0, 20)).init_state()
+    st = dataclasses_replace_stamp(st, cfg)
+    with pytest.raises(ValueError, match="scan"):
+        _model(panels, slice(20, T), cfg=cfg).update(st)
+
+
+def dataclasses_replace_stamp(st, cfg):
+    """A state whose stamp claims ``cfg``'s identity (so update's method
+    check, not the stamp check, is what fires)."""
+    import dataclasses
+
+    stamp = (st.stamp[0], st.stamp[1], st.stamp[2], st.stamp[3],
+             cfg.identity())
+    return dataclasses.replace(st, stamp=stamp)

@@ -171,6 +171,33 @@ def test_full_pipeline_associative_nw_sharded_matches_scan(arrays):
                                rtol=1e-8, atol=1e-12)
 
 
+def test_newey_west_associative_date_sharded_matches_scan():
+    """The associative NW kernel directly (not through the pipeline) with its
+    (T, K) input sharded across all 8 devices on the date axis.  The
+    associative_scan combine must commute with the spmd partitioner's
+    shard-boundary handling — covs and the validity mask both match the
+    serial scan."""
+    from mfm_tpu.models.newey_west import (
+        newey_west_expanding, newey_west_expanding_associative,
+    )
+
+    rng = np.random.default_rng(4)
+    fr = jnp.asarray(rng.normal(0, 0.01, (64, 9)))
+    covs_ref, valid_ref = newey_west_expanding(fr, q=2, half_life=20.0,
+                                               method="scan")
+
+    mesh = make_mesh(8, 1)
+    fr_sharded = jax.device_put(fr, NamedSharding(mesh, P("date")))
+    with use_mesh(mesh):
+        covs, valid = jax.jit(
+            lambda r: newey_west_expanding_associative(r, q=2, half_life=20.0)
+        )(fr_sharded)
+
+    np.testing.assert_array_equal(np.asarray(valid), np.asarray(valid_ref))
+    np.testing.assert_allclose(np.asarray(covs), np.asarray(covs_ref),
+                               rtol=1e-9, atol=1e-15)
+
+
 def test_rolling_kernel_stock_sharded(arrays):
     rng = np.random.default_rng(0)
     T, N = 80, 64

@@ -6,6 +6,9 @@
 #      re-captures PARITY_TPU.json under the current kernel defaults)
 #   2. bench.py --config alla   (the scan-path all-A number, BASELINE.md row 4)
 #   3. bench.py --config alpha  (config-5 refresh)
+#   4. bench.py --config riskmodel  (daily_update_latency on real hardware —
+#      the CPU-host 242x update-vs-rebuild ratio in docs/QUICKSTART.md wants
+#      a TPU number; the update step is eigen-bound so expect it to widen)
 #
 # Outputs land in OUTDIR (default /tmp/tpu_watch); run `git diff` afterwards —
 # refresh_hardware_evidence.sh edits PARITY_TPU.json in place when gates pass.
@@ -62,6 +65,11 @@ MFM_COMPILATION_CACHE="$fresh_cache" python bench.py --config alpha \
 # kernel A/B queue: v_compose2 promotion decision + NW scan-vs-associative
 python tools/kernel_ab.py > "$out/kernel_ab.log" 2>&1 \
   || echo "kernel_ab FAILED (see kernel_ab.log)" >> "$out/status"
+# incremental update path: daily_update_latency / update_speedup_vs_e2e on
+# real hardware (QUICKSTART's daily-serving table carries the CPU-host number)
+python bench.py --config riskmodel 2> "$out/riskmodel.err" \
+  | tail -1 > "$out/config1_riskmodel_update.json" \
+  || echo "riskmodel update bench FAILED (see riskmodel.err)" >> "$out/status"
 # a capture that fell back to CPU is NOT evidence — flag it
 grep -L '"backend": "tpu"' "$out"/config*.json 2>/dev/null \
   | sed 's/$/: backend is not tpu/' >> "$out/status"
