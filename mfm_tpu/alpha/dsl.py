@@ -93,7 +93,8 @@ def _windows(x, w: int):
     T = x.shape[0]
     pad = jnp.full((w - 1,) + x.shape[1:], jnp.nan, x.dtype)
     xp = jnp.concatenate([pad, x], axis=0)
-    idx = jnp.arange(T)[:, None] + jnp.arange(w)[None, :]
+    idx = (jnp.arange(T, dtype=jnp.int32)[:, None]
+           + jnp.arange(w, dtype=jnp.int32)[None, :])  # R2: explicit s32
     return jnp.take(xp, idx, axis=0)
 
 
@@ -264,7 +265,7 @@ def cs_neutralize(x, g, num_groups: int = 64):
          & (g >= 0) & (g < num_groups))
     gi = jnp.where(m, g, 0).astype(jnp.int32)
     T = x.shape[0]
-    rows = jnp.broadcast_to(jnp.arange(T)[:, None], x.shape)
+    rows = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[:, None], x.shape)
     sums = jnp.zeros((T, num_groups), x.dtype).at[rows, gi].add(
         jnp.where(m, x, 0.0))
     cnts = jnp.zeros((T, num_groups), x.dtype).at[rows, gi].add(

@@ -1076,6 +1076,21 @@ def _etl_missing(args):
     print(json.dumps({"n_missing": len(missing), "missing": missing}))
 
 
+def _lint_cmd(args):
+    # pure-AST pass (mfm_tpu/lint.py): no backend, no numpy — safe to run
+    # anywhere, including a box with a dead TPU tunnel
+    from mfm_tpu.lint import main as lint_main
+
+    lint_argv = list(args.paths)
+    if args.baseline:
+        lint_argv += ["--baseline", args.baseline]
+    if args.strict:
+        lint_argv.append("--strict")
+    if args.json:
+        lint_argv.append("--json")
+    raise SystemExit(lint_main(lint_argv))
+
+
 def main(argv=None):
     # safe pre-pinning: importing the module only loads jax, it does not
     # initialize a backend (the --platform pin below still wins)
@@ -1438,6 +1453,22 @@ def main(argv=None):
     em.add_argument("--calls-per-min", type=int, default=480)
     em.add_argument("--token", default=None)
     em.set_defaults(fn=_etl_missing)
+
+    ln = sub.add_parser(
+        "lint",
+        help="the JAX-doctrine linter (rules R1-R6, docs/DOCTRINE.md) over "
+             "mfm_tpu/, bench.py and tools/")
+    ln.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: mfm_tpu bench.py "
+                         "tools)")
+    ln.add_argument("--baseline", default=None,
+                    help="baseline JSON ('none' disables; default: "
+                         "tools/mfmlint_baseline.json)")
+    ln.add_argument("--strict", action="store_true",
+                    help="also fail on stale baseline entries")
+    ln.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ln.set_defaults(fn=_lint_cmd)
 
     args = ap.parse_args(argv)
     if getattr(args, "select_out", None) and args.select is None:

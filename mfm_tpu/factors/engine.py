@@ -60,7 +60,8 @@ def scatter_rows(f: jax.Array, idx: jax.Array) -> jax.Array:
     T, N = f.shape
     safe = jnp.where(idx >= 0, idx, T)
     out = jnp.full((T + 1, N), jnp.nan, f.dtype)
-    out = out.at[safe, jnp.arange(N)[None, :]].set(jnp.where(idx >= 0, f, jnp.nan))
+    out = out.at[safe, jnp.arange(N, dtype=jnp.int32)[None, :]].set(
+        jnp.where(idx >= 0, f, jnp.nan))
     return out[:T]
 
 

@@ -1,0 +1,997 @@
+"""mfmlint — the repo's JAX doctrine as a static gate.
+
+Every rule here is a bug class this codebase has already paid for (the
+incident record lives in docs/DOCTRINE.md):
+
+  R1  no host-numpy compute inside traced code
+  R2  integer dtypes in traced code must be explicit s32 (arange / iota /
+      astype / fori_loop bounds) — the s64-under-SPMD class that broke
+      tier-1 twice
+  R3  jax.config.update / enable_compilation_cache only in designated
+      entrypoint modules, and never the same key twice per module
+  R4  no use of a donated argument after its donating call
+  R5  perf_counter timing spans around async-dispatch (JAX) work must
+      force results (block_until_ready) inside the span
+  R6  PartitionSpec axis names must come from the mesh doctrine
+      (parallel/mesh.py)
+
+The analysis is a conservative intra-package call graph over the linted
+files: functions reachable from ``jax.jit``/``pjit``/``vmap``/``lax.scan``/
+``lax.fori_loop``/``lax.map``/... call sites count as *traced*; attribute
+calls resolve by bare method name against every known def (over-approximate
+on purpose — a missed edge hides a real s64, a spurious edge costs at most a
+baseline entry).  ``pallas_call`` kernels are deliberately NOT traced roots:
+Mosaic has no 64-bit types at all, so the s64 class cannot arise there and
+the kernels' host-side planners are free to use numpy.
+
+Intentional exceptions live in ``tools/mfmlint_baseline.json`` keyed by
+(file, rule, function) — line-number free so refactors don't churn it.  The
+default run exits non-zero only on NEW violations; ``--strict`` also fails
+on stale baseline entries (grandfathered violations that no longer exist).
+
+This module imports neither jax nor numpy: it is safe to run anywhere,
+including as the first step of TPU capture scripts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import os
+import sys
+from typing import Iterable
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_TARGETS = ("mfm_tpu", "bench.py", "tools")
+DEFAULT_BASELINE = os.path.join("tools", "mfmlint_baseline.json")
+
+RULES = {
+    "R1": "host-numpy compute inside traced code (host sync / tracer "
+          "concretization; use jnp or hoist to the host path)",
+    "R2": "integer dtype in traced code must be explicit s32 — unpinned "
+          "arange/iota/astype/fori_loop bounds canonicalize to s64 under "
+          "x64 and trip XLA's s32 SPMD shard-offset math",
+    "R3": "jax.config.update / compilation-cache setup only in designated "
+          "entrypoint modules, at most once per key per module",
+    "R4": "donated argument used after its donating call (the buffer may "
+          "already be retired into the callee's outputs)",
+    "R5": "perf_counter span times async-dispatch JAX work without forcing "
+          "it (block_until_ready) — the span measures dispatch, not compute",
+    "R6": "PartitionSpec axis name outside the mesh doctrine "
+          "(parallel/mesh.py defines the only legal mesh axes)",
+}
+
+# numpy attributes that are dtype/constant plumbing, not compute — legal
+# anywhere, including traced code
+_NP_ALLOWED = {
+    "dtype", "float16", "float32", "float64", "int8", "int16", "int32",
+    "int64", "uint8", "uint16", "uint32", "uint64", "bool_", "generic",
+    "integer", "floating", "complexfloating", "number", "ndarray",
+    "datetime64", "timedelta64", "finfo", "iinfo", "issubdtype",
+    "result_type", "promote_types", "pi", "e", "inf", "nan", "newaxis",
+    "errstate",
+}
+
+# modules (dotted) allowed to mutate process-global jax config.  tools/ are
+# each their own CLI entrypoint; cli.py and utils/cache.py are the package's
+# designated config owners; bench.py is a standalone entrypoint.
+_R3_ALLOWED_MODULES = ("mfm_tpu.cli", "mfm_tpu.utils.cache", "bench")
+_R3_ALLOWED_PREFIXES = ("tools.",)
+
+_TRACER_JIT = {"jit", "pjit", "vmap", "pmap", "checkpoint", "remat", "grad",
+               "value_and_grad"}
+_TRACER_LAX = {"scan", "fori_loop", "map", "while_loop", "cond", "switch",
+               "associative_scan"}
+
+# calls that force device work to completion on the host (R5)
+_FORCING_NAMES = {"block_until_ready", "force", "_force", "asarray", "array",
+                  "to_numpy", "item", "compile", "memory_analysis"}
+
+_INT64_STRS = {"int64", "long", "i8"}
+
+
+def _attr_chain(node) -> list[str] | None:
+    """a.b.c -> ['a', 'b', 'c']; None when the root isn't a plain Name."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+def _const_str(node) -> str | None:
+    return node.value if (isinstance(node, ast.Constant)
+                          and isinstance(node.value, str)) else None
+
+
+@dataclasses.dataclass
+class Violation:
+    file: str
+    line: int
+    rule: str
+    qualname: str
+    message: str
+
+    def key(self) -> tuple:
+        return (self.file, self.rule, self.qualname)
+
+    def render(self) -> str:
+        return (f"{self.file}:{self.line}: {self.rule} [{self.qualname}] "
+                f"{self.message}\n    doctrine: {RULES[self.rule]}")
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    qualname: str            # module:Outer.inner or module:<lambda@L..>
+    module: str
+    name: str                # bare name ('' for lambdas)
+    node: object             # ast.FunctionDef | ast.Lambda
+    parent: str | None       # enclosing function qualname
+    file: str
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    name: str                # dotted module name
+    file: str                # path as given (repo-relative when possible)
+    tree: object
+    # import alias sets
+    np_aliases: set = dataclasses.field(default_factory=set)
+    jnp_aliases: set = dataclasses.field(default_factory=set)
+    jax_aliases: set = dataclasses.field(default_factory=set)
+    lax_aliases: set = dataclasses.field(default_factory=set)
+    time_aliases: set = dataclasses.field(default_factory=set)
+    functools_aliases: set = dataclasses.field(default_factory=set)
+    partial_aliases: set = dataclasses.field(default_factory=set)
+    pspec_aliases: set = dataclasses.field(default_factory=set)
+    # local name -> (target module, attr) for from-imports
+    from_imports: dict = dataclasses.field(default_factory=dict)
+    # local alias -> dotted module for module imports
+    module_imports: dict = dataclasses.field(default_factory=dict)
+    # names imported directly from jax / jax.lax (e.g. `from jax import vmap`)
+    jax_names: set = dataclasses.field(default_factory=set)
+    lax_names: set = dataclasses.field(default_factory=set)
+    # module-level defs: bare name -> qualname (methods as Class.meth)
+    locals: dict = dataclasses.field(default_factory=dict)
+    # local function names stored as values in module-level dict registries
+    # (e.g. the alpha DSL's _OPS table) — dispatched via subscript calls that
+    # name resolution cannot see
+    registry_names: set = dataclasses.field(default_factory=set)
+
+
+class _Scanner(ast.NodeVisitor):
+    """Collect imports + every function (incl. nested and lambdas)."""
+
+    def __init__(self, mod: ModuleInfo, funcs: dict, bare_index: dict):
+        self.mod = mod
+        self.funcs = funcs
+        self.bare_index = bare_index
+        self.scope: list[str] = []      # class/function name stack
+
+    # -- imports ------------------------------------------------------------
+    def visit_Import(self, node):
+        for a in node.names:
+            alias = a.asname or a.name.split(".")[0]
+            tgt = a.name
+            if tgt == "numpy":
+                self.mod.np_aliases.add(alias)
+            elif tgt == "jax.numpy":
+                self.mod.jnp_aliases.add(a.asname or "jax")
+            elif tgt == "jax":
+                self.mod.jax_aliases.add(alias)
+            elif tgt == "jax.lax":
+                self.mod.lax_aliases.add(a.asname or "jax")
+            elif tgt == "time":
+                self.mod.time_aliases.add(alias)
+            elif tgt == "functools":
+                self.mod.functools_aliases.add(alias)
+            else:
+                self.mod.module_imports[alias] = tgt
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        src = node.module or ""
+        for a in node.names:
+            local = a.asname or a.name
+            if src == "jax" and a.name == "numpy":
+                self.mod.jnp_aliases.add(local)
+            elif src == "jax" and a.name == "lax":
+                self.mod.lax_aliases.add(local)
+            elif src == "jax":
+                self.mod.jax_names.add(local)
+            elif src in ("jax.lax",):
+                self.mod.lax_names.add(local)
+            elif src == "functools" and a.name == "partial":
+                self.mod.partial_aliases.add(local)
+            elif src == "time":
+                self.mod.time_aliases.add(local)
+            elif a.name == "PartitionSpec" or (
+                    src.endswith("sharding") and a.name == "PartitionSpec"):
+                self.mod.pspec_aliases.add(local)
+            else:
+                self.mod.from_imports[local] = (src, a.name)
+        self.generic_visit(node)
+
+    # -- defs ---------------------------------------------------------------
+    def _register(self, name: str, node):
+        qual = f"{self.mod.name}:{'.'.join(self.scope + [name]) or name}"
+        parent = None
+        # nearest enclosing *function* (skip class frames)
+        for i in range(len(self.scope) - 1, -1, -1):
+            cand = f"{self.mod.name}:{'.'.join(self.scope[: i + 1])}"
+            if cand in self.funcs:
+                parent = cand
+                break
+        self.funcs[qual] = FuncInfo(qual, self.mod.name, name, node, parent,
+                                    self.mod.file)
+        if name and not name.startswith("<"):
+            self.bare_index.setdefault(name, []).append(qual)
+        if len(self.scope) == 0 or all(
+                f"{self.mod.name}:{'.'.join(self.scope[:i + 1])}"
+                not in self.funcs for i in range(len(self.scope))):
+            # module-level def or method of a module-level class
+            self.mod.locals.setdefault(name, qual)
+        return qual
+
+    def _visit_func(self, node, name):
+        self._register(name, node)
+        self.scope.append(name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    def visit_FunctionDef(self, node):
+        self._visit_func(node, node.name)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._visit_func(node, node.name)
+
+    def visit_ClassDef(self, node):
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    def visit_Lambda(self, node):
+        self._visit_func(node, f"<lambda@L{node.lineno}>")
+
+    def visit_Assign(self, node):
+        # `phase1 = lambda ...` binds a function to a name: register the
+        # lambda under that name so `jax.vmap(phase1)` resolves to it
+        if isinstance(node.value, ast.Lambda) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            self._visit_func(node.value, node.targets[0].id)
+            return
+        # module-level dict registries: `_OPS = {"delta": delta, ...}`
+        if not self.scope and isinstance(node.value, ast.Dict):
+            for v in node.value.values:
+                if isinstance(v, ast.Name):
+                    self.mod.registry_names.add(v.id)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        # annotated registry: `_OPS: Dict[str, Callable] = {...}`
+        if not self.scope and isinstance(node.value, ast.Dict):
+            for v in node.value.values:
+                if isinstance(v, ast.Name):
+                    self.mod.registry_names.add(v.id)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        # module-level `_OPS.update({"alias": fn, ...})`
+        if not self.scope and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "update":
+            for a in node.args:
+                if isinstance(a, ast.Dict):
+                    for v in a.values:
+                        if isinstance(v, ast.Name):
+                            self.mod.registry_names.add(v.id)
+        self.generic_visit(node)
+
+
+def _own_nodes(func_node) -> Iterable[ast.AST]:
+    """Walk a function's body without descending into nested functions.
+
+    Nested FunctionDef/Lambda nodes are yielded (so call sites can see them
+    as arguments) but their bodies belong to their own FuncInfo.
+    """
+    if isinstance(func_node, ast.Lambda):
+        roots = [func_node.body]
+    else:
+        roots = list(func_node.body)
+    stack = list(roots)
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class Linter:
+    def __init__(self):
+        self.modules: dict[str, ModuleInfo] = {}
+        self.funcs: dict[str, FuncInfo] = {}
+        self.bare_index: dict[str, list[str]] = {}
+        self.edges: dict[str, set[str]] = {}
+        self.traced: set[str] = set()
+        self.jax_touch: set[str] = set()
+        self.donating: dict[str, tuple] = {}   # qualname -> donated positions
+        self.mesh_axes: set[str] = {"date", "stock"}
+        self.violations: list[Violation] = []
+
+    # -- loading ------------------------------------------------------------
+    def add_file(self, path: str, relto: str | None = None):
+        rel = os.path.relpath(path, relto or os.getcwd())
+        modname = rel[:-3].replace(os.sep, ".").lstrip(".")
+        while modname.startswith("."):
+            modname = modname[1:]
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            self.violations.append(Violation(
+                rel, e.lineno or 0, "R1", "<module>",
+                f"syntax error prevents linting: {e.msg}"))
+            return
+        mod = ModuleInfo(modname, rel, tree)
+        self.modules[modname] = mod
+        _Scanner(mod, self.funcs, self.bare_index).visit(tree)
+
+    # -- resolution ---------------------------------------------------------
+    def _resolve_in_module(self, mod: ModuleInfo, name: str) -> list[str]:
+        if name in mod.locals:
+            return [mod.locals[name]]
+        if name in mod.from_imports:
+            src, attr = mod.from_imports[name]
+            tgt = self.modules.get(src)
+            if tgt and attr in tgt.locals:
+                return [tgt.locals[attr]]
+            # from-import of a submodule: `from mfm_tpu import pipeline`
+            sub = self.modules.get(f"{src}.{attr}" if src else attr)
+            if sub:
+                return []  # module object, not a function
+        return []
+
+    def _resolve_call(self, caller: FuncInfo, func_node) -> list[str]:
+        """Call target qualnames for a Call's func expression (conservative)."""
+        mod = self.modules[caller.module]
+        if isinstance(func_node, ast.Name):
+            name = func_node.id
+            # scope chain: nested defs of enclosing functions
+            p = caller.qualname
+            while p is not None:
+                info = self.funcs.get(p)
+                if info is None:
+                    break
+                prefix = p + "."  # children qualnames are parent.child
+                cand = None
+                for q in self.funcs:
+                    if q.startswith(prefix) and q[len(prefix):] == name:
+                        cand = q
+                        break
+                if cand:
+                    return [cand]
+                p = info.parent
+            return self._resolve_in_module(mod, name)
+        chain = _attr_chain(func_node)
+        if not chain:
+            return []
+        root, attr = chain[0], chain[-1]
+        # external API roots: never package calls
+        if root in (mod.np_aliases | mod.jnp_aliases | mod.jax_aliases
+                    | mod.lax_aliases | mod.time_aliases
+                    | mod.functools_aliases):
+            return []
+        if root in mod.module_imports:
+            tgt = self.modules.get(mod.module_imports[root])
+            if tgt:
+                return self._resolve_in_module(tgt, attr)
+            return []
+        if root in mod.from_imports:
+            src, a = mod.from_imports[root]
+            tgt = self.modules.get(f"{src}.{a}" if src else a)
+            if tgt:
+                return self._resolve_in_module(tgt, attr)
+        # bare-name over-approximation: any def in the lint set with this name
+        return list(self.bare_index.get(attr, []))
+
+    # -- classification -----------------------------------------------------
+    def _is_tracer_call(self, mod: ModuleInfo, call: ast.Call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Name):
+            return (f.id in mod.jax_names and f.id in _TRACER_JIT) or \
+                   (f.id in mod.lax_names and f.id in _TRACER_LAX)
+        chain = _attr_chain(f)
+        if not chain:
+            return False
+        root, attr = chain[0], chain[-1]
+        if root in mod.jax_aliases:
+            if "lax" in chain[:-1]:
+                return attr in _TRACER_LAX
+            return attr in _TRACER_JIT
+        if root in mod.lax_aliases and "lax" in chain:
+            return attr in _TRACER_LAX
+        return False
+
+    def _is_jit_expr(self, mod: ModuleInfo, node) -> bool:
+        """jax.jit / jit / pjit as a plain expression (decorator or callee)."""
+        if isinstance(node, ast.Name):
+            return node.id in mod.jax_names and node.id in {"jit", "pjit"}
+        chain = _attr_chain(node)
+        return bool(chain) and chain[0] in mod.jax_aliases and \
+            chain[-1] in {"jit", "pjit"}
+
+    def _func_args_of_call(self, caller: FuncInfo, call: ast.Call):
+        """Function-valued arguments of a tracer call -> qualnames."""
+        out = []
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(arg, ast.Lambda):
+                q = self._lambda_qual(caller, arg)
+                if q:
+                    out.append(q)
+            elif isinstance(arg, (ast.Name, ast.Attribute)):
+                out.extend(self._resolve_call(caller, arg))
+        return out
+
+    def _lambda_qual(self, caller: FuncInfo, node: ast.Lambda) -> str | None:
+        name = f"<lambda@L{node.lineno}>"
+        for q, info in self.funcs.items():
+            if info.node is node:
+                return q
+        # fall back by position
+        cand = f"{caller.qualname.split(':')[0]}:{name}"
+        return cand if cand in self.funcs else None
+
+    def _donate_positions(self, call: ast.Call) -> tuple:
+        for kw in call.keywords:
+            if kw.arg in ("donate_argnums", "donate_argnames"):
+                v = kw.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    return (v.value,)
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    return tuple(e.value for e in v.elts
+                                 if isinstance(e, ast.Constant)
+                                 and isinstance(e.value, int))
+        return ()
+
+    # -- graph construction --------------------------------------------------
+    def build(self):
+        roots: set[str] = set()
+        for qual, info in self.funcs.items():
+            mod = self.modules[info.module]
+            self.edges.setdefault(qual, set())
+            # decorators: @jax.jit / @partial(jax.jit, ...) mark the def as a
+            # traced root; donate_argnums there registers donation positions
+            node = info.node
+            for dec in getattr(node, "decorator_list", []):
+                if self._is_jit_expr(mod, dec):
+                    roots.add(qual)
+                elif isinstance(dec, ast.Call):
+                    dchain = _attr_chain(dec.func) or []
+                    is_partial = (
+                        (dchain and dchain[-1] == "partial"
+                         and (dchain[0] in mod.functools_aliases
+                              or dchain[0] in mod.partial_aliases))
+                        or (isinstance(dec.func, ast.Name)
+                            and dec.func.id in mod.partial_aliases))
+                    if is_partial and dec.args and \
+                            self._is_jit_expr(mod, dec.args[0]):
+                        roots.add(qual)
+                        pos = self._donate_positions(dec)
+                        if pos:
+                            self.donating[qual] = pos
+                    elif self._is_jit_expr(mod, dec.func):
+                        roots.add(qual)
+                        pos = self._donate_positions(dec)
+                        if pos:
+                            self.donating[qual] = pos
+            for n in _own_nodes(node):
+                if not isinstance(n, ast.Call):
+                    continue
+                tgts = self._resolve_call(info, n.func)
+                for tgt in tgts:
+                    self.edges[qual].add(tgt)
+                if tgts:
+                    # higher-order flow: a function-valued argument passed
+                    # to a package function may be called by it (e.g.
+                    # _dispatch_eigh(jacobi_fn=_jacobi)) — assume it is
+                    fargs = self._func_args_of_call(info, n)
+                    if fargs:
+                        for t in tgts:
+                            self.edges.setdefault(t, set()).update(fargs)
+                if self._is_tracer_call(mod, n):
+                    roots.update(self._func_args_of_call(info, n))
+                if self._is_jit_expr(mod, n.func) and n.args:
+                    # jax.jit(fn, ...) call form
+                    tgt_funcs = []
+                    a0 = n.args[0]
+                    if isinstance(a0, ast.Lambda):
+                        q = self._lambda_qual(info, a0)
+                        if q:
+                            tgt_funcs.append(q)
+                    elif isinstance(a0, (ast.Name, ast.Attribute)):
+                        tgt_funcs = self._resolve_call(info, a0)
+                    roots.update(tgt_funcs)
+                    pos = self._donate_positions(n)
+                    for t in tgt_funcs:
+                        if pos:
+                            self.donating[t] = pos
+            # direct jax/jnp/lax usage marks jax_touch seed
+            for n in _own_nodes(node):
+                if isinstance(n, ast.Call):
+                    chain = _attr_chain(n.func)
+                    if chain and chain[0] in (mod.jax_aliases
+                                              | mod.jnp_aliases
+                                              | mod.lax_aliases):
+                        self.jax_touch.add(qual)
+                        break
+                    if isinstance(n.func, ast.Name) and (
+                            n.func.id in mod.jax_names
+                            or n.func.id in mod.lax_names):
+                        self.jax_touch.add(qual)
+                        break
+
+        # traced: forward closure from roots over call edges
+        def propagate(seed):
+            stack = list(seed)
+            while stack:
+                q = stack.pop()
+                if q in self.traced:
+                    continue
+                self.traced.add(q)
+                stack.extend(self.edges.get(q, ()))
+
+        propagate(roots)
+
+        # Indirect-dispatch closure, iterated to a fixpoint:
+        #  (a) a traced function calling through a subscript
+        #      (`_OPS[name](*args)`) can reach any function stored in that
+        #      module's dict registries;
+        #  (b) a traced function calling an unresolvable local variable
+        #      (`e(p)` where e is a closure/callable object) can reach any
+        #      __call__ method defined in the lint set.
+        _BUILTIN_CALLS = {
+            "len", "range", "print", "int", "float", "bool", "str", "tuple",
+            "list", "dict", "set", "frozenset", "min", "max", "abs", "sum",
+            "zip", "enumerate", "sorted", "reversed", "isinstance", "getattr",
+            "setattr", "hasattr", "repr", "type", "id", "map", "filter",
+            "any", "all", "round", "divmod", "slice", "iter", "next", "vars",
+            "open", "format", "hash", "ValueError", "TypeError", "KeyError",
+            "RuntimeError", "AssertionError", "NotImplementedError",
+            "IndexError", "StopIteration", "Exception", "super", "object",
+        }
+        call_methods = {q for q, i in self.funcs.items()
+                        if i.name == "__call__"}
+        for _ in range(4):
+            extra = set()
+            for qual in list(self.traced):
+                info = self.funcs.get(qual)
+                if info is None:
+                    continue
+                mod = self.modules[info.module]
+                for n in _own_nodes(info.node):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    if isinstance(n.func, ast.Subscript) and \
+                            mod.registry_names:
+                        for name in mod.registry_names:
+                            tgt = mod.locals.get(name)
+                            if tgt and tgt not in self.traced:
+                                extra.add(tgt)
+                    elif isinstance(n.func, ast.Name) and \
+                            n.func.id not in _BUILTIN_CALLS and \
+                            n.func.id not in (mod.jax_names | mod.lax_names
+                                              | mod.partial_aliases) and \
+                            not self._resolve_call(info, n.func):
+                        extra.update(call_methods - self.traced)
+            if not extra:
+                break
+            propagate(extra)
+
+        # jax_touch: F touches jax if it calls a toucher (fixpoint)
+        changed = True
+        while changed:
+            changed = False
+            for q, outs in self.edges.items():
+                if q not in self.jax_touch and outs & self.jax_touch:
+                    self.jax_touch.add(q)
+                    changed = True
+
+        # mesh doctrine axes from parallel/mesh.py when present
+        for mod in self.modules.values():
+            if not mod.name.endswith("parallel.mesh"):
+                continue
+            for n in ast.walk(mod.tree):
+                if isinstance(n, ast.Call):
+                    chain = _attr_chain(n.func) or []
+                    name = (n.func.id if isinstance(n.func, ast.Name)
+                            else (chain[-1] if chain else ""))
+                    if name == "Mesh" and len(n.args) >= 2 and \
+                            isinstance(n.args[1], (ast.Tuple, ast.List)):
+                        axes = {_const_str(e) for e in n.args[1].elts}
+                        axes.discard(None)
+                        if axes:
+                            self.mesh_axes = axes
+
+    # -- rules ---------------------------------------------------------------
+    def _emit(self, info: FuncInfo, node, rule: str, msg: str):
+        self.violations.append(Violation(
+            info.file, getattr(node, "lineno", 0), rule,
+            info.qualname.split(":", 1)[1], msg))
+
+    def _int64_dtype_expr(self, mod: ModuleInfo, node) -> bool:
+        if isinstance(node, ast.Name) and node.id == "int":
+            return True
+        s = _const_str(node)
+        if s is not None:
+            return s in _INT64_STRS
+        chain = _attr_chain(node)
+        return bool(chain) and chain[-1] in ("int64", "uint64")
+
+    def _s32_pinned(self, mod: ModuleInfo, node) -> bool:
+        """Expression explicitly pinned to a 32-bit integer."""
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func) or []
+            if chain and chain[-1] in ("int32", "uint32"):
+                return True
+            if chain and chain[-1] == "astype" and node.args:
+                a = node.args[0]
+                achain = _attr_chain(a) or []
+                if achain and achain[-1] in ("int32", "uint32"):
+                    return True
+                if _const_str(a) in ("int32", "uint32"):
+                    return True
+            if chain and chain[-1] in ("asarray", "array"):
+                exprs = list(node.args[1:]) + [kw.value for kw in node.keywords
+                                               if kw.arg == "dtype"]
+                for e in exprs:
+                    ec = _attr_chain(e) or []
+                    if (ec and ec[-1] in ("int32", "uint32")) or \
+                            _const_str(e) in ("int32", "uint32"):
+                        return True
+            return False
+        # trusted: plain names / attributes (runtime values we can't see)
+        return isinstance(node, (ast.Name, ast.Attribute))
+
+    def _check_traced_function(self, info: FuncInfo):
+        mod = self.modules[info.module]
+        for n in _own_nodes(info.node):
+            if not isinstance(n, ast.Call):
+                continue
+            chain = _attr_chain(n.func) or []
+            # R1: numpy compute in traced code
+            if chain and chain[0] in mod.np_aliases and len(chain) > 1:
+                if chain[-1] not in _NP_ALLOWED and "linalg" not in chain:
+                    self._emit(info, n, "R1",
+                               f"np.{'.'.join(chain[1:])}(...) inside traced "
+                               "code")
+                elif "linalg" in chain:
+                    self._emit(info, n, "R1",
+                               f"np.{'.'.join(chain[1:])}(...) inside traced "
+                               "code")
+            if chain:
+                attr = chain[-1]
+            elif isinstance(n.func, ast.Attribute):
+                attr = n.func.attr     # method on a non-Name root,
+            elif isinstance(n.func, ast.Name):  # e.g. x[i].astype(...)
+                attr = n.func.id
+            else:
+                attr = ""
+            is_jnp = bool(chain) and chain[0] in mod.jnp_aliases
+            # R2: arange
+            if attr == "arange" and is_jnp:
+                dt = next((kw.value for kw in n.keywords
+                           if kw.arg == "dtype"), None)
+                if dt is None and len(n.args) >= 4:
+                    dt = n.args[3]
+                if dt is None:
+                    if not any(isinstance(a, ast.Constant)
+                               and isinstance(a.value, float)
+                               for a in n.args):
+                        self._emit(info, n, "R2",
+                                   "integer arange without an explicit "
+                                   "dtype (s64 under x64) — pin "
+                                   "dtype=jnp.int32")
+                elif self._int64_dtype_expr(mod, dt):
+                    self._emit(info, n, "R2",
+                               "arange pinned to a 64-bit integer dtype")
+            # R2: iota
+            if attr in ("iota", "broadcasted_iota") and chain:
+                for a in list(n.args) + [kw.value for kw in n.keywords]:
+                    if self._int64_dtype_expr(mod, a):
+                        self._emit(info, n, "R2",
+                                   f"{attr} with a 64-bit integer dtype")
+            # R2: astype
+            if attr == "astype" and isinstance(n.func, ast.Attribute) \
+                    and n.args and self._int64_dtype_expr(mod, n.args[0]):
+                self._emit(info, n, "R2",
+                           "astype to a 64-bit integer in traced code — "
+                           "use jnp.int32")
+            # R2: fori_loop bounds
+            if attr == "fori_loop" and len(n.args) >= 2:
+                for i, bound in enumerate(n.args[:2]):
+                    if not self._s32_pinned(mod, bound):
+                        which = "lower" if i == 0 else "upper"
+                        self._emit(info, n, "R2",
+                                   f"fori_loop {which} bound is not "
+                                   "explicitly s32 (python ints/expressions "
+                                   "canonicalize the counter to s64 under "
+                                   "x64) — wrap with jnp.int32(...)")
+
+    def _check_r3(self, mod: ModuleInfo):
+        allowed = (mod.name in _R3_ALLOWED_MODULES
+                   or mod.name.startswith(_R3_ALLOWED_PREFIXES)
+                   or mod.name.split(".")[-1] == "conftest")
+        seen_keys: dict[str, int] = {}
+        cache_calls = 0
+        for n in ast.walk(mod.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            chain = _attr_chain(n.func) or []
+            name = (n.func.id if isinstance(n.func, ast.Name)
+                    else (chain[-1] if chain else ""))
+            is_config_update = (len(chain) >= 3 and chain[-2] == "config"
+                                and chain[-1] == "update"
+                                and chain[0] in mod.jax_aliases)
+            is_cache_enable = name in ("enable_compilation_cache",
+                                       "enable_persistent_compilation_cache",
+                                       "initialize_cache")
+            if not (is_config_update or is_cache_enable):
+                continue
+            qual = self._enclosing_qual(mod, n)
+            if not allowed:
+                what = ("jax.config.update" if is_config_update
+                        else name)
+                self.violations.append(Violation(
+                    mod.file, n.lineno, "R3", qual,
+                    f"{what}(...) outside designated entrypoint modules "
+                    f"({', '.join(_R3_ALLOWED_MODULES)}, tools/*)"))
+                continue
+            if is_cache_enable:
+                cache_calls += 1
+                if cache_calls > 1:
+                    self.violations.append(Violation(
+                        mod.file, n.lineno, "R3", qual,
+                        f"duplicate {name}(...) in one module — the second "
+                        "call is dead weight or a conflicting cache dir"))
+            if is_config_update and n.args:
+                key = _const_str(n.args[0])
+                if key is not None:
+                    seen_keys[key] = seen_keys.get(key, 0) + 1
+                    if seen_keys[key] > 1:
+                        self.violations.append(Violation(
+                            mod.file, n.lineno, "R3", qual,
+                            f"jax.config.update({key!r}, ...) repeated in "
+                            "one module — one process path must set a key "
+                            "at most once"))
+
+    def _enclosing_qual(self, mod: ModuleInfo, node) -> str:
+        best, best_span = "<module>", None
+        for q, info in self.funcs.items():
+            if info.module != mod.name:
+                continue
+            fn = info.node
+            end = getattr(fn, "end_lineno", fn.lineno)
+            if fn.lineno <= node.lineno <= end:
+                span = end - fn.lineno
+                if best_span is None or span < best_span:
+                    best, best_span = q.split(":", 1)[1], span
+        return best
+
+    def _check_r4(self, info: FuncInfo):
+        mod = self.modules[info.module]
+        # donating targets callable by bare name from this function
+        for n in _own_nodes(info.node):
+            if not isinstance(n, ast.Call):
+                continue
+            targets = self._resolve_call(info, n.func)
+            donated_pos: tuple = ()
+            for t in targets:
+                if t in self.donating:
+                    donated_pos = self.donating[t]
+                    break
+            if not donated_pos:
+                continue
+            tainted = {n.args[p].id for p in donated_pos
+                       if p < len(n.args) and isinstance(n.args[p], ast.Name)}
+            if not tainted:
+                continue
+            call_line = n.lineno
+            # loads within the call's own (possibly multi-line) span ARE
+            # the donation, not a post-donation use
+            call_end = getattr(n, "end_lineno", n.lineno)
+            for m in _own_nodes(info.node):
+                if isinstance(m, ast.Name) and m.id in tainted:
+                    if isinstance(m.ctx, ast.Store) and \
+                            m.lineno >= call_line:
+                        tainted.discard(m.id)  # rebound: taint cleared
+            for m in _own_nodes(info.node):
+                if isinstance(m, ast.Name) and m.id in tainted and \
+                        isinstance(m.ctx, ast.Load) and m.lineno > call_end:
+                    self._emit(info, m, "R4",
+                               f"'{m.id}' used after being donated at line "
+                               f"{call_line} — its buffer may already be "
+                               "retired into the callee's outputs")
+                    tainted.discard(m.id)
+
+    def _check_r5(self, info: FuncInfo):
+        mod = self.modules[info.module]
+        if not (mod.name == "bench" or mod.name.startswith("tools.")):
+            return
+        pcs, forcing, jaxish = [], [], []
+        for n in _own_nodes(info.node):
+            if not isinstance(n, ast.Call):
+                continue
+            chain = _attr_chain(n.func) or []
+            if isinstance(n.func, ast.Name):
+                name = n.func.id
+            elif isinstance(n.func, ast.Attribute):
+                name = n.func.attr   # covers jnp.sum(x).block_until_ready()
+            else:
+                name = ""
+            if name == "perf_counter" and (not chain
+                                           or chain[0] in mod.time_aliases):
+                pcs.append(n.lineno)
+            elif name in _FORCING_NAMES:
+                forcing.append(n.lineno)
+            else:
+                if chain and chain[0] in (mod.jnp_aliases | mod.jax_aliases
+                                          | mod.lax_aliases):
+                    jaxish.append(n.lineno)
+                else:
+                    for t in self._resolve_call(info, n.func):
+                        if t in self.jax_touch:
+                            jaxish.append(n.lineno)
+                            break
+        if len(pcs) < 2:
+            return
+        lo, hi = min(pcs), max(pcs)
+        spans_jax = [ln for ln in jaxish if lo <= ln <= hi]
+        if spans_jax and not any(lo <= ln <= hi for ln in forcing):
+            self._emit(info, info.node, "R5",
+                       f"perf_counter span (lines {lo}-{hi}) contains JAX "
+                       "dispatch without a block_until_ready/force inside "
+                       "the span")
+
+    def _check_r6(self, mod: ModuleInfo):
+        if not mod.pspec_aliases:
+            return
+        for n in ast.walk(mod.tree):
+            if not (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                    and n.func.id in mod.pspec_aliases):
+                continue
+            for a in n.args:
+                elts = a.elts if isinstance(a, (ast.Tuple, ast.List)) else [a]
+                for e in elts:
+                    s = _const_str(e)
+                    if s is not None and s not in self.mesh_axes:
+                        self.violations.append(Violation(
+                            mod.file, n.lineno, "R6",
+                            self._enclosing_qual(mod, n),
+                            f"PartitionSpec axis {s!r} is not a doctrine "
+                            f"mesh axis {sorted(self.mesh_axes)}"))
+
+    def run_rules(self):
+        for info in self.funcs.values():
+            if info.qualname in self.traced:
+                self._check_traced_function(info)
+            self._check_r4(info)
+            self._check_r5(info)
+        for mod in self.modules.values():
+            self._check_r3(mod)
+            self._check_r6(mod)
+        self.violations.sort(key=lambda v: (v.file, v.line, v.rule))
+
+
+# -- baseline + driver -------------------------------------------------------
+
+def load_baseline(path: str | None) -> list[dict]:
+    if not path or not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+@dataclasses.dataclass
+class LintResult:
+    new: list[Violation]
+    baselined: list[Violation]
+    stale: list[dict]
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+
+def collect_files(paths: Iterable[str], root: str) -> list[str]:
+    out = []
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isdir(full):
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                out.extend(os.path.join(dirpath, f)
+                           for f in sorted(filenames) if f.endswith(".py"))
+        elif full.endswith(".py"):
+            out.append(full)
+    return out
+
+
+def run_lint(paths: Iterable[str], baseline: list[dict] | None = None,
+             root: str | None = None) -> LintResult:
+    """Lint ``paths`` (files or directories) against the doctrine rules.
+
+    ``root`` anchors module-name derivation (defaults to the repo root);
+    ``baseline`` entries are dicts with file/rule/qualname keys.
+    """
+    root = root or REPO_ROOT
+    lint = Linter()
+    for f in collect_files(paths, root):
+        lint.add_file(f, relto=root)
+    lint.build()
+    lint.run_rules()
+    baseline = baseline or []
+    bl_keys = {(b["file"], b["rule"], b["qualname"]) for b in baseline}
+    new = [v for v in lint.violations if v.key() not in bl_keys]
+    old = [v for v in lint.violations if v.key() in bl_keys]
+    hit = {v.key() for v in old}
+    stale = [b for b in baseline
+             if (b["file"], b["rule"], b["qualname"]) not in hit]
+    return LintResult(new, old, stale)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mfmlint",
+        description="AST lint for the repo's JAX doctrine (R1-R6; see "
+                    "docs/DOCTRINE.md)")
+    ap.add_argument("paths", nargs="*", default=list(DEFAULT_TARGETS),
+                    help="files/dirs to lint (default: mfm_tpu bench.py "
+                         "tools)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON of grandfathered violations "
+                         "('none' disables)")
+    ap.add_argument("--strict", action="store_true",
+                    help="also fail on stale baseline entries")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--root", default=REPO_ROOT,
+                    help="root for module-name derivation (default: repo)")
+    args = ap.parse_args(argv)
+
+    bl_path = None if args.baseline.lower() == "none" else (
+        args.baseline if os.path.isabs(args.baseline)
+        else os.path.join(args.root, args.baseline))
+    res = run_lint(args.paths, load_baseline(bl_path), root=args.root)
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [dataclasses.asdict(v) for v in res.new],
+            "baselined": [dataclasses.asdict(v) for v in res.baselined],
+            "stale": res.stale,
+        }, indent=1))
+    else:
+        for v in res.new:
+            print(v.render())
+        for b in res.stale:
+            print(f"STALE baseline entry: {b['file']} {b['rule']} "
+                  f"[{b['qualname']}] — the violation no longer exists; "
+                  "remove it")
+        print(f"mfmlint: {len(res.new)} new violation(s), "
+              f"{len(res.baselined)} baselined, {len(res.stale)} stale "
+              "baseline entr(ies)")
+    if res.new:
+        return 1
+    if args.strict and res.stale:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

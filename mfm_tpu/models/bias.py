@@ -265,7 +265,8 @@ def bayes_shrink(
         frac = (pos - lo).astype(dtype)
         qs = s[lo] * (1.0 - frac) + s[hi] * frac
     group = jnp.searchsorted(qs, capital, side="left")  # (N,) in [0, ngroup)
-    oh = (group[:, None] == jnp.arange(ngroup)[None, :]).astype(dtype)  # (N, G)
+    oh = (group[:, None]
+          == jnp.arange(ngroup, dtype=jnp.int32)[None, :]).astype(dtype)  # (N, G)
     oh = oh * mf[:, None]
     cap_g = oh.T @ capital
     cnt_g = jnp.sum(oh, axis=0)

@@ -26,6 +26,7 @@ magnitude component positive) makes results reproducible across backends.
 
 from __future__ import annotations
 
+import math
 import os
 
 import numpy as np
@@ -147,7 +148,10 @@ def jacobi_eigh(A: jax.Array, sweeps: int | None = None,
         V = jnp.take(V, pi, axis=-1)
         return A, V
 
-    A, V = jax.lax.fori_loop(0, sweeps * (n - 1), round_step, (A, V))
+    # R2: explicit s32 bounds — python ints would canonicalize the loop
+    # counter to s64 under x64 (same class as the vol_regime/newey_west fix)
+    A, V = jax.lax.fori_loop(
+        jnp.int32(0), jnp.int32(sweeps * (n - 1)), round_step, (A, V))
 
     w = jnp.diagonal(A, axis1=-2, axis2=-1)
     order = jnp.argsort(w, axis=-1)
@@ -316,8 +320,10 @@ def _dispatch_eigh(operands: tuple, prefer_pallas, pallas_fn, xla_fn,
     if jacobi_fn is not None:
         if cpu_jacobi is None:
             thr = cpu_jacobi_batch_threshold()
-            batch = batch_hint if batch_hint is not None else int(
-                np.prod(operands[0].shape[:-2], dtype=np.int64))
+            # R1: math.prod on the static shape tuple, not np.prod — this
+            # runs at trace time inside a traced dispatch path
+            batch = batch_hint if batch_hint is not None else math.prod(
+                operands[0].shape[:-2])
             cpu_jacobi = thr is not None and batch >= thr
         if cpu_jacobi:
             default_fn = jacobi_fn

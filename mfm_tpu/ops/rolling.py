@@ -96,8 +96,9 @@ def rolling_reduce(
         )
         for x in inputs
     ]
-    starts = jnp.arange(nb) * block
-    offs = jnp.arange(block)[:, None] + jnp.arange(window)[None, :]  # (B, W)
+    starts = jnp.arange(nb, dtype=jnp.int32) * block  # R2: explicit s32
+    offs = (jnp.arange(block, dtype=jnp.int32)[:, None]
+            + jnp.arange(window, dtype=jnp.int32)[None, :])  # (B, W)
 
     def one_block(t0):
         idx = t0 + offs  # (B, W) into padded rows; window ends at date t0+b

@@ -55,7 +55,8 @@ def _constraint_matrix(ind_cap: jax.Array, Q: int) -> jax.Array:
     R = jnp.eye(K, dtype=ind_cap.dtype)
     row = jnp.zeros((K,), ind_cap.dtype).at[1 : 1 + P].set(-ind_cap / ind_cap[-1])
     R = R.at[P].set(row)
-    keep = jnp.concatenate([jnp.arange(P), jnp.arange(P + 1, K)])
+    keep = jnp.concatenate([jnp.arange(P, dtype=jnp.int32),
+                            jnp.arange(P + 1, K, dtype=jnp.int32)])
     return R[:, keep]  # static-shape column delete
 
 
@@ -94,7 +95,8 @@ def regression_design(
     capz = jnp.where(valid, cap, 0.0)
     country = vf[:, None]
     if P:
-        ind_oh = (industry[:, None] == jnp.arange(P)[None, :]).astype(dtype) \
+        ind_oh = (industry[:, None]
+                  == jnp.arange(P, dtype=jnp.int32)[None, :]).astype(dtype) \
             * vf[:, None]
         X = jnp.concatenate([country, ind_oh, s], axis=1)  # (N, K)
     else:

@@ -1,0 +1,83 @@
+"""Runtime contracts: compile-count and tracer-leak guards for tests.
+
+The static half of the doctrine lives in ``mfm_tpu/lint.py``; this module
+covers what AST analysis cannot see — whether a jitted step *actually*
+retraces at runtime.  The incremental-serving win (daily ``update()`` at
+~0.08 s vs ~19 s e2e) only holds while the state pytree keeps stable
+shapes/dtypes, so tests pin the step to exactly one compilation:
+
+    with assert_max_compiles(1):
+        for day in days:
+            state = model.update(state, panel_for(day))
+
+Counting uses JAX's monitoring events rather than wrapping ``jit``: the
+``/jax/core/compile/jaxpr_to_mlir_module_duration`` event fires once per
+lowering, *including* when the persistent compilation cache satisfies the
+backend compile — a cache hit is still a retrace and still a bug for these
+contracts.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+# One lowering per distinct (function, shape/dtype signature): the right
+# proxy for "did this step retrace".  backend_compile events would undercount
+# under a warm persistent cache.
+_COMPILE_EVENT = "/jax/core/compile/jaxpr_to_mlir_module_duration"
+
+
+class CompileCounter:
+    """Live count of jit lowerings observed while registered."""
+
+    def __init__(self):
+        self.count = 0
+        self.events: list[str] = []
+
+    def __call__(self, event: str, duration: float, **kwargs) -> None:
+        if event == _COMPILE_EVENT:
+            self.count += 1
+            self.events.append(event)
+
+
+@contextlib.contextmanager
+def count_compiles():
+    """Yield a :class:`CompileCounter` tracking lowerings inside the block."""
+    from jax._src import monitoring
+
+    counter = CompileCounter()
+    monitoring.register_event_duration_secs_listener(counter)
+    try:
+        yield counter
+    finally:
+        unregister = getattr(
+            monitoring, "_unregister_event_duration_listener_by_callback",
+            None)
+        if unregister is not None:
+            unregister(counter)
+
+
+@contextlib.contextmanager
+def assert_max_compiles(n: int, what: str = ""):
+    """Fail if the block triggers more than ``n`` jit lowerings.
+
+    Use after a warmup call when asserting steady-state behaviour (eager ops
+    on first-seen shapes also lower tiny programs, which count).
+    """
+    with count_compiles() as counter:
+        yield counter
+    if counter.count > n:
+        label = f" in {what}" if what else ""
+        raise AssertionError(
+            f"expected at most {n} compilation(s){label}, observed "
+            f"{counter.count} — a traced step is being retraced "
+            "(shape/dtype drift in its inputs or state pytree?)")
+
+
+@contextlib.contextmanager
+def no_tracer_leaks():
+    """Fail on tracers escaping their trace (wraps jax.checking_leaks)."""
+    with jax.checking_leaks():
+        yield

@@ -7,7 +7,7 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
 
-from collective_audit import build_report  # noqa: E402
+from collective_audit import audit_hlo, build_report, check_invariants  # noqa: E402
 
 
 def test_collective_doctrine_holds_on_virtual_mesh():
@@ -21,3 +21,34 @@ def test_collective_doctrine_holds_on_virtual_mesh():
     assert entry["rolling_is_communication_free"]
     assert entry["no_full_panel_collective"]
     assert rep["invariants_hold"]
+
+    # the factored invariant check reproduces the report's verdict from the
+    # raw stage audits — the importable path tests gate on
+    inv = check_invariants(
+        entry["regression"], entry["full_pipeline"], entry["rolling_beta"],
+        panel_bytes=rep["panel_bytes"],
+        eigh_gather_budget=entry["eigh_gather_budget_bytes"])
+    assert inv["ok"]
+    assert inv["rolling_is_communication_free"] \
+        == entry["rolling_is_communication_free"]
+    assert inv["no_full_panel_collective"] == entry["no_full_panel_collective"]
+    assert inv["regression_is_reduce_only"] \
+        == entry["regression_is_reduce_only"]
+
+
+def test_check_invariants_rejects_panel_sized_collective():
+    # a synthetic HLO with one panel-sized all-gather must fail the gate
+    clean = audit_hlo("")
+    bad = audit_hlo(
+        "%all-gather.1 = f32[64,48]{1,0} all-gather(f32[64,24]{1,0} %p0)")
+    panel_bytes = 64 * 48 * 4
+    inv = check_invariants(bad, clean, clean, panel_bytes=panel_bytes,
+                           eigh_gather_budget=1024)
+    assert not inv["regression_is_reduce_only"]
+    assert not inv["ok"]
+    # and a reduce-only regression with bounded comms passes
+    ok_reg = audit_hlo(
+        "%all-reduce.1 = f32[14,14]{1,0} all-reduce(f32[14,14]{1,0} %p1)")
+    inv2 = check_invariants(ok_reg, clean, clean, panel_bytes=panel_bytes,
+                            eigh_gather_budget=1024)
+    assert inv2["ok"]

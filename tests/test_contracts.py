@@ -1,0 +1,79 @@
+"""Runtime contracts (mfm_tpu/utils/contracts.py).
+
+``assert_max_compiles`` is the dynamic half of the doctrine: the linter
+proves traced code *looks* stable; this proves a jitted step *is* reused.
+The deliberately shape-polymorphic call below is the canonical failure the
+guard exists to catch — each new shape retraces, the serving-latency win
+evaporates, and nothing else in the suite would notice.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mfm_tpu.utils.contracts import (
+    assert_max_compiles,
+    count_compiles,
+    no_tracer_leaks,
+)
+
+
+@jax.jit
+def _double(x):
+    return x * 2.0
+
+
+def test_cached_signature_does_not_count():
+    _double(jnp.ones(3))  # warm
+    with assert_max_compiles(0, what="cache hit"):
+        _double(jnp.ones(3))
+
+
+def test_single_fresh_compile_is_allowed():
+    # input premade: eager array creation lowers tiny programs of its own,
+    # so the guarded region should contain only the step under contract
+    x = jnp.ones(7)
+    with assert_max_compiles(1):
+        _double(x)  # fresh signature: exactly one lowering
+
+
+def test_shape_polymorphic_call_is_caught():
+    with pytest.raises(AssertionError, match="retraced"):
+        with assert_max_compiles(1, what="polymorphic loop"):
+            # one compile per distinct length — the retrace-per-day bug
+            for n in (11, 12, 13):
+                _double(jnp.ones(n))
+
+
+def test_count_compiles_reports_exact_lowerings():
+    x3, x21 = jnp.ones(3), jnp.ones(21)
+    _double(x3)  # warm
+    with count_compiles() as c:
+        _double(x3)   # hit
+        _double(x21)  # miss
+    assert c.count == 1
+
+
+def test_listener_is_unregistered_after_exit():
+    with count_compiles() as c:
+        _double(jnp.ones(31))
+    seen = c.count
+    _double(jnp.ones(41))  # outside the context: must not be counted
+    assert c.count == seen
+
+
+def test_no_tracer_leaks_catches_escape():
+    leaked = []
+
+    def leaky(x):
+        leaked.append(x)
+        return x * 1.0
+
+    with pytest.raises(Exception, match="[Ll]eak"):
+        with no_tracer_leaks():
+            jax.jit(leaky)(jnp.ones(3))
+
+
+def test_no_tracer_leaks_passes_clean_code():
+    with no_tracer_leaks():
+        assert float(jax.jit(lambda x: x + 1.0)(jnp.ones(3)).sum()) == 6.0

@@ -1,0 +1,319 @@
+"""The doctrine linter, gated into tier-1.
+
+Three layers:
+ 1. the real tree lints clean — zero non-baselined violations over
+    ``mfm_tpu bench.py tools`` with the committed baseline (<= 5 entries,
+    none stale), which is what makes every rule here a regression gate;
+ 2. per-rule fixture snippets (positive + negative) pin each rule's
+    semantics, including the conservative call graph (helpers reachable
+    only from un-traced CLI paths are NOT flagged);
+ 3. injection drills on scratch copies of real modules: flipping a real
+    s32 ``fori_loop`` bound back to a python int, or adding a
+    post-donation use to ``risk_model.py``, must make the CLI exit
+    non-zero — proof the gate would have caught the original incidents.
+
+No jax import here: the linter is pure-AST and these tests stay cheap.
+"""
+
+import json
+import shutil
+import textwrap
+from pathlib import Path
+
+from mfm_tpu.lint import (
+    DEFAULT_BASELINE,
+    REPO_ROOT,
+    load_baseline,
+    main,
+    run_lint,
+)
+
+REPO = Path(REPO_ROOT)
+
+
+def _lint(tmp_path, files, baseline=None):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return run_lint([str(tmp_path)], baseline=baseline, root=str(tmp_path))
+
+
+def _rules(res):
+    return sorted({v.rule for v in res.new})
+
+
+# -- layer 1: the real tree ---------------------------------------------------
+
+def test_repo_lints_clean_with_committed_baseline():
+    baseline = load_baseline(str(REPO / DEFAULT_BASELINE))
+    assert len(baseline) <= 5, "baseline creep: justify or fix instead"
+    res = run_lint(["mfm_tpu", "bench.py", "tools"], baseline=baseline)
+    assert not res.new, "\n".join(v.render() for v in res.new)
+    assert not res.stale, f"stale baseline entries: {res.stale}"
+    # the grandfathered host-side planners are still covered (the baseline
+    # is live, not vestigial)
+    assert res.baselined, "baseline matched nothing — regenerate it"
+
+
+# -- layer 2: per-rule fixtures ----------------------------------------------
+
+def test_r1_np_in_traced_flagged_and_callgraph_spares_cli(tmp_path):
+    res = _lint(tmp_path, {"mod.py": """
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        def helper(x):
+            return np.mean(x)          # reachable from the jit below: R1
+
+        def cli_helper(x):
+            return np.median(x)        # only called from main(): clean
+
+        @jax.jit
+        def traced(x):
+            return helper(x) + jnp.sum(x)
+
+        def main(x):
+            return cli_helper(x)
+    """})
+    assert [v.rule for v in res.new] == ["R1"]
+    assert res.new[0].qualname == "helper"
+    assert "np.mean" in res.new[0].message
+
+
+def test_r1_dtype_plumbing_allowed_in_traced(tmp_path):
+    res = _lint(tmp_path, {"mod.py": """
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def traced(x):
+            eps = np.finfo(np.float32).eps
+            return x.astype(np.float32) + eps
+    """})
+    assert not res.new
+
+
+def test_r2_unpinned_arange_and_s64_astype(tmp_path):
+    res = _lint(tmp_path, {"mod.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def bad(x):
+            idx = jnp.arange(x.shape[0])          # R2: unpinned
+            return x[idx].astype(int)             # R2: python int -> s64
+
+        @jax.jit
+        def good(x):
+            idx = jnp.arange(x.shape[0], dtype=jnp.int32)
+            f = jnp.arange(0.0, 1.0, 0.1)         # float arange: fine
+            return x[idx].astype(jnp.int32) + f.sum()
+
+        def host(n):
+            return jnp.arange(n)                  # un-traced: not R2's scope
+    """})
+    assert [v.rule for v in res.new] == ["R2", "R2"]
+    assert all(v.qualname == "bad" for v in res.new)
+
+
+def test_r2_fori_loop_bounds(tmp_path):
+    res = _lint(tmp_path, {"mod.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def bad(x):
+            return jax.lax.fori_loop(0, 7 * 3, lambda i, c: c + i, x)
+
+        @jax.jit
+        def good(x, hi):
+            return jax.lax.fori_loop(jnp.int32(0), hi.astype(jnp.int32),
+                                     lambda i, c: c + i, x)
+    """})
+    assert [v.rule for v in res.new] == ["R2", "R2"]  # both bounds of `bad`
+    assert all(v.qualname == "bad" for v in res.new)
+
+
+def test_r3_config_update_placement_and_duplicates(tmp_path):
+    res = _lint(tmp_path, {
+        "mfm_tpu/deep/worker.py": """
+            import jax
+            jax.config.update("jax_enable_x64", True)   # R3: not entrypoint
+        """,
+        "tools/capture.py": """
+            import jax
+            jax.config.update("jax_platforms", "cpu")    # entrypoint: fine
+            jax.config.update("jax_enable_x64", True)    # distinct key: fine
+            jax.config.update("jax_platforms", "tpu")    # R3: duplicate key
+        """})
+    got = {(v.file.replace("\\", "/"), v.rule) for v in res.new}
+    assert got == {("mfm_tpu/deep/worker.py", "R3"),
+                   ("tools/capture.py", "R3")}
+
+
+def test_r4_use_after_donation(tmp_path):
+    res = _lint(tmp_path, {"mod.py": """
+        from functools import partial
+        import jax
+        import jax.numpy as jnp
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(x, y):
+            return x + y
+
+        def bad(a, b):
+            out = step(a, b)
+            return out + a            # R4: a was donated into step
+
+        def good(a, b):
+            a = step(a, b)            # rebound: the old buffer is gone
+            return a + b
+
+        def also_good(a, b):
+            out = step(a, b)
+            return out + b            # b was not donated
+    """})
+    assert [v.rule for v in res.new] == ["R4"]
+    assert res.new[0].qualname == "bad"
+    assert "'a'" in res.new[0].message
+
+
+def test_r5_unforced_timing_span_in_tools(tmp_path):
+    files = {
+        "tools/bench_like.py": """
+            import time
+            import jax.numpy as jnp
+            import numpy as np
+
+            def unforced(x):
+                t0 = time.perf_counter()
+                y = jnp.sum(x)                    # R5: dispatch, not compute
+                return time.perf_counter() - t0, y
+
+            def forced(x):
+                t0 = time.perf_counter()
+                y = jnp.sum(x).block_until_ready()
+                return time.perf_counter() - t0, y
+
+            def host_golden(x):
+                t0 = time.perf_counter()
+                y = np.sum(x)                     # pure numpy: synchronous
+                return time.perf_counter() - t0, y
+        """,
+        # same unforced span OUTSIDE bench/tools: not R5's scope
+        "mfm_tpu/inner.py": """
+            import time
+            import jax.numpy as jnp
+
+            def unforced(x):
+                t0 = time.perf_counter()
+                y = jnp.sum(x)
+                return time.perf_counter() - t0, y
+        """}
+    res = _lint(tmp_path, files)
+    assert [(v.rule, v.qualname) for v in res.new] == [("R5", "unforced")]
+    assert "bench_like" in res.new[0].file
+
+
+def test_r6_partition_spec_axes(tmp_path):
+    res = _lint(tmp_path, {
+        "parallel/mesh.py": """
+            from jax.sharding import Mesh
+            def make(devs):
+                return Mesh(devs, ("row", "col"))
+        """,
+        "specs.py": """
+            from jax.sharding import PartitionSpec as P
+            GOOD = P("row", None)
+            ALSO = P(("row", "col"))
+            BAD = P("model")           # R6: not a doctrine axis
+        """})
+    assert [v.rule for v in res.new] == ["R6"]
+    assert "'model'" in res.new[0].message
+    assert "row" in res.new[0].message  # the legal axes are named
+
+
+def test_baseline_roundtrip_and_stale_reporting(tmp_path):
+    src = {"mod.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def bad(x):
+            return jnp.arange(x.shape[0])
+    """}
+    dirty = _lint(tmp_path, src)
+    assert len(dirty.new) == 1
+    entry = {"file": dirty.new[0].file, "rule": dirty.new[0].rule,
+             "qualname": dirty.new[0].qualname, "note": "fixture"}
+    clean = run_lint([str(tmp_path)], baseline=[entry], root=str(tmp_path))
+    assert not clean.new and len(clean.baselined) == 1 and not clean.stale
+
+    stale_entry = dict(entry, qualname="no_such_function")
+    res = run_lint([str(tmp_path)], baseline=[entry, stale_entry],
+                   root=str(tmp_path))
+    assert res.stale == [stale_entry]
+
+
+# -- layer 3: injection drills on scratch copies of real modules --------------
+
+def test_injected_s64_fori_bound_fails_cli(tmp_path):
+    """Reverting the real eigh fix (jnp.int32 bounds -> python ints) on a
+    scratch copy of the package must flip the CLI from exit 0 to exit 1.
+
+    The whole package is copied so the conservative call graph still sees
+    ``jacobi_eigh`` as traced-reachable; relative paths match, so the
+    committed baseline applies to the copy unchanged."""
+    shutil.copytree(REPO / "mfm_tpu", tmp_path / "mfm_tpu",
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    args = [str(tmp_path / "mfm_tpu"),
+            "--baseline", str(REPO / DEFAULT_BASELINE),
+            "--root", str(tmp_path)]
+    assert main(args) == 0, "pristine scratch package should lint clean"
+
+    eigh = tmp_path / "mfm_tpu" / "ops" / "eigh.py"
+    src = eigh.read_text()
+    pinned = "jnp.int32(0), jnp.int32(sweeps * (n - 1))"
+    assert pinned in src, "eigh fori bounds changed — update this drill"
+    eigh.write_text(src.replace(pinned, "0, sweeps * (n - 1)"))
+    assert main(args) == 1
+    res = run_lint([str(tmp_path / "mfm_tpu")], root=str(tmp_path))
+    assert any(v.rule == "R2" and "fori_loop" in v.message for v in res.new)
+
+
+def test_injected_post_donation_use_fails_cli(tmp_path):
+    """Adding a use-after-donation to a scratch copy of risk_model.py must
+    exit non-zero (R4) even though the pristine copy lints clean."""
+    real = (REPO / "mfm_tpu" / "models" / "risk_model.py").read_text()
+    scratch = tmp_path / "risk_model.py"
+    scratch.write_text(real)
+    base = run_lint([str(scratch)], root=str(tmp_path))
+    assert not base.new, "pristine scratch copy should lint clean"
+
+    scratch.write_text(real + textwrap.dedent("""
+
+        def _scratch_misuse(ret, cap, styles, industry, valid, sim_covs,
+                            nw_carry, vr_num, vr_den, n_industries, config):
+            out = _fused_update_step(ret, cap, styles, industry, valid,
+                                     sim_covs, nw_carry, vr_num, vr_den,
+                                     n_industries=n_industries, config=config)
+            return out, ret
+    """))
+    rc = main([str(scratch), "--baseline", "none", "--root", str(tmp_path)])
+    assert rc == 1
+    res = run_lint([str(scratch)], root=str(tmp_path))
+    assert [(v.rule, v.qualname) for v in res.new] == [("R4",
+                                                        "_scratch_misuse")]
+
+
+def test_strict_fails_on_stale_baseline(tmp_path):
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps([{"file": "clean.py", "rule": "R1",
+                               "qualname": "ghost", "note": "stale"}]))
+    args = [str(tmp_path), "--baseline", str(bl), "--root", str(tmp_path)]
+    assert main(args) == 0          # default: stale is a warning
+    assert main(args + ["--strict"]) == 1

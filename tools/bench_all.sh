@@ -13,6 +13,12 @@ cd "$(dirname "$0")/.."
 out=${1:-/tmp/bench_all}
 mkdir -p "$out"
 
+# numbers captured from a tree that violates the JAX doctrine (s64 loop
+# counters, unforced timing spans, ...) are not evidence — gate first
+python tools/mfmlint.py --strict \
+  || { echo "mfmlint violations — fix or baseline before benching" >&2
+       exit 1; }
+
 # probe the backend ONCE here: each bench.py run would otherwise repeat its
 # own multi-attempt probe (~6.5 min per config against a dead tunnel);
 # a dead tunnel pins every config straight to the CPU fallback instead

@@ -94,6 +94,39 @@ def audit_hlo(text: str) -> dict:
     }
 
 
+def check_invariants(regression: dict, full_pipeline: dict,
+                     rolling_beta: dict, *, panel_bytes: int,
+                     eigh_gather_budget: int) -> dict:
+    """Evaluate the mesh-layout doctrine on audited stage HLO.
+
+    Takes the :func:`audit_hlo` summaries of the three compiled stages and
+    returns the named structural invariants plus an overall ``ok``.  Pure
+    and importable: tests assert the doctrine in-process on whatever HLO
+    they compiled, no subprocess and no report plumbing.
+
+    One structural exception is carved out explicitly rather than hidden:
+    XLA's eigh (QDWH) is not batch-partitionable on this jaxlib, so the
+    hoisted batched pseudo-inverse/eigen decompositions gather their tiny
+    (T, K, K) matrix batches (plus QDWH's (2K, 2K) workspace) onto every
+    device.  That is a K^2-sized gather of replicated-by-doctrine small
+    matrices, NOT (T, N) panel movement — bound it by ``eigh_gather_budget``
+    and reject anything larger.
+    """
+    inv = {
+        "rolling_is_communication_free": rolling_beta["total"] == 0,
+        "no_full_panel_collective": all(
+            e["largest_bytes"] < max(panel_bytes, eigh_gather_budget)
+            for e in (regression, full_pipeline)),
+        # the regression stage communicates through reductions only, except
+        # the bounded all-gather feeding the batched eigh
+        "regression_is_reduce_only": (
+            set(regression["non_reduce_kinds"]) <= {"all-gather"}
+            and regression["largest_non_reduce_bytes"] <= eigh_gather_budget),
+    }
+    inv["ok"] = all(inv.values())
+    return inv
+
+
 def compiled_text(fn, mesh, arg_specs, *args) -> str:
     shardings = [jax.NamedSharding(mesh, s) for s in arg_specs]
     placed = [jax.device_put(a, s) for a, s in zip(args, shardings)]
@@ -161,30 +194,15 @@ def _build_report(T, N, P, Q, meshes):
         entry["rolling_beta"] = audit_hlo(compiled_text(
             rolling, mesh, [roll_spec, Sp()], ret, mkt))
 
-        # doctrine invariants.  One structural exception is carved out
-        # explicitly rather than hidden: XLA's eigh (QDWH) is not
-        # batch-partitionable on this jaxlib, so the hoisted batched
-        # pseudo-inverse/eigen decompositions gather their tiny (T, K, K)
-        # matrix batches (plus QDWH's (2K, 2K) workspace) onto every device.
-        # That is a K^2-sized gather of replicated-by-doctrine small
-        # matrices, NOT (T, N) panel movement — bound it by the workspace
-        # budget and reject anything larger.
+        # doctrine invariants (see check_invariants for the eigh carve-out)
         eigh_gather_budget = T * (2 * K) * (2 * K) * 8  # f64 upper bound
         entry["eigh_gather_budget_bytes"] = eigh_gather_budget
-        entry["rolling_is_communication_free"] = (
-            entry["rolling_beta"]["total"] == 0)
-        entry["no_full_panel_collective"] = all(
-            e["largest_bytes"] < max(panel_bytes, eigh_gather_budget)
-            for e in (entry["regression"], entry["full_pipeline"]))
-        # the regression stage communicates through reductions only, except
-        # the bounded all-gather feeding the batched eigh
-        reg = entry["regression"]
-        entry["regression_is_reduce_only"] = (
-            set(reg["non_reduce_kinds"]) <= {"all-gather"}
-            and reg["largest_non_reduce_bytes"] <= eigh_gather_budget)
-        ok &= (entry["rolling_is_communication_free"]
-               and entry["no_full_panel_collective"]
-               and entry["regression_is_reduce_only"])
+        inv = check_invariants(
+            entry["regression"], entry["full_pipeline"],
+            entry["rolling_beta"], panel_bytes=panel_bytes,
+            eigh_gather_budget=eigh_gather_budget)
+        entry.update((k, v) for k, v in inv.items() if k != "ok")
+        ok &= inv["ok"]
         report["meshes"][f"{nd}x{ns}"] = entry
     report["invariants_hold"] = ok
     return report

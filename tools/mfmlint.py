@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""Executable wrapper for the doctrine linter (mfm_tpu/lint.py).
+
+Usage:  python tools/mfmlint.py [paths...] [--strict] [--baseline FILE]
+
+Kept as a thin shim so the same pass is importable (`mfm_tpu.lint.run_lint`
+in tests, `mfm-tpu lint` on the CLI) and runnable before any heavyweight
+import: the linter pulls in neither jax nor numpy.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mfm_tpu.lint import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

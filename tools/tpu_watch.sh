@@ -20,6 +20,12 @@ out=${1:-/tmp/tpu_watch}
 max_wait=${2:-28800}
 mkdir -p "$out"
 
+# hardware evidence from a doctrine-violating tree is not evidence — gate
+# before burning hours waiting on the tunnel (no -e here: abort explicitly)
+python tools/mfmlint.py --strict \
+  || { echo "mfmlint violations — fix or baseline before capturing" >&2
+       exit 1; }
+
 start=$(date +%s)
 while true; do
   if timeout 90 python -c \
