@@ -195,6 +195,31 @@ def bench_riskmodel():
 
     upd_s = _time3(update_step)
 
+    # the PRODUCTION serving path is guarded (input guards + degraded-mode
+    # quarantine, serve/guard.py): same single-date append through
+    # update_guarded, so the overhead of health-checking every slab is a
+    # recorded number, not an assumption.  The synthetic panel is clean, so
+    # the observed quarantine_rate doubles as the guards-are-free evidence.
+    import dataclasses as _dcg
+    from mfm_tpu.config import QuarantinePolicy
+    gcfg = _dcg.replace(cfg, quarantine=QuarantinePolicy(enabled=True))
+    rm_gh = RiskModel(*[_prefix(a) for a in args], n_industries=P, config=gcfg)
+    _, gstate0 = rm_gh.init_state(sim_covs=jnp.array(sim_covs, copy=True),
+                                  sim_length=T)
+    quarantined = []
+
+    def guarded_update_step():
+        st = jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True),
+                                    gstate0)
+        fresh = [jnp.array(a[-1:], copy=True) for a in args]
+        m = RiskModel(*fresh, n_industries=P, config=gcfg)
+        out, rep, _ = m.update_guarded(st)
+        quarantined.append(float(np.asarray(rep.quarantined).mean()))
+        return _checksum(out) + jnp.sum(rep.staleness)
+
+    gupd_s = _time3(guarded_update_step)
+    quarantine_rate = float(np.mean(quarantined)) if quarantined else None
+
     # per-stage split (VERDICT r3 weak #4): each stage jitted alone with its
     # real inputs passed as jit ARGUMENTS (closed-over arrays would embed as
     # constants and invite compile-time folding), so drift in any one stage
@@ -304,6 +329,14 @@ def bench_riskmodel():
             "daily_update_latency_s": round(upd_s, 4),
             "update_dates_per_sec": round(1.0 / upd_s),
             "update_speedup_vs_e2e": round(tpu_s / upd_s, 1),
+            # the guarded (production) serving path: input guards +
+            # degraded-mode quarantine run inside the same fused step
+            "guarded_update_latency_s": round(gupd_s, 4),
+            "guard_overhead_frac": round(gupd_s / upd_s - 1.0, 4),
+            # fraction of served dates quarantined during the timed runs —
+            # 0.0 on the clean synthetic panel (guards must cost nothing
+            # and flag nothing when nothing is wrong)
+            "quarantine_rate": quarantine_rate,
             # each stage timed as its OWN jitted program (intermediates
             # materialized at stage boundaries), so the sum exceeds the
             # fused e2e wall above — the gap IS the fusion win, not noise

@@ -116,10 +116,36 @@ def _write_result_tables(res, out: str, specific_risk: bool) -> None:
         shrunk.to_csv(os.path.join(out, "specific_risk.csv"))
 
 
+def _report_json(res) -> dict:
+    """JSON-ready quarantine summary of an append result's GuardReport."""
+    import numpy as np
+    from mfm_tpu.pipeline import date_stamp
+    from mfm_tpu.serve.guard import reason_names
+
+    rep = res.report
+    q = np.asarray(rep.quarantined, bool)
+    reasons = np.asarray(rep.reasons)
+    stale = np.asarray(rep.staleness)
+    # the report covers the appended slab; on the `pipeline --append` path
+    # res.arrays is the full concatenated history, so align from the tail
+    dates = [date_stamp(d) for d in res.arrays.dates[-len(q):]]
+    return {
+        "quarantined": [
+            {"date": dates[i], "reasons": reason_names(int(reasons[i])),
+             "staleness": int(stale[i])}
+            for i in np.nonzero(q)[0]
+        ],
+        "quarantine_count_total": int(np.asarray(
+            res.state.quarantine_count)) if res.state is not None else None,
+    }
+
+
 def _risk(args):
     import numpy as np
     import pandas as pd
-    from mfm_tpu.config import PipelineConfig, RiskModelConfig
+    from mfm_tpu.config import (
+        PipelineConfig, QuarantinePolicy, RiskModelConfig,
+    )
     from mfm_tpu.data.barra import barra_frame_to_arrays
     from mfm_tpu.pipeline import run_risk_pipeline
 
@@ -144,6 +170,7 @@ def _risk(args):
             eigen_chunk=args.eigen_chunk,
             eigen_sim_length=args.eigen_sim_length,
             vol_regime_half_life=args.vr_half_life, seed=args.seed,
+            quarantine=QuarantinePolicy(enabled=args.quarantine),
         ),
         dtype=args.dtype,
     )
@@ -184,11 +211,17 @@ def _risk(args):
             append_risk_pipeline, date_stamp, save_pipeline_state,
         )
 
+        from mfm_tpu.data.artifacts import (
+            ArtifactCorruptError, ArtifactStaleError,
+        )
+
         t0 = time.perf_counter()
         with _profile_ctx(args.profile):
             try:
-                res = append_risk_pipeline(args.update, df, config=cfg)
-            except ValueError as err:
+                res = append_risk_pipeline(args.update, df, config=cfg,
+                                           force=args.force)
+            except (ValueError, ArtifactCorruptError,
+                    ArtifactStaleError) as err:
                 raise SystemExit(f"--update: {err}") from err
         _write_result_tables(res, args.out, args.specific_risk)
         save_pipeline_state(args.update, res)  # advance the checkpoint
@@ -197,14 +230,17 @@ def _risk(args):
             _save_outputs_npz(res, args.out,
                               args.barra or args.barra_store)
         _maybe_portfolio_risk(res, args)
-        print(json.dumps({
+        rec = {
             "appended_dates": [date_stamp(d) for d in res.arrays.dates],
             "stocks": int(res.arrays.ret.shape[1]),
             "factors": len(res.arrays.factor_names()),
             "update_wall_s": round(wall, 3),
             "mean_r2": float(np.nanmean(np.asarray(res.outputs.r2))),
             "state": args.update,
-        }))
+        }
+        if res.report is not None:
+            rec.update(_report_json(res))
+        print(json.dumps(rec))
         return
 
     arrays = barra_frame_to_arrays(df, industry_codes=codes)
@@ -587,10 +623,15 @@ def _pipeline_append_stage(args, barra, cfg, prev_barra):
     _, smeta = load_artifact(state_path)
     _check_append_prefix_unrevised(prev_barra, barra, smeta["last_date"],
                                    cfg.dtype)
+    from mfm_tpu.data.artifacts import (
+        ArtifactCorruptError, ArtifactStaleError,
+    )
+
     t0 = time.perf_counter()
     try:
-        app = append_risk_pipeline(state_path, barra, config=cfg)
-    except ValueError as err:
+        app = append_risk_pipeline(state_path, barra, config=cfg,
+                                   force=args.force)
+    except (ValueError, ArtifactCorruptError, ArtifactStaleError) as err:
         raise SystemExit(f"--append: {err}") from err
     update_wall = time.perf_counter() - t0
     # full-history arrays pinned to the checkpoint's axes, so the
@@ -607,7 +648,8 @@ def _pipeline_append_stage(args, barra, cfg, prev_barra):
     cat = RiskModelOutputs(*[
         np.concatenate([np.asarray(p), np.asarray(n)], axis=0)
         for p, n in zip(prev, app.outputs)])
-    res = RiskPipelineResult(outputs=cat, arrays=full, state=app.state)
+    res = RiskPipelineResult(outputs=cat, arrays=full, state=app.state,
+                             report=app.report)
     return res, [date_stamp(d) for d in app.arrays.dates], update_wall
 
 
@@ -619,7 +661,9 @@ def _pipeline(args):
     O(new-dates) daily refresh."""
     import numpy as np
     import pandas as pd
-    from mfm_tpu.config import PipelineConfig, RiskModelConfig
+    from mfm_tpu.config import (
+        PipelineConfig, QuarantinePolicy, RiskModelConfig,
+    )
     from mfm_tpu.data.etl import PanelStore
     from mfm_tpu.data.prepare import prepare_factor_inputs
     from mfm_tpu.pipeline import run_factor_pipeline, run_risk_pipeline
@@ -638,6 +682,7 @@ def _pipeline(args):
             eigen_chunk=args.eigen_chunk,
             eigen_sim_length=args.eigen_sim_length,
             vol_regime_half_life=args.vr_half_life, seed=args.seed,
+            quarantine=QuarantinePolicy(enabled=args.quarantine),
         ),
         dtype=args.dtype,
         block=args.block,
@@ -750,6 +795,8 @@ def _pipeline(args):
     if appended is not None:
         rec["appended_dates"] = appended
         rec["update_wall_s"] = round(update_wall, 3)
+    if res.report is not None:
+        rec.update(_report_json(res))
     print(json.dumps(rec))
 
 
@@ -1076,6 +1123,98 @@ def _etl_missing(args):
     print(json.dumps({"n_missing": len(missing), "missing": missing}))
 
 
+def _doctor(args):
+    """Audit a serving state directory (or one artifact): payload
+    checksums, fencing generation vs ``latest.json``, and the risk-state
+    field/stamp schema.  Prints one JSON record per artifact and exits
+    non-zero when anything is corrupt, stale, or schema-broken — the
+    pre-flight check for `risk --update` / `pipeline --append` after a
+    crash or restore (docs/SERVING.md)."""
+    import glob
+
+    from mfm_tpu.data.artifacts import (
+        _NW_SCALARS, _NW_STACKED, ArtifactCorruptError, ArtifactStaleError,
+        _file_sha256, _stamp_from_json, load_artifact, read_pointer,
+    )
+
+    if os.path.isdir(args.path):
+        paths = sorted(glob.glob(os.path.join(args.path, "*.npz")))
+        if not paths:
+            raise SystemExit(f"{args.path}: no .npz artifacts to audit")
+    elif os.path.exists(args.path):
+        paths = [args.path]
+    else:
+        raise SystemExit(f"{args.path}: not found")
+
+    records, unhealthy = [], 0
+    for p in paths:
+        rec = {"file": p, "status": "ok", "problems": [], "warnings": []}
+        records.append(rec)
+        try:
+            arrays, meta = load_artifact(p, fenced=True, force=args.force)
+        except ArtifactStaleError as err:
+            rec["status"] = "stale"
+            rec["problems"].append(str(err))
+            continue
+        except ArtifactCorruptError as err:
+            rec["status"] = "corrupt"
+            rec["problems"].append(str(err))
+            continue
+        rec["kind"] = meta.get("kind", "raw")
+        rec["arrays"] = len(arrays)
+        if meta.get("sha256") is None:
+            # loadable, but silent corruption would pass undetected —
+            # re-running the producing stage upgrades it in place
+            rec["warnings"].append("no payload checksum (legacy artifact)")
+        gen = meta.get("generation")
+        entry = read_pointer(p)
+        if gen is not None:
+            rec["generation"] = gen
+        if entry is not None:
+            ptr_gen = entry.get("generation")
+            rec["pointer_generation"] = ptr_gen
+            if isinstance(gen, int) and isinstance(ptr_gen, int) \
+                    and gen < ptr_gen:
+                # only reachable under --force (the fenced load refuses
+                # otherwise); keep it visible
+                rec["warnings"].append(
+                    f"generation {gen} older than the pointer ({ptr_gen}) "
+                    "— audited past the fence via --force")
+            if gen == ptr_gen and isinstance(entry.get("sha256"), str) \
+                    and _file_sha256(p) != entry["sha256"]:
+                rec["problems"].append(
+                    "file hash differs from the latest.json pointer's — "
+                    "the live file changed after its pointer swap")
+        if meta.get("kind") == "risk_state":
+            required = (set(_NW_SCALARS) | set(_NW_STACKED)
+                        | {"vr_num", "vr_den", "sim_covs"})
+            missing = sorted(required - set(arrays))
+            if missing:
+                rec["problems"].append(
+                    f"missing state field(s) {missing}")
+            guard_keys = sorted(k for k in arrays if k.startswith("guard_"))
+            rec["guarded"] = len(guard_keys) == 5
+            if guard_keys and len(guard_keys) != 5:
+                rec["problems"].append(
+                    f"partial guard state {guard_keys} — expected all "
+                    "five guard_* leaves or none")
+            try:
+                stamp = _stamp_from_json(meta["stamp"])
+                if not isinstance(stamp, tuple):
+                    raise ValueError("stamp is not a tuple")
+            except (KeyError, TypeError, ValueError) as err:
+                rec["problems"].append(f"unusable config stamp ({err}) — "
+                                       "updates would be refused")
+            rec["last_date"] = meta.get("last_date")
+        if rec["problems"]:
+            rec["status"] = "unhealthy" if rec["status"] == "ok" \
+                else rec["status"]
+    unhealthy = sum(r["status"] != "ok" for r in records)
+    print(json.dumps({"audited": len(records), "unhealthy": unhealthy,
+                      "records": records}, indent=1))
+    raise SystemExit(1 if unhealthy else 0)
+
+
 def _lint_cmd(args):
     # pure-AST pass (mfm_tpu/lint.py): no backend, no numpy — safe to run
     # anywhere, including a box with a dead TPU tunnel
@@ -1190,6 +1329,16 @@ def main(argv=None):
                         "OUT/portfolio_risk.json")
     r.add_argument("--portfolio-date", type=int, default=-1,
                    help="date index for --portfolio (default: last)")
+    r.add_argument("--quarantine", action="store_true",
+                   help="guard appended dates (NaN density, universe "
+                        "collapse, MAD outliers, bad caps, date order) and "
+                        "serve quarantined dates in degraded mode: last "
+                        "healthy covariance + staleness, carries frozen.  "
+                        "See docs/SERVING.md")
+    r.add_argument("--force", action="store_true",
+                   help="with --update: accept a checkpoint whose "
+                        "generation is older than the latest.json pointer "
+                        "(deliberate rollback; never bypasses the checksum)")
     r.set_defaults(fn=_risk)
 
     f = sub.add_parser("factors", help="style-factor production (main.py path)")
@@ -1313,6 +1462,14 @@ def main(argv=None):
                     help="max alpha styles to keep (default 5)")
     pl.add_argument("--alpha-max-corr", type=float, default=0.7,
                     help="pairwise PnL-correlation cap for alpha selection")
+    pl.add_argument("--quarantine", action="store_true",
+                    help="guard appended dates and serve quarantined ones "
+                         "in degraded mode (last healthy covariance + "
+                         "staleness, carries frozen).  See docs/SERVING.md")
+    pl.add_argument("--force", action="store_true",
+                    help="with --append: accept a checkpoint whose "
+                         "generation is older than the latest.json pointer "
+                         "(deliberate rollback; never bypasses the checksum)")
     pl.set_defaults(fn=_pipeline)
 
     al = sub.add_parser("alpha",
@@ -1469,6 +1626,19 @@ def main(argv=None):
     ln.add_argument("--json", action="store_true",
                     help="machine-readable output")
     ln.set_defaults(fn=_lint_cmd)
+
+    dr = sub.add_parser(
+        "doctor",
+        help="audit serving artifacts: payload checksums, fencing "
+             "generations vs latest.json, risk-state schema/stamp "
+             "(exit 1 on any problem; docs/SERVING.md)")
+    dr.add_argument("path",
+                    help=".npz artifact or a directory of them (e.g. a "
+                         "pipeline OUT dir or checkpoint dir)")
+    dr.add_argument("--force", action="store_true",
+                    help="audit past a stale-generation refusal (reported "
+                         "as a warning instead of a failure)")
+    dr.set_defaults(fn=_doctor)
 
     args = ap.parse_args(argv)
     if getattr(args, "select_out", None) and args.select is None:

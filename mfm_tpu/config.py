@@ -100,6 +100,58 @@ class FactorConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class QuarantinePolicy:
+    """Input-guard thresholds for the daily serving loop (serve/guard.py).
+
+    Disabled by default: the historical fit path trusts its inputs (they
+    were assembled and validated upstream); the *serving* path — appended
+    slabs arriving one date at a time from a live feed — is where a bad day
+    must be caught before it poisons the Newey-West / vol-regime EWMA
+    carries forever.  A date that trips any check is QUARANTINED: the model
+    serves the last healthy covariance with a staleness counter and the
+    recursive carries skip the date entirely, so the carry after
+    (good, BAD, good) equals the carry after (good, good) bitwise.
+
+    Thresholds are math identity: they decide which dates enter the EWMA
+    sums, so they are stamped into checkpoints via
+    :meth:`RiskModelConfig.identity`.
+    """
+
+    enabled: bool = False
+    #: quarantine when the fraction of non-finite returns inside the
+    #: universe exceeds this (a NaN-poisoned feed day)
+    max_nan_frac: float = 0.05
+    #: cross-sectional |ret - median| > mad_k * MAD marks an outlier cell;
+    #: the date is quarantined when the outlier fraction exceeds
+    #: ``max_outlier_frac`` (fat-fingered prices / split-adjustment bugs)
+    mad_k: float = 10.0
+    max_outlier_frac: float = 0.05
+    #: quarantine when the universe (valid count) collapses below this
+    #: fraction of the trailing-median universe over ``universe_window``
+    #: healthy dates (half the market missing = upstream join broke)
+    min_universe_frac: float = 0.5
+    universe_window: int = 63
+
+    def identity(self) -> tuple:
+        return (self.enabled, self.max_nan_frac, self.mad_k,
+                self.max_outlier_frac, self.min_universe_frac,
+                self.universe_window)
+
+    def __post_init__(self):
+        if not (isinstance(self.universe_window, int)
+                and not isinstance(self.universe_window, bool)
+                and self.universe_window >= 1):
+            raise ValueError(f"universe_window must be a positive int, "
+                             f"got {self.universe_window!r}")
+        for name in ("max_nan_frac", "max_outlier_frac", "min_universe_frac"):
+            v = getattr(self, name)
+            if not 0.0 <= float(v) <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {v!r}")
+        if float(self.mad_k) <= 0:
+            raise ValueError(f"mad_k must be positive, got {self.mad_k!r}")
+
+
+@dataclasses.dataclass(frozen=True)
 class RiskModelConfig:
     """Hyper-parameters of the covariance stack.
 
@@ -141,19 +193,25 @@ class RiskModelConfig:
     eigen_chunk: int | str | None = "auto"
     vol_regime_half_life: float = 42.0
     seed: int = 0
+    #: serving-loop input guards + degraded mode (serve/guard.py); disabled
+    #: by default so the historical fit path is untouched
+    quarantine: QuarantinePolicy = dataclasses.field(
+        default_factory=QuarantinePolicy)
 
     def identity(self) -> tuple:
         """The math identity of the covariance stack: every field that can
         change the numbers.  ``eigen_chunk`` is excluded — chunked and
         full-batch evaluation are bitwise identical (models/eigen.py), so it
-        is an execution knob, not a model parameter.  Stamped into
-        ``RiskModelState`` so a checkpoint refuses to resume under a config
-        that would silently change the math mid-history.
+        is an execution knob, not a model parameter.  The quarantine policy
+        IS included: it decides which dates enter the EWMA sums.  Stamped
+        into ``RiskModelState`` so a checkpoint refuses to resume under a
+        config that would silently change the math mid-history.
         """
         return (
             self.nw_lags, self.nw_half_life, self.nw_method,
             self.eigen_n_sims, self.eigen_scale_coef, self.eigen_sim_length,
             self.eigen_sim_sweeps, self.vol_regime_half_life, self.seed,
+            self.quarantine.identity(),
         )
 
     def __post_init__(self):

@@ -9,25 +9,141 @@ schema stamp, and jitted executables persist via JAX's compilation cache
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import zipfile
+import zlib
 from typing import Mapping
 
 import numpy as np
 
+from mfm_tpu.utils.chaos import chaos_point
+
 FORMAT_VERSION = 1
 
+#: per-directory fencing pointer: ``{basename: {"generation": g,
+#: "sha256": file-digest}}`` — swapped atomically AFTER the artifact rename,
+#: so it always names a fully-written file
+POINTER_NAME = "latest.json"
 
-def save_artifact(path: str, arrays: Mapping[str, object], meta: dict | None = None):
-    """Persist a flat dict of arrays (+ JSON-able metadata) atomically."""
+
+class ArtifactCorruptError(RuntimeError):
+    """An artifact file exists but cannot be trusted: truncated or corrupt
+    npz (suspected torn write) or a checksum mismatch."""
+
+
+class ArtifactStaleError(RuntimeError):
+    """Fencing refusal: the artifact's generation is older than the
+    directory's ``latest.json`` pointer — a restored backup or a file from
+    a superseded writer.  Load with ``force=True`` to accept it anyway."""
+
+
+def _payload_sha256(payload: Mapping[str, np.ndarray]) -> str:
+    """Canonical digest of the array payload (name/dtype/shape/bytes, name
+    order).  Lives INSIDE the npz meta — an end-to-end content check the
+    zip CRCs don't give us across numpy/zlib versions — while the pointer
+    carries the whole-file digest for the doctor audit."""
+    h = hashlib.sha256()
+    for k in sorted(payload):
+        a = np.ascontiguousarray(payload[k])
+        h.update(k.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def _file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _fsync_dir(dirname: str) -> None:
+    """Durably record a rename: fsync of the file alone does not persist
+    the directory entry pointing at it."""
+    try:
+        fd = os.open(dirname or ".", os.O_RDONLY)
+    except OSError:  # pragma: no cover - exotic filesystems
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _pointer_path(path: str) -> str:
+    return os.path.join(os.path.dirname(path) or ".", POINTER_NAME)
+
+
+def read_pointer(path: str) -> dict | None:
+    """The ``latest.json`` entry for ``path`` (None when absent/unreadable —
+    a torn pointer write cannot exist by protocol, but an unreadable pointer
+    must not brick loading: the artifact's own checksum still protects it)."""
+    try:
+        with open(_pointer_path(path)) as f:
+            table = json.load(f)
+    except (OSError, ValueError):
+        return None
+    entry = table.get(os.path.basename(path))
+    return entry if isinstance(entry, dict) else None
+
+
+def _swap_pointer(path: str, generation: int, sha256: str) -> None:
+    """Atomically advance the fencing pointer for ``path``: read-modify-
+    write of the whole table through a tmp + fsync + rename."""
+    ptr = _pointer_path(path)
+    try:
+        with open(ptr) as f:
+            table = json.load(f)
+        if not isinstance(table, dict):
+            table = {}
+    except (OSError, ValueError):
+        table = {}
+    table[os.path.basename(path)] = {
+        "generation": int(generation), "sha256": sha256,
+    }
+    tmp = ptr + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(table, f, indent=0, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, ptr)
+    _fsync_dir(os.path.dirname(ptr))
+
+
+def save_artifact(path: str, arrays: Mapping[str, object],
+                  meta: dict | None = None, *, fenced: bool = False):
+    """Persist a flat dict of arrays (+ JSON-able metadata) atomically.
+
+    Always: payload sha256 into ``__meta__``, tmp write + fsync + rename +
+    directory fsync — a kill at any byte leaves either the old file or the
+    new file, never neither.  ``fenced`` additionally stamps a monotonically
+    increasing ``generation`` (pointer + 1) into the meta and swaps the
+    directory's ``latest.json`` pointer after the rename; loaders then
+    refuse generations older than the pointer (:func:`load_artifact`).
+    """
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     payload = {k: np.asarray(v) for k, v in arrays.items()}
+    meta = dict(meta or {})
+    meta["sha256"] = _payload_sha256(payload)
+    generation = None
+    if fenced:
+        entry = read_pointer(path)
+        generation = (int(entry["generation"]) if entry
+                      and isinstance(entry.get("generation"), int) else 0) + 1
+        meta["generation"] = generation
     payload["__meta__"] = np.frombuffer(
-        json.dumps({"format": FORMAT_VERSION, **(meta or {})}).encode(), dtype=np.uint8
+        json.dumps({"format": FORMAT_VERSION, **meta}).encode(), dtype=np.uint8
     )
     tmp = path + ".tmp.npz"  # savez appends .npz unless already present
     try:
         np.savez_compressed(tmp, **payload)
+        with open(tmp, "rb+") as f:
+            os.fsync(f.fileno())
     except BaseException:
         # a failed write must not leave a half-written temp behind — the
         # next save would os.replace over it, but stray .tmp.npz files in
@@ -37,14 +153,67 @@ def save_artifact(path: str, arrays: Mapping[str, object], meta: dict | None = N
         except OSError:
             pass
         raise
+    file_sha = _file_sha256(tmp)
+    chaos_point("save_artifact.after_tmp", path)
     os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))
+    chaos_point("save_artifact.after_rename", path)
+    if fenced:
+        _swap_pointer(path, generation, file_sha)
 
 
-def load_artifact(path: str):
-    """Returns (arrays dict, meta dict)."""
-    with np.load(path, allow_pickle=False) as z:
-        arrays = {k: z[k] for k in z.files if k != "__meta__"}
-        meta = json.loads(bytes(z["__meta__"]).decode()) if "__meta__" in z.files else {}
+def load_artifact(path: str, *, fenced: bool = False, force: bool = False):
+    """Returns (arrays dict, meta dict).
+
+    A truncated or corrupt npz (the torn-write signature) raises
+    :class:`ArtifactCorruptError` naming the path instead of surfacing a
+    raw ``zipfile.BadZipFile``; a payload-checksum mismatch likewise.  With
+    ``fenced``, the artifact's ``generation`` is checked against the
+    directory's ``latest.json``: older than the pointer raises
+    :class:`ArtifactStaleError` (``force=True`` overrides); exactly one
+    NEWER than the pointer means the writer died between the rename and the
+    pointer swap — the file is complete (it passed its checksum), so the
+    pointer is healed forward and the load succeeds.
+    """
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            arrays = {k: z[k] for k in z.files if k != "__meta__"}
+            meta = (json.loads(bytes(z["__meta__"]).decode())
+                    if "__meta__" in z.files else {})
+    except (zipfile.BadZipFile, zlib.error, EOFError) as e:
+        raise ArtifactCorruptError(
+            f"{path}: truncated or corrupt npz ({e}) — suspected torn "
+            f"write; recover from the previous generation or re-run the "
+            f"producing stage (docs/SERVING.md)") from e
+    except ValueError as e:
+        # np.load raises bare ValueError on non-zip magic / header damage
+        raise ArtifactCorruptError(
+            f"{path}: unreadable artifact ({e}) — suspected torn write or "
+            f"foreign file; recover per docs/SERVING.md") from e
+    # force bypasses FENCING only — a corrupt payload is corrupt under any
+    # flag (rebuild it; don't serve garbage covariances)
+    want = meta.get("sha256")
+    if want is not None:
+        got = _payload_sha256(arrays)
+        if got != want:
+            raise ArtifactCorruptError(
+                f"{path}: payload sha256 mismatch (stored {want[:12]}…, "
+                f"recomputed {got[:12]}…) — corrupt or tampered artifact")
+    if fenced and not force:
+        entry = read_pointer(path)
+        gen = meta.get("generation")
+        if entry is not None and isinstance(gen, int):
+            ptr_gen = entry.get("generation")
+            if isinstance(ptr_gen, int):
+                if gen < ptr_gen:
+                    raise ArtifactStaleError(
+                        f"{path}: generation {gen} is older than the "
+                        f"latest.json pointer ({ptr_gen}) — stale state "
+                        f"(restored backup / superseded writer); pass "
+                        f"force to load anyway")
+                if gen > ptr_gen:
+                    # crash between rename and pointer swap: heal forward
+                    _swap_pointer(path, gen, _file_sha256(path))
     return arrays, meta
 
 
@@ -107,6 +276,12 @@ def save_risk_state(path: str, state, meta: dict | None = None):
         "vr_den": np.asarray(state.vr_den),
         "sim_covs": np.asarray(state.sim_covs),
     }
+    if state.guarded:
+        arrays["guard_last_good_cov"] = np.asarray(state.last_good_cov)
+        arrays["guard_staleness"] = np.asarray(state.staleness)
+        arrays["guard_quarantine_count"] = np.asarray(state.quarantine_count)
+        arrays["guard_ring"] = np.asarray(state.guard_ring)
+        arrays["guard_ring_pos"] = np.asarray(state.guard_ring_pos)
     state_meta = {
         "kind": "risk_state",
         "nw_q": len(Ps),
@@ -115,21 +290,25 @@ def save_risk_state(path: str, state, meta: dict | None = None):
         "stamp": _stamp_to_json(state.stamp),
         "last_date": state.last_date,
     }
-    save_artifact(path, arrays, {**state_meta, **(meta or {})})
+    save_artifact(path, arrays, {**state_meta, **(meta or {})}, fenced=True)
 
 
-def load_risk_state(path: str):
+def load_risk_state(path: str, force: bool = False):
     """Rehydrate a :func:`save_risk_state` artifact.
 
     Returns ``(RiskModelState, meta)``; arrays come back as jax arrays with
     their exact saved dtypes, so ``RiskModel.update`` from the loaded state
-    is bitwise the run that would have continued in-process.
+    is bitwise the run that would have continued in-process.  Checkpoint
+    loads are FENCED: a generation older than the directory's
+    ``latest.json`` pointer is refused (:class:`ArtifactStaleError`) unless
+    ``force`` — serving yesterday's carries as today's silently forks the
+    history.
     """
     import jax.numpy as jnp
 
     from mfm_tpu.models.risk_model import RiskModelState
 
-    arrays, meta = load_artifact(path)
+    arrays, meta = load_artifact(path, fenced=True, force=force)
     missing = (set(_NW_SCALARS) | set(_NW_STACKED)
                | {"vr_num", "vr_den", "sim_covs"}) - set(arrays)
     if meta.get("kind") != "risk_state" or missing:
@@ -137,24 +316,38 @@ def load_risk_state(path: str):
                          + (f" — missing field(s) {sorted(missing)}"
                             if missing else ""))
     q = int(meta["nw_q"])
-    unstack = lambda name: tuple(jnp.asarray(arrays[name][i]) for i in range(q))
+    # jnp.array, NOT jnp.asarray: every leaf built here is later DONATED to
+    # the fused update jits (donate_argnums).  On CPU, asarray zero-copies
+    # the npz-loaded numpy buffer whenever its alignment permits (most of
+    # the time, empirically), and donating a buffer JAX does not own lets
+    # XLA scribble over host memory — nondeterministic garbage in the very
+    # outputs the bitwise-resume contract promises.  jnp.array always copies.
+    own = lambda name: jnp.array(arrays[name])
+    unstack = lambda name: tuple(jnp.array(arrays[name][i]) for i in range(q))
     nw_carry = (
-        jnp.asarray(arrays["nw_t"]),
-        jnp.asarray(arrays["nw_S"]),
-        jnp.asarray(arrays["nw_A"]),
-        jnp.asarray(arrays["nw_Z"]),
+        own("nw_t"), own("nw_S"), own("nw_A"), own("nw_Z"),
         unstack("nw_Ps"), unstack("nw_hs"), unstack("nw_gs"),
         unstack("nw_Slags"), unstack("nw_xlags"),
     )
+    guard = {}
+    if "guard_last_good_cov" in arrays:
+        guard = dict(
+            last_good_cov=own("guard_last_good_cov"),
+            staleness=own("guard_staleness"),
+            quarantine_count=own("guard_quarantine_count"),
+            guard_ring=own("guard_ring"),
+            guard_ring_pos=own("guard_ring_pos"),
+        )
     state = RiskModelState(
         nw_carry,
-        jnp.asarray(arrays["vr_num"]),
-        jnp.asarray(arrays["vr_den"]),
-        jnp.asarray(arrays["sim_covs"]),
+        own("vr_num"),
+        own("vr_den"),
+        own("sim_covs"),
         sim_length=meta["sim_length"],
         eigen_batch_hint=int(meta["eigen_batch_hint"]),
         stamp=_stamp_from_json(meta["stamp"]),
         last_date=meta.get("last_date"),
+        **guard,
     )
     return state, meta
 

@@ -72,6 +72,14 @@ def barra_frame_to_arrays(
         style_names = [c for c in df.columns if c not in base_cols]
     if drop_any_nan:
         df = df.dropna(how="any")
+    if not len(df):
+        # fail with the cause, not a downstream IndexError from empty axes —
+        # the usual culprit is a slab cut entirely inside the factor-warmup
+        # region, where every style column is still NaN
+        raise ValueError(
+            "no rows survive the NaN row filter (drop_any_nan): every row "
+            "has at least one missing field — check that the slab's dates "
+            "lie beyond the style-factor warmup region")
     dates = np.sort(df["date"].unique())
     if stocks is None:
         stocks = np.sort(df["stocknames"].unique())

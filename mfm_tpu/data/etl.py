@@ -57,17 +57,40 @@ class RateLimiter:
 
 
 def with_retry(fn: Callable, attempts: int = 3, backoff_s: float = 5.0,
-               sleep=time.sleep):
-    """Call ``fn``; on exception retry up to ``attempts`` times with a fixed
-    backoff (the reference's pattern, ``update_mongo_db.py:164-184``)."""
+               sleep=time.sleep, *, exponential: bool = False,
+               max_backoff_s: float = 60.0, jitter: float = 0.0,
+               seed: int = 0, retryable: tuple = (Exception,)):
+    """Call ``fn``; on a retryable exception retry up to ``attempts`` times.
+
+    Defaults reproduce the reference's fixed 5 s backoff, broad catch
+    (``update_mongo_db.py:164-184``) exactly.  Production knobs:
+
+    - ``exponential``: back off ``backoff_s * 2**i`` capped at
+      ``max_backoff_s`` — repeated transient failures stop hammering a
+      recovering upstream.
+    - ``jitter``: multiply each delay by a seeded uniform draw from
+      ``[1 - jitter, 1 + jitter]`` (decorrelates a fleet of daily jobs all
+      retrying the same outage on the same schedule).  Seeded so a replay
+      sleeps the same schedule.
+    - ``retryable``: exception classes worth retrying; anything else
+      (a programming error, an auth failure) re-raises IMMEDIATELY — two
+      more identical attempts cannot fix a TypeError.
+    """
+    import random
+
+    rng = random.Random(seed)
     last = None
     for i in range(attempts):
         try:
             return fn()
-        except Exception as e:  # noqa: BLE001 — mirror the reference's broad catch
+        except retryable as e:
             last = e
             if i < attempts - 1:
-                sleep(backoff_s)
+                delay = (min(backoff_s * (2.0 ** i), max_backoff_s)
+                         if exponential else backoff_s)
+                if jitter:
+                    delay *= 1.0 + jitter * (2.0 * rng.random() - 1.0)
+                sleep(delay)
     raise last
 
 

@@ -39,6 +39,23 @@ except Exception:  # pragma: no cover
     BulkWriteError = None
 
 
+def _transient_mongo_errors() -> tuple:
+    """The pymongo exception classes that mean "try again later", resolved
+    lazily so the monkeypatched stand-in (tests/mongofake.py, which defines
+    only the errors the adapter's logic needs) works too.  The builtin
+    connection/timeout errors ride along — drivers and injected transports
+    surface raw socket failures as those.
+    """
+    names = ("AutoReconnect", "NetworkTimeout", "ConnectionFailure",
+             "ServerSelectionTimeoutError", "ExecutionTimeout",
+             "WTimeoutError")
+    errs = tuple(
+        t for n in names
+        if isinstance(t := getattr(pymongo.errors, n, None), type)
+    )
+    return errs + (ConnectionError, TimeoutError)
+
+
 class MongoPanelStore:
     """PanelStore-compatible wrapper over a ``pymongo.database.Database``.
 
@@ -161,7 +178,12 @@ class MongoPanelStore:
                 self._indexed.add(key)
             except pymongo.errors.OperationFailure:
                 self._indexed.add(key)
-            except Exception:
+            except _transient_mongo_errors():
+                # don't-cache-transient-failures (stated above): a stepdown
+                # or timeout must NOT mark the key done — the next call
+                # retries and builds the index.  Narrowed from a bare
+                # ``except Exception``: a programming error in the index
+                # spec must surface, not be swallowed as "transient".
                 pass
         doc = self.db[name].find_one(
             {date_col: {"$exists": True}}, {date_col: 1, "_id": 0},

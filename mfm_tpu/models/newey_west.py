@@ -108,6 +108,7 @@ def newey_west_expanding_resume(
     ret: jax.Array, q: int = 2, half_life: float = 252.0,
     min_valid: int | None = None, carry: tuple | None = None,
     dyn_length: jax.Array | None = None,
+    skip_mask: jax.Array | None = None,
 ):
     """The "scan" method of :func:`newey_west_expanding`, checkpointable.
 
@@ -126,6 +127,15 @@ def newey_west_expanding_resume(
     the surrounding program, whose different fusion shifts the step math by
     an ulp — a dynamic bound keeps the body its own computation at any T,
     so a one-date update executes bitwise the same step as a long history.
+
+    ``skip_mask`` (a (T,) bool, quarantine verdicts from serve/guard.py)
+    excises dates from the recursion: at a masked date the carry passes
+    through UNCHANGED — no decay, no ``t`` increment — selected per-leaf
+    after the step, so the carry after (good, BAD, good) equals the carry
+    after (good, good) bitwise and a NaN-poisoned date cannot reach the
+    sums (``jnp.where`` never propagates NaN from the unselected branch).
+    The masked date's stacked output V is the discarded candidate (its
+    ``valid`` flag is forced False); callers serve a degraded value there.
     """
     T, K = ret.shape
     dtype = ret.dtype
@@ -174,6 +184,7 @@ def newey_west_expanding_resume(
     from mfm_tpu.parallel.mesh import replicate_under_mesh
 
     ret_r = replicate_under_mesh(ret)
+    skip_r = None if skip_mask is None else replicate_under_mesh(skip_mask)
 
     # s32-indexed fori_loop rather than lax.scan: scan's stacked-output
     # counter canonicalizes to s64 under x64 and trips the spmd partitioner's
@@ -182,10 +193,15 @@ def newey_west_expanding_resume(
     def body(i, state):
         carry, covs_acc, valid_acc = state
         xt = jax.lax.dynamic_index_in_dim(ret_r, i, 0, keepdims=False)
-        carry, (V, v_ok) = step(carry, xt)
+        new_carry, (V, v_ok) = step(carry, xt)
+        if skip_r is not None:
+            sk = jax.lax.dynamic_index_in_dim(skip_r, i, 0, keepdims=False)
+            new_carry = jax.tree_util.tree_map(
+                lambda old, new: jnp.where(sk, old, new), carry, new_carry)
+            v_ok = v_ok & ~sk
         covs_acc = jax.lax.dynamic_update_index_in_dim(covs_acc, V, i, 0)
         valid_acc = jax.lax.dynamic_update_index_in_dim(valid_acc, v_ok, i, 0)
-        return carry, covs_acc, valid_acc
+        return new_carry, covs_acc, valid_acc
 
     hi = jnp.int32(T) if dyn_length is None else dyn_length.astype(jnp.int32)
     carry_out, covs, valid = jax.lax.fori_loop(

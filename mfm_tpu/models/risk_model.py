@@ -44,6 +44,7 @@ from mfm_tpu.models.vol_regime import (
 )
 from mfm_tpu.models.bias import eigenfactor_bias_stat
 from mfm_tpu.ops.xreg import regress_panel
+from mfm_tpu.serve.guard import GuardReport, guard_slab
 
 
 class RiskModelOutputs(NamedTuple):
@@ -95,25 +96,46 @@ class RiskModelState:
     eigen_batch_hint: int
     stamp: tuple
     last_date: str | None = None
+    #: degraded-mode serving state (all five together, None when the state
+    #: was built without quarantine — serve/guard.py): the last healthy
+    #: vol-regime covariance, its age in dates, the cumulative quarantined
+    #: count, and the trailing-universe ring the collapse check medians over
+    last_good_cov: jax.Array | None = None   # (K, K)
+    staleness: jax.Array | None = None       # s32 scalar
+    quarantine_count: jax.Array | None = None  # s32 scalar
+    guard_ring: jax.Array | None = None      # (universe_window,)
+    guard_ring_pos: jax.Array | None = None  # s32 scalar
 
     def tree_flatten(self):
-        children = (self.nw_carry, self.vr_num, self.vr_den, self.sim_covs)
+        children = (self.nw_carry, self.vr_num, self.vr_den, self.sim_covs,
+                    self.last_good_cov, self.staleness,
+                    self.quarantine_count, self.guard_ring,
+                    self.guard_ring_pos)
         aux = (self.sim_length, self.eigen_batch_hint, self.stamp,
                self.last_date)
         return children, aux
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        nw_carry, vr_num, vr_den, sim_covs = children
+        (nw_carry, vr_num, vr_den, sim_covs, last_good_cov, staleness,
+         quarantine_count, guard_ring, guard_ring_pos) = children
         sim_length, eigen_batch_hint, stamp, last_date = aux
         return cls(nw_carry, vr_num, vr_den, sim_covs,
                    sim_length=sim_length, eigen_batch_hint=eigen_batch_hint,
-                   stamp=stamp, last_date=last_date)
+                   stamp=stamp, last_date=last_date,
+                   last_good_cov=last_good_cov, staleness=staleness,
+                   quarantine_count=quarantine_count, guard_ring=guard_ring,
+                   guard_ring_pos=guard_ring_pos)
 
     @property
     def t(self) -> int:
         """Number of dates folded into the state so far."""
         return int(self.nw_carry[0])
+
+    @property
+    def guarded(self) -> bool:
+        """True when the state carries degraded-mode serving leaves."""
+        return self.last_good_cov is not None
 
 
 @dataclasses.dataclass
@@ -143,6 +165,15 @@ class RiskModel:
     factor_names: Sequence[str] | None = None
 
     def __post_init__(self):
+        # Panels feed the fused jits with donate_argnums.  A raw numpy input
+        # must become a JAX-OWNED buffer here: on CPU ``jnp.asarray`` can
+        # zero-copy alias the caller's numpy memory (alignment permitting),
+        # and donating an aliased buffer corrupts outputs nondeterministically.
+        # ``jnp.array`` copies; tracers/jax arrays pass through untouched.
+        for f in ("ret", "cap", "styles", "industry", "valid"):
+            v = getattr(self, f)
+            if isinstance(v, np.ndarray):
+                object.__setattr__(self, f, jnp.array(v))
         self.T, self.N = self.ret.shape
         self.Q = self.styles.shape[-1]
         self.K = 1 + self.n_industries + self.Q
@@ -262,13 +293,16 @@ class RiskModel:
 
     # -- incremental daily-update path --------------------------------------
     def _run_carried(self, sim_covs, sim_length, nw_carry=None, vr_carry=None,
-                     eigen_batch_hint=None, dyn_length=None):
+                     eigen_batch_hint=None, dyn_length=None, skip_mask=None):
         """:meth:`run` with resumable scans: same four stages, but Newey-West
         and vol-regime run through their ``*_resume`` forms so the exact EWMA
         carries come out alongside the outputs.  With ``None`` carries this
         IS the full-history run (the resume forms default to the empty-history
         state); with carries from a previous call it continues that history,
-        bitwise."""
+        bitwise.  ``skip_mask`` ((T,) bool, None = no guards, the exact
+        pre-guard graph) excises quarantined dates from both recursions and
+        forces their ``nw_valid`` False so the eigen/vol-regime stages treat
+        them as invalid."""
         if self.T == 1:
             # XLA collapses a unit date batch into a different (gemv)
             # lowering of the residual matvec — 1 ulp off the batched
@@ -288,7 +322,7 @@ class RiskModel:
         nw_cov, nw_valid, nw_carry_out = newey_west_expanding_resume(
             factor_ret, q=self.config.nw_lags,
             half_life=self.config.nw_half_life, min_valid=self.K,
-            carry=nw_carry, dyn_length=dyn_length,
+            carry=nw_carry, dyn_length=dyn_length, skip_mask=skip_mask,
         )
         if self.T == 1:
             # same unit-batch pinning as the regression above, for the
@@ -308,7 +342,7 @@ class RiskModel:
         vr_cov, lamb, vr_carry_out = vol_regime_adjust_resume(
             factor_ret, eigen_cov, eigen_valid,
             half_life=self.config.vol_regime_half_life, carry=vr_carry,
-            dyn_length=dyn_length,
+            dyn_length=dyn_length, skip_mask=skip_mask,
         )
         outputs = RiskModelOutputs(
             factor_ret, specific_ret, r2,
@@ -349,6 +383,11 @@ class RiskModel:
                 dtype=self.ret.dtype,
             )
         hint = self.T * int(sim_covs.shape[0])
+        # the guard ring seeds from the history's universe sizes — read them
+        # BEFORE the fused call donates (and may invalidate) self.valid
+        guarded = self.config.quarantine.enabled
+        if guarded:
+            counts = np.asarray(jnp.sum(self.valid, axis=1)).astype(np.int64)
         import warnings
 
         with warnings.catch_warnings():
@@ -359,12 +398,48 @@ class RiskModel:
                 sim_covs, n_industries=self.n_industries, config=self.config,
                 sim_length=sim_len, eigen_batch_hint=hint,
             )
+        guard = {}
+        if guarded:
+            guard = self._seed_guard_state(outputs, counts)
         state = RiskModelState(
             nw_carry, vr_num, vr_den, sim_covs,
             sim_length=sim_len, eigen_batch_hint=hint,
-            stamp=self._stamp(), last_date=last_date,
+            stamp=self._stamp(), last_date=last_date, **guard,
         )
         return outputs, state
+
+    def _seed_guard_state(self, outputs, universe_counts) -> dict:
+        """Degraded-mode leaves for a freshly fitted history (host-side:
+        init is not latency-critical and the history is trusted — guards
+        protect the *appended* dates).  The trailing-universe ring takes the
+        last ``universe_window`` per-date valid counts; the last-good
+        covariance is the final eigen-valid date's adjusted covariance."""
+        pol = self.config.quarantine
+        dtype = np.asarray(outputs.vr_cov).dtype
+        W = pol.universe_window
+        ring = np.full((W,), np.nan, dtype)
+        tail = np.asarray(universe_counts, np.float64)[-W:]
+        ring[: len(tail)] = tail.astype(dtype)
+        pos = np.int32(len(tail) % W)
+        ev = np.asarray(outputs.eigen_valid, bool)
+        vr = np.asarray(outputs.vr_cov)
+        good = np.nonzero(ev)[0]
+        if good.size:
+            last_good = vr[good[-1]].copy()
+            staleness = np.int32(len(ev) - 1 - good[-1])
+        else:
+            last_good = np.full(vr.shape[1:], np.nan, dtype)
+            staleness = np.int32(len(ev))
+        # jnp.array: these leaves are donated by the next guarded update, so
+        # they must be JAX-owned copies, not zero-copy views of the local
+        # numpy scratch above (whose buffers die with this frame)
+        return dict(
+            last_good_cov=jnp.array(last_good),
+            staleness=jnp.array(staleness, jnp.int32),
+            quarantine_count=jnp.array(0, jnp.int32),
+            guard_ring=jnp.array(ring),
+            guard_ring_pos=jnp.array(pos, jnp.int32),
+        )
 
     def update(self, state: RiskModelState, last_date: str | None = None):
         """Append this model's panel — the new date(s) only — to ``state``.
@@ -409,8 +484,88 @@ class RiskModel:
             eigen_batch_hint=state.eigen_batch_hint,
             stamp=state.stamp,
             last_date=state.last_date if last_date is None else last_date,
+            # an unguarded update trusts the slab: degraded-mode leaves ride
+            # along unchanged (use update_guarded to maintain them)
+            last_good_cov=state.last_good_cov, staleness=state.staleness,
+            quarantine_count=state.quarantine_count,
+            guard_ring=state.guard_ring,
+            guard_ring_pos=state.guard_ring_pos,
         )
         return outputs, new_state
+
+    def update_guarded(self, state: RiskModelState, last_date: str | None = None,
+                       pre_reasons=None):
+        """:meth:`update` behind the serving guards (degraded mode).
+
+        Health-checks every slab date (serve/guard.py) inside the same
+        single jitted step, excises quarantined dates from the Newey-West /
+        vol-regime carries (so the carry after (good, BAD, good) equals the
+        carry after (good, good) bitwise), and maintains the degraded-mode
+        serving state: the last healthy covariance, its staleness, the
+        cumulative quarantine count and the trailing-universe ring.
+
+        Returns ``(outputs, report, new_state)``: ``outputs`` is the raw
+        :class:`RiskModelOutputs` over the slab (quarantined dates carry
+        their discarded candidates, ``nw_valid``/``eigen_valid`` forced
+        False there); ``report`` is the :class:`GuardReport` whose
+        ``served_cov`` is what a reader should be handed — ``vr_cov``
+        bitwise-untouched at healthy dates, the last healthy covariance at
+        quarantined ones.  ``pre_reasons``: optional (T,) uint32 host-side
+        verdicts (:func:`mfm_tpu.serve.guard.host_date_reasons`) OR-ed in.
+
+        Requires a state built under a quarantine-enabled config
+        (:meth:`init_state` seeds the guard leaves).  Same donation story
+        as :meth:`update`: panels, carries and guard leaves are donated.
+        """
+        self._require_scan_method("update_guarded")
+        if not self.config.quarantine.enabled:
+            raise ValueError(
+                "update_guarded requires config.quarantine.enabled=True "
+                "(QuarantinePolicy on RiskModelConfig)")
+        expect = self._stamp()
+        if state.stamp != expect:
+            raise ValueError(
+                f"RiskModelState stamp mismatch: checkpoint carries "
+                f"{state.stamp}, this model is {expect} — refusing to resume "
+                f"under different shapes/dtype/math config"
+            )
+        if not state.guarded:
+            raise ValueError(
+                "state has no degraded-mode leaves — it was initialized "
+                "without quarantine; re-run init_state under a "
+                "quarantine-enabled config (the guards need the trailing-"
+                "universe ring and last-good covariance seeded at init)")
+        pre = (jnp.zeros((self.T,), jnp.uint32) if pre_reasons is None
+               else jnp.asarray(pre_reasons, jnp.uint32))
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message=".*[Dd]onat.*", category=UserWarning)
+            outputs, report, nw_carry, (vr_num, vr_den), guard = \
+                _fused_update_guarded_step(
+                    self.ret, self.cap, self.styles, self.industry,
+                    self.valid, state.sim_covs, state.nw_carry,
+                    state.vr_num, state.vr_den, state.last_good_cov,
+                    state.staleness, state.quarantine_count,
+                    state.guard_ring, state.guard_ring_pos, pre,
+                    jnp.asarray(self.T, jnp.int32),
+                    n_industries=self.n_industries, config=self.config,
+                    sim_length=state.sim_length,
+                    eigen_batch_hint=state.eigen_batch_hint,
+                )
+        last_good, staleness, q_count, ring, ring_pos = guard
+        new_state = RiskModelState(
+            nw_carry, vr_num, vr_den, state.sim_covs,
+            sim_length=state.sim_length,
+            eigen_batch_hint=state.eigen_batch_hint,
+            stamp=state.stamp,
+            last_date=state.last_date if last_date is None else last_date,
+            last_good_cov=last_good, staleness=staleness,
+            quarantine_count=q_count, guard_ring=ring,
+            guard_ring_pos=ring_pos,
+        )
+        return outputs, report, new_state
 
     def bias_stat(self, covs, valid, factor_ret, predlen: int = 1):
         """Eigenfactor bias statistic (``MFM.py:203-204``)."""
@@ -484,3 +639,72 @@ def _fused_update_step(ret, cap, styles, industry, valid, sim_covs,
                           nw_carry=nw_carry, vr_carry=(vr_num, vr_den),
                           eigen_batch_hint=eigen_batch_hint,
                           dyn_length=t_count)
+
+
+def _serve_degraded(vr_cov, eigen_valid, quarantined, last_good, staleness,
+                    dyn_length):
+    """Degraded-mode serving scan: thread (last_good, staleness) through the
+    slab dates in order.  A healthy eigen-valid date refreshes last_good and
+    zeroes the age; a quarantined date is served last_good at age+1; healthy
+    dates are served their own vr_cov bitwise-untouched (the select picks
+    the computed value — no re-math)."""
+    T = vr_cov.shape[0]
+
+    def body(i, state):
+        last_good, age, served_acc, stale_acc = state
+        q_t = jax.lax.dynamic_index_in_dim(quarantined, i, 0, keepdims=False)
+        cov_t = jax.lax.dynamic_index_in_dim(vr_cov, i, 0, keepdims=False)
+        ev_t = jax.lax.dynamic_index_in_dim(eigen_valid, i, 0, keepdims=False)
+        served_t = jnp.where(q_t, last_good, cov_t)
+        stale_t = jnp.where(q_t, age + jnp.int32(1), jnp.int32(0))
+        healthy = ~q_t & ev_t
+        last_good = jnp.where(healthy, cov_t, last_good)
+        age = jnp.where(healthy, jnp.int32(0), age + jnp.int32(1))
+        served_acc = jax.lax.dynamic_update_index_in_dim(
+            served_acc, served_t, i, 0)
+        stale_acc = jax.lax.dynamic_update_index_in_dim(
+            stale_acc, stale_t, i, 0)
+        return last_good, age, served_acc, stale_acc
+
+    hi = (jnp.int32(T) if dyn_length is None
+          else dyn_length.astype(jnp.int32))
+    return jax.lax.fori_loop(
+        jnp.int32(0), hi, body,
+        (last_good, staleness.astype(jnp.int32),
+         jnp.zeros_like(vr_cov), jnp.zeros((T,), jnp.int32)),
+    )
+
+
+# the guarded serving step: guards, the carried four stages with quarantined
+# dates excised, and the degraded-mode serving scan — still ONE compiled
+# program (the steady-state serving loop stays at <= 1 compile).  Donation
+# adds the guard-state operands (9-13); sim_covs (5) and pre_reasons (14)
+# stay host-owned.
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_industries", "config", "sim_length",
+                     "eigen_batch_hint"),
+    donate_argnums=(0, 1, 2, 3, 4, 6, 7, 8, 9, 10, 11, 12, 13),
+)
+def _fused_update_guarded_step(ret, cap, styles, industry, valid, sim_covs,
+                               nw_carry, vr_num, vr_den, last_good, staleness,
+                               q_count, ring, ring_pos, pre_reasons, t_count,
+                               *, n_industries, config, sim_length,
+                               eigen_batch_hint):
+    quarantined, reasons, ring, ring_pos = guard_slab(
+        ret, cap, valid, ring, ring_pos, config.quarantine,
+        pre_reasons=pre_reasons)
+    m = RiskModel(ret, cap, styles, industry, valid,
+                  n_industries=n_industries, config=config)
+    outputs, nw_carry_out, vr_carry_out = m._run_carried(
+        sim_covs, sim_length,
+        nw_carry=nw_carry, vr_carry=(vr_num, vr_den),
+        eigen_batch_hint=eigen_batch_hint, dyn_length=t_count,
+        skip_mask=quarantined)
+    last_good, staleness, served, stale_series = _serve_degraded(
+        outputs.vr_cov, outputs.eigen_valid, quarantined, last_good,
+        staleness, t_count)
+    q_count = q_count + jnp.sum(quarantined.astype(jnp.int32))
+    report = GuardReport(quarantined, reasons, stale_series, served)
+    return (outputs, report, nw_carry_out, vr_carry_out,
+            (last_good, staleness, q_count, ring, ring_pos))

@@ -51,6 +51,7 @@ def vol_regime_adjust_resume(
     half_life: float = 42.0,
     carry: tuple | None = None,
     dyn_length: jax.Array | None = None,
+    skip_mask: jax.Array | None = None,
 ):
     """:func:`vol_regime_adjust_by_time`, checkpointable.
 
@@ -63,6 +64,14 @@ def vol_regime_adjust_resume(
     calls.  ``dyn_length`` (traced s32 scalar == T) keeps the loop bound
     dynamic so XLA cannot inline a trip-count-1 loop into the surrounding
     program and shift the step math by an ulp (see newey_west.py).
+
+    ``skip_mask`` ((T,) bool, quarantine verdicts) excises dates from the
+    EWMA: at a masked date ``(num, den)`` pass through UNCHANGED — note
+    this is *stronger* than an invalid date (``ok`` False), which still
+    decays both sums (time-decay semantics, MFM.py:158); a quarantined
+    date is removed from the time axis entirely so (good, BAD, good)
+    matches (good, good) bitwise.  The masked date's stored multiplier is
+    the frozen carry's ratio (the value a degraded-mode reader would see).
     """
     dtype = factor_ret.dtype
     lam = jnp.asarray(0.5, dtype) ** (1.0 / half_life)
@@ -77,6 +86,7 @@ def vol_regime_adjust_resume(
     from mfm_tpu.parallel.mesh import replicate_under_mesh
 
     B2z, okf = replicate_under_mesh((B2z, okf))
+    skf = None if skip_mask is None else replicate_under_mesh(skip_mask)
     T = B2z.shape[0]
 
     # s32-indexed fori_loop rather than lax.scan: scan's stacked-output
@@ -87,12 +97,17 @@ def vol_regime_adjust_resume(
         num, den, out = state
         b2 = jax.lax.dynamic_index_in_dim(B2z, i, 0, keepdims=False)
         okv = jax.lax.dynamic_index_in_dim(okf, i, 0, keepdims=False)
-        num = lam * num + okv * b2
-        den = lam * den + okv
+        num_new = lam * num + okv * b2
+        den_new = lam * den + okv
+        if skf is not None:
+            sk = jax.lax.dynamic_index_in_dim(skf, i, 0, keepdims=False)
+            num_new = jnp.where(sk, num, num_new)
+            den_new = jnp.where(sk, den, den_new)
         # before any valid date numpy sums over empty arrays yield 0.0
         # (MFM.py:159-160), not NaN
-        val = jnp.where(den > 0, num / den, 0.0)
-        return num, den, jax.lax.dynamic_update_index_in_dim(out, val, i, 0)
+        val = jnp.where(den_new > 0, num_new / den_new, 0.0)
+        return (num_new, den_new,
+                jax.lax.dynamic_update_index_in_dim(out, val, i, 0))
 
     num0, den0 = vr_init_carry(dtype) if carry is None else carry
     hi = jnp.int32(T) if dyn_length is None else dyn_length.astype(jnp.int32)

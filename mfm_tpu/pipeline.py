@@ -134,6 +134,11 @@ class RiskPipelineResult:
     #: :func:`append_risk_pipeline`); persist with
     #: :func:`save_pipeline_state` to serve future dates in O(1) each
     state: RiskModelState | None = None
+    #: per-date guard verdicts over the appended slab
+    #: (:class:`mfm_tpu.serve.guard.GuardReport`) when the append ran with
+    #: quarantine enabled; ``report.served_cov`` is the degraded-mode
+    #: covariance series a reader should be handed
+    report: object | None = None
     #: (half_life, ngroup, q, min_periods) -> (T, N) shrunk specific vol
     _spec_cache: dict = dataclasses.field(default_factory=dict, repr=False)
 
@@ -352,10 +357,13 @@ def run_risk_pipeline(
     if arrays is None:
         arrays = barra_frame_to_arrays(barra_df, industry_codes=industry_codes)
     dtype = jnp.float64 if config.dtype == "float64" else jnp.float32
+    # jnp.array (copying), not asarray: the panels are donated by the fused
+    # init/update jits, and on CPU asarray can zero-copy alias the numpy
+    # buffers — donating memory JAX does not own corrupts outputs.
     rm = RiskModel(
-        jnp.asarray(arrays.ret, dtype), jnp.asarray(arrays.cap, dtype),
-        jnp.asarray(arrays.styles, dtype), jnp.asarray(arrays.industry),
-        jnp.asarray(arrays.valid), n_industries=arrays.n_industries,
+        jnp.array(arrays.ret, dtype), jnp.array(arrays.cap, dtype),
+        jnp.array(arrays.styles, dtype), jnp.array(arrays.industry),
+        jnp.array(arrays.valid), n_industries=arrays.n_industries,
         config=config.risk, factor_names=arrays.factor_names(),
     )
     if with_state:
@@ -395,6 +403,7 @@ def append_risk_pipeline(
     state_path: str,
     barra_df,
     config: PipelineConfig | None = None,
+    force: bool = False,
 ) -> RiskPipelineResult:
     """Serve the new date(s) of a barra table from a saved checkpoint.
 
@@ -406,11 +415,19 @@ def append_risk_pipeline(
     advanced past them (save it back with :func:`save_pipeline_state` to
     continue tomorrow).  Outputs are bitwise what a full-history rerun would
     produce for those dates.  Raises when the table holds no new dates.
+
+    With ``config.risk.quarantine.enabled`` (and a checkpoint initialized
+    under it), the update runs GUARDED (:meth:`RiskModel.update_guarded`):
+    slab dates are health-checked, quarantined dates are excised from the
+    carries and served the last healthy covariance, and ``result.report``
+    carries the verdicts.  ``force`` overrides the checkpoint generation
+    fencing (:func:`mfm_tpu.data.artifacts.load_risk_state`).
     """
     from mfm_tpu.data.artifacts import load_risk_state
+    from mfm_tpu.serve.guard import host_date_reasons
 
     config = config or PipelineConfig()
-    state, meta = load_risk_state(state_path)
+    state, meta = load_risk_state(state_path, force=force)
     arrays = barra_frame_to_arrays(
         barra_df,
         industry_codes=np.asarray(meta["industry_codes"]),
@@ -432,12 +449,22 @@ def append_risk_pipeline(
         industry_codes=sl.industry_codes, style_names=sl.style_names,
     )
     dtype = jnp.float64 if config.dtype == "float64" else jnp.float32
+    # copying conversion — the slab panels are donated (see run_risk_pipeline)
     rm = RiskModel(
-        jnp.asarray(slab.ret, dtype), jnp.asarray(slab.cap, dtype),
-        jnp.asarray(slab.styles, dtype), jnp.asarray(slab.industry),
-        jnp.asarray(slab.valid), n_industries=slab.n_industries,
+        jnp.array(slab.ret, dtype), jnp.array(slab.cap, dtype),
+        jnp.array(slab.styles, dtype), jnp.array(slab.industry),
+        jnp.array(slab.valid), n_industries=slab.n_industries,
         config=config.risk, factor_names=slab.factor_names(),
     )
+    if config.risk.quarantine.enabled:
+        # the host-side date-order pre-check feeds the traced guards; a
+        # disordered date is quarantined, not folded into the carries
+        pre = host_date_reasons(
+            [date_stamp(d) for d in slab.dates], last_date=last)
+        outputs, report, new_state = rm.update_guarded(
+            state, last_date=date_stamp(slab.dates[-1]), pre_reasons=pre)
+        return RiskPipelineResult(outputs=outputs, arrays=slab, model=rm,
+                                  state=new_state, report=report)
     outputs, new_state = rm.update(state,
                                    last_date=date_stamp(slab.dates[-1]))
     return RiskPipelineResult(outputs=outputs, arrays=slab, model=rm,

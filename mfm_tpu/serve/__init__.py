@@ -1,0 +1,20 @@
+"""Serving-hardening layer: input guards + degraded-mode quarantine.
+
+The daily-update path (``RiskModel.update``) trusts its inputs; a live feed
+does not deserve that trust.  This package holds the jit-traceable per-date
+health checks (:mod:`mfm_tpu.serve.guard`) the guarded update step runs on
+every appended slab before the date is allowed into the EWMA carries.
+"""
+
+from mfm_tpu.serve.guard import (  # noqa: F401
+    REASON_NAN_DENSITY,
+    REASON_UNIVERSE_COLLAPSE,
+    REASON_RET_OUTLIER,
+    REASON_CAP_NONPOS,
+    REASON_DATE_ORDER,
+    GuardReport,
+    guard_ring_init,
+    guard_slab,
+    host_date_reasons,
+    reason_names,
+)

@@ -1,0 +1,179 @@
+"""Per-date input guards for the daily serving loop.
+
+A production daily-batch risk model's real failure mode is bad *days*, not
+bad math (ISSUE/PAPER: USE4-style systems): a NaN-poisoned slab, a feed
+that silently lost half the universe, a split-adjustment bug spraying 10-MAD
+returns.  One such date entering the Newey-West / vol-regime EWMA carries
+corrupts every later covariance — the carries are exact cumulative sums
+with no forgetting beyond the half-life decay.
+
+:func:`guard_slab` runs INSIDE the jitted update step (no host round-trips
+in the hot loop): for each appended date it computes a reason bitmask over
+the traced checks and a quarantine verdict, and maintains a ring buffer of
+healthy-universe sizes so the collapse check compares against a trailing
+median.  Dates are processed in order — a quarantined date does not enter
+the ring, so a collapse cannot drag its own reference down.
+
+The one check that cannot be traced — non-monotone / duplicate dates — runs
+host-side (:func:`host_date_reasons`) and feeds in through ``pre_reasons``.
+
+All thresholds come from :class:`mfm_tpu.config.QuarantinePolicy`, a frozen
+(hashable) dataclass that rides in the jit-static config, so the compiled
+step is specialized to the policy and re-tuning recompiles exactly once.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+# reason bitmask: a date may trip several checks at once; the report keeps
+# all of them (uint32 leaves room to grow)
+REASON_NAN_DENSITY = 1        # non-finite ret fraction inside the universe
+REASON_UNIVERSE_COLLAPSE = 2  # valid count << trailing-median universe
+REASON_RET_OUTLIER = 4        # too many |ret - median| > mad_k * MAD cells
+REASON_CAP_NONPOS = 8         # non-positive / non-finite cap in universe
+REASON_DATE_ORDER = 16        # host-side: non-monotone or duplicate date
+
+_REASON_NAMES = (
+    (REASON_NAN_DENSITY, "nan_density"),
+    (REASON_UNIVERSE_COLLAPSE, "universe_collapse"),
+    (REASON_RET_OUTLIER, "ret_outlier"),
+    (REASON_CAP_NONPOS, "cap_nonpos"),
+    (REASON_DATE_ORDER, "date_order"),
+)
+
+
+def reason_names(mask: int) -> list[str]:
+    """Human-readable names of the bits set in a reason mask."""
+    return [name for bit, name in _REASON_NAMES if int(mask) & bit]
+
+
+class GuardReport(NamedTuple):
+    """Per-date verdicts of one guarded update step.
+
+    ``served_cov[t]`` is the covariance the serving layer should hand out
+    at date t: ``vr_cov[t]`` bitwise-untouched for healthy dates, the last
+    healthy covariance (``staleness[t]`` dates old) for quarantined ones.
+    """
+
+    quarantined: jax.Array   # (T,) bool
+    reasons: jax.Array       # (T,) uint32 bitmask
+    staleness: jax.Array     # (T,) int32: dates since the served cov was fit
+    served_cov: jax.Array    # (T, K, K)
+
+
+def guard_ring_init(window: int, dtype) -> tuple[jax.Array, jax.Array]:
+    """Empty trailing-universe ring: NaN slots are "no observation yet"
+    (the collapse check disables itself until the ring holds data)."""
+    return (jnp.full((window,), jnp.nan, dtype),
+            jnp.asarray(0, jnp.int32))
+
+
+def guard_slab(ret, cap, valid, ring, ring_pos, policy, pre_reasons=None):
+    """Health-check every date of an appended slab, in order.
+
+    Args:
+      ret, cap: (T, N) slab panels (compute dtype).
+      valid: (T, N) bool universe mask.
+      ring: (W,) trailing healthy-universe sizes (NaN = empty slot).
+      ring_pos: s32 next write slot.
+      policy: :class:`QuarantinePolicy` (trace-time constants).
+      pre_reasons: optional (T,) uint32 host-computed reasons
+        (:func:`host_date_reasons`) OR-ed into the verdicts.
+
+    Returns ``(quarantined (T,) bool, reasons (T,) uint32, ring, ring_pos)``.
+    Traced; call from inside the jitted update step.
+    """
+    T, _ = ret.shape
+    dtype = ret.dtype
+    one = jnp.asarray(1.0, dtype)
+    if pre_reasons is None:
+        pre_reasons = jnp.zeros((T,), jnp.uint32)
+
+    def body(i, state):
+        ring, pos, reasons_acc = state
+        rett = jax.lax.dynamic_index_in_dim(ret, i, 0, keepdims=False)
+        capt = jax.lax.dynamic_index_in_dim(cap, i, 0, keepdims=False)
+        vt = jax.lax.dynamic_index_in_dim(valid, i, 0, keepdims=False)
+        pre = jax.lax.dynamic_index_in_dim(pre_reasons, i, 0, keepdims=False)
+
+        n_valid = jnp.sum(vt.astype(dtype))
+        denom = jnp.maximum(n_valid, one)
+
+        # 1. NaN/Inf density over the universe
+        bad_ret = vt & ~jnp.isfinite(rett)
+        nan_frac = jnp.sum(bad_ret.astype(dtype)) / denom
+        r_nan = nan_frac > policy.max_nan_frac
+
+        # 2. universe collapse vs the trailing median of HEALTHY dates.
+        # An empty ring yields a NaN reference -> check disabled (isfinite).
+        ref = jnp.nanmedian(ring)
+        r_uni = jnp.isfinite(ref) & (n_valid < policy.min_universe_frac * ref)
+
+        # 3. cross-sectional return outliers: |r - med| > mad_k * MAD.
+        # A degenerate MAD of 0 (constant cross-section) disables the check
+        # rather than flagging every cell.
+        r_use = jnp.where(vt & jnp.isfinite(rett), rett, jnp.nan)
+        med = jnp.nanmedian(r_use)
+        mad = jnp.nanmedian(jnp.abs(r_use - med))
+        thresh = jnp.where(mad > 0, policy.mad_k * mad, jnp.inf)
+        out_cells = jnp.abs(r_use - med) > thresh   # NaN compares False
+        out_frac = jnp.sum(out_cells.astype(dtype)) / denom
+        r_out = out_frac > policy.max_outlier_frac
+
+        # 4. cap positivity: the regression weights are cap-derived; a
+        # non-positive or non-finite cap inside the universe is corrupt
+        r_cap = jnp.any(vt & (~jnp.isfinite(capt) | (capt <= 0)))
+
+        reasons = (
+            pre
+            | jnp.where(r_nan, jnp.uint32(REASON_NAN_DENSITY), jnp.uint32(0))
+            | jnp.where(r_uni, jnp.uint32(REASON_UNIVERSE_COLLAPSE),
+                        jnp.uint32(0))
+            | jnp.where(r_out, jnp.uint32(REASON_RET_OUTLIER), jnp.uint32(0))
+            | jnp.where(r_cap, jnp.uint32(REASON_CAP_NONPOS), jnp.uint32(0))
+        )
+        q_t = reasons != 0
+
+        # only healthy dates feed the trailing-universe reference
+        ring_upd = jax.lax.dynamic_update_index_in_dim(
+            ring, n_valid.astype(ring.dtype), pos, 0)
+        ring = jnp.where(q_t, ring, ring_upd)
+        pos = jnp.where(q_t, pos,
+                        (pos + jnp.int32(1)) % jnp.int32(ring.shape[0]))
+        reasons_acc = jax.lax.dynamic_update_index_in_dim(
+            reasons_acc, reasons, i, 0)
+        return ring, pos, reasons_acc
+
+    ring, ring_pos, reasons = jax.lax.fori_loop(
+        jnp.int32(0), jnp.int32(T), body,
+        (ring, ring_pos.astype(jnp.int32), jnp.zeros((T,), jnp.uint32)),
+    )
+    return reasons != 0, reasons, ring, ring_pos
+
+
+def host_date_reasons(dates, last_date=None) -> "object":
+    """Host-side pre-check: flag non-monotone / duplicate dates.
+
+    ``dates`` is the appended slab's date axis (any orderable values, e.g.
+    the normalized strings of :func:`mfm_tpu.pipeline.date_stamp`);
+    ``last_date`` the checkpoint's last served date.  Returns a (T,) uint32
+    numpy array with :data:`REASON_DATE_ORDER` set on every date that is
+    <= its predecessor (or <= ``last_date``) — those dates are quarantined
+    rather than folded into the carries, so one miswired feed day cannot
+    corrupt the time axis.  Host-side by design: string/object dates never
+    enter the traced step.
+    """
+    import numpy as np
+
+    out = np.zeros(len(dates), np.uint32)
+    prev = last_date
+    for i, d in enumerate(dates):
+        if prev is not None and not (d > prev):
+            out[i] = REASON_DATE_ORDER
+        else:
+            prev = d
+    return out

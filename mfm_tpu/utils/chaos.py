@@ -1,0 +1,225 @@
+"""Deterministic fault injection for the serving-hardening suite.
+
+Production daily-batch systems fail on bad days and bad restarts, not bad
+math.  This module makes both reproducible:
+
+- **Crash points** (:func:`chaos_point`): named markers compiled into the
+  checkpoint write path (data/artifacts.py).  Setting
+  ``MFM_CHAOS_KILL=<point>`` in a subprocess's environment SIGKILLs the
+  process AT that exact protocol step — a *deterministic* "kill -9 mid
+  write", no racy timers.  ``MFM_CHAOS_KILL_MATCH`` optionally restricts
+  the kill to paths containing a substring.  Zero cost when the variable
+  is unset (one dict lookup).
+- **Byte-level faults** (:func:`truncate_file`, :func:`corrupt_file`):
+  seeded truncation / bit-flips on an existing checkpoint, modelling torn
+  writes and silent media corruption.
+- **Data faults** (:func:`poison_nan`, :func:`poison_outliers`,
+  :func:`poison_universe`): seeded slab poisoning for the input-guard
+  checks (serve/guard.py).
+- **Transport faults** (:class:`FlakyStore`, :func:`flaky`): wrappers that
+  fail the first N calls with a chosen exception — the retry-path drill
+  for ``data/etl.py::with_retry``.
+- **Fault plans** (:func:`plan_suite`): the named, seeded scenario matrix
+  ``tools/faultinject.py`` and ``tests/test_chaos.py`` drive.
+
+Everything here is host-side tooling: nothing imports jax, nothing is
+traced, and the only coupling to the serving path is the two
+``chaos_point`` call sites in ``save_artifact``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+
+#: env var naming the crash point to SIGKILL at (e.g.
+#: ``save_artifact.after_tmp``); optional ``MFM_CHAOS_KILL_MATCH`` narrows
+#: to paths containing the given substring
+KILL_ENV = "MFM_CHAOS_KILL"
+KILL_MATCH_ENV = "MFM_CHAOS_KILL_MATCH"
+
+#: the crash points compiled into the write protocol, in order
+CRASH_POINTS = (
+    "save_artifact.after_tmp",     # tmp durable, final file still the old one
+    "save_artifact.after_rename",  # new file live, pointer not yet swapped
+)
+
+
+def chaos_point(name: str, path: str = "") -> None:
+    """SIGKILL this process iff ``MFM_CHAOS_KILL`` names this point (and
+    ``MFM_CHAOS_KILL_MATCH``, when set, is a substring of ``path``).
+
+    SIGKILL — not sys.exit, not an exception — because the contract under
+    test is crash *atomicity*: no cleanup handler may run, exactly like a
+    power cut or an OOM kill.
+    """
+    if os.environ.get(KILL_ENV) != name:
+        return
+    match = os.environ.get(KILL_MATCH_ENV)
+    if match and match not in path:
+        return
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+# -- byte-level checkpoint faults -------------------------------------------
+
+def truncate_file(path: str, n_bytes: int) -> int:
+    """Drop the last ``n_bytes`` of ``path`` (a torn tail write).  Returns
+    the new size."""
+    size = os.path.getsize(path)
+    new = max(0, size - int(n_bytes))
+    with open(path, "rb+") as f:
+        f.truncate(new)
+    return new
+
+
+def corrupt_file(path: str, n_bytes: int, seed: int) -> list[int]:
+    """Flip one bit in each of ``n_bytes`` seeded random positions of
+    ``path`` (silent media corruption).  Returns the offsets touched."""
+    import numpy as np
+
+    size = os.path.getsize(path)
+    rng = np.random.default_rng(seed)
+    offsets = sorted(int(o) for o in
+                     rng.choice(size, size=min(int(n_bytes), size),
+                                replace=False))
+    with open(path, "rb+") as f:
+        for off in offsets:
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ (1 << int(rng.integers(8)))]))
+    return offsets
+
+
+# -- slab data faults --------------------------------------------------------
+
+def poison_nan(ret, dates, frac: float = 1.0, seed: int = 0):
+    """NaN-poison a seeded ``frac`` of each listed date's return row.
+    ``ret`` is modified in place ((T, N) float array); returns it."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    for t in dates:
+        n = ret.shape[1]
+        k = max(1, int(round(frac * n)))
+        cols = rng.choice(n, size=k, replace=False)
+        ret[t, cols] = np.nan
+    return ret
+
+
+def poison_outliers(ret, dates, magnitude: float = 5.0, frac: float = 0.3,
+                    seed: int = 0):
+    """Blow up a seeded ``frac`` of each listed date's returns to
+    ``±magnitude`` (fat-finger / bad-split day).  In place; returns ret."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    for t in dates:
+        n = ret.shape[1]
+        k = max(1, int(round(frac * n)))
+        cols = rng.choice(n, size=k, replace=False)
+        ret[t, cols] = magnitude * rng.choice([-1.0, 1.0], size=k)
+    return ret
+
+
+def poison_universe(valid, dates, keep_frac: float = 0.2, seed: int = 0):
+    """Collapse the listed dates' universes to a seeded ``keep_frac`` of
+    their stocks (upstream join loss).  In place; returns valid."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    for t in dates:
+        idx = np.nonzero(valid[t])[0]
+        drop = rng.choice(idx, size=int(round((1 - keep_frac) * idx.size)),
+                          replace=False)
+        valid[t, drop] = False
+    return valid
+
+
+# -- transport faults --------------------------------------------------------
+
+def flaky(fn, n_failures: int, exc_factory=ConnectionError):
+    """Wrap ``fn`` to raise ``exc_factory(...)`` on its first ``n_failures``
+    calls, then behave normally — the deterministic transient-error source
+    for the ``with_retry`` drill."""
+    state = {"left": int(n_failures)}
+
+    def wrapped(*a, **kw):
+        if state["left"] > 0:
+            state["left"] -= 1
+            raise exc_factory(f"chaos: injected transient failure "
+                              f"({state['left']} more)")
+        return fn(*a, **kw)
+
+    return wrapped
+
+
+class FlakyStore:
+    """PanelStore proxy whose chosen methods fail the first N calls each
+    with a transient error, then delegate — exercised against
+    ``IncrementalUpdater``-style retry loops."""
+
+    def __init__(self, inner, n_failures: int = 2,
+                 methods: tuple = ("insert",), exc_factory=ConnectionError):
+        self._inner = inner
+        self._left = {m: int(n_failures) for m in methods}
+        self._exc = exc_factory
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name not in self._left or not callable(attr):
+            return attr
+
+        def wrapped(*a, **kw):
+            if self._left[name] > 0:
+                self._left[name] -= 1
+                raise self._exc(f"chaos: {name} transient failure")
+            return attr(*a, **kw)
+
+        return wrapped
+
+
+# -- the seeded fault-plan matrix -------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """One named, fully-seeded fault scenario.  ``kind`` selects the
+    mechanism; ``params`` feed it; ``seed`` pins every random choice so a
+    failing plan replays exactly."""
+
+    name: str
+    kind: str        # truncate | corrupt | kill | nan_slab | outlier_slab |
+                     # universe_slab | flaky_store
+    seed: int = 0
+    params: tuple = ()   # ((key, value), ...) — hashable, printable
+
+    def param(self, key, default=None):
+        return dict(self.params).get(key, default)
+
+
+def plan_suite(seed: int = 0) -> tuple:
+    """The standard recovery matrix: every mechanism at least once, seeds
+    derived from ``seed`` so the whole suite replays deterministically."""
+    s = int(seed)
+    return (
+        FaultPlan("truncate-tail-64", "truncate", s + 1,
+                  (("n_bytes", 64),)),
+        FaultPlan("truncate-half", "truncate", s + 2,
+                  (("frac", 0.5),)),
+        FaultPlan("corrupt-8-bytes", "corrupt", s + 3,
+                  (("n_bytes", 8),)),
+        FaultPlan("kill-after-tmp", "kill", s + 4,
+                  (("point", "save_artifact.after_tmp"),)),
+        FaultPlan("kill-after-rename", "kill", s + 5,
+                  (("point", "save_artifact.after_rename"),)),
+        FaultPlan("nan-slab", "nan_slab", s + 6,
+                  (("frac", 1.0),)),
+        FaultPlan("outlier-slab", "outlier_slab", s + 7,
+                  (("magnitude", 5.0), ("frac", 0.3))),
+        FaultPlan("universe-collapse", "universe_slab", s + 8,
+                  (("keep_frac", 0.2),)),
+        FaultPlan("flaky-store", "flaky_store", s + 9,
+                  (("n_failures", 2),)),
+    )

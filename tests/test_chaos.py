@@ -1,0 +1,256 @@
+"""Fault-injection drills for the checkpoint write/load protocol and the
+transport retry path (mfm_tpu/utils/chaos.py, data/artifacts.py fencing,
+data/etl.py::with_retry).
+
+The fast subset (no marker) runs in tier-1: byte-fault detection, fencing
+refusal/heal, retry-schedule determinism — all in-process, no jax.  The
+real crash drills — SIGKILL-ing a subprocess at a named protocol point —
+carry ``chaos`` (and ``slow``): run them with ``pytest -m chaos``.  The
+full recovery matrix, including bitwise-resume assertions over the risk
+pipeline, lives in ``tools/faultinject.py``.
+"""
+
+import os
+import shutil
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mfm_tpu.data.artifacts import (
+    ArtifactCorruptError,
+    ArtifactStaleError,
+    load_artifact,
+    read_pointer,
+    save_artifact,
+)
+from mfm_tpu.data.etl import with_retry
+from mfm_tpu.utils.chaos import (
+    FlakyStore,
+    chaos_point,
+    corrupt_file,
+    flaky,
+    plan_suite,
+    truncate_file,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _save(path, gen_payload=0, fenced=True):
+    save_artifact(path, {"x": np.arange(12.0) + gen_payload,
+                         "y": np.eye(3)},
+                  {"kind": "test", "note": gen_payload}, fenced=fenced)
+
+
+# -- byte-level faults (fast, tier-1) ---------------------------------------
+
+def test_truncation_is_detected(tmp_path):
+    p = str(tmp_path / "a.npz")
+    _save(p)
+    truncate_file(p, 64)
+    with pytest.raises(ArtifactCorruptError):
+        load_artifact(p)
+
+
+def test_bit_corruption_is_detected(tmp_path):
+    p = str(tmp_path / "a.npz")
+    _save(p)
+    offsets = corrupt_file(p, 8, seed=3)
+    assert len(offsets) == 8
+    with pytest.raises(ArtifactCorruptError):
+        load_artifact(p, fenced=True)
+
+
+def test_force_never_bypasses_corruption_checks(tmp_path):
+    """``force`` overrides FENCING only; a checksum mismatch is still a
+    refusal — forcing a load must never hand back corrupt arrays."""
+    p = str(tmp_path / "a.npz")
+    _save(p)
+    corrupt_file(p, 8, seed=4)
+    with pytest.raises(ArtifactCorruptError):
+        load_artifact(p, fenced=True, force=True)
+
+
+# -- generation fencing (fast, tier-1) --------------------------------------
+
+def test_stale_generation_refused_then_forced(tmp_path):
+    p = str(tmp_path / "state.npz")
+    backup = str(tmp_path / "state.gen1.bak")
+    _save(p, 1)
+    shutil.copy2(p, backup)
+    _save(p, 2)
+    _, meta = load_artifact(p, fenced=True)
+    assert meta["generation"] == 2
+
+    # yesterday's backup restored over today's file: generation 1 < pointer 2
+    shutil.copy2(backup, p)
+    with pytest.raises(ArtifactStaleError):
+        load_artifact(p, fenced=True)
+    arrays, meta = load_artifact(p, fenced=True, force=True)
+    assert meta["generation"] == 1 and meta["note"] == 1
+    np.testing.assert_array_equal(arrays["x"], np.arange(12.0) + 1)
+
+
+def test_pointer_heals_forward(tmp_path):
+    """File generation AHEAD of the pointer (a crash between rename and
+    pointer swap) is the torn-write recovery case: the load accepts the
+    file and advances the pointer to match."""
+    import json
+
+    p = str(tmp_path / "state.npz")
+    _save(p, 1)
+    _save(p, 2)
+    # rewind the pointer to generation 1, as if the swap never happened
+    ptr = str(tmp_path / "latest.json")
+    with open(ptr) as f:
+        table = json.load(f)
+    table["state.npz"]["generation"] = 1
+    with open(ptr, "w") as f:
+        json.dump(table, f)
+
+    _, meta = load_artifact(p, fenced=True)
+    assert meta["generation"] == 2
+    assert read_pointer(p)["generation"] == 2, "pointer must heal forward"
+
+
+# -- retry / transport faults (fast, tier-1) --------------------------------
+
+def test_with_retry_exponential_jitter_schedule():
+    sleeps = []
+    fn = flaky(lambda: "ok", n_failures=2)
+    got = with_retry(fn, attempts=4, backoff_s=0.25, sleep=sleeps.append,
+                     exponential=True, jitter=0.5, seed=11,
+                     retryable=(ConnectionError,))
+    assert got == "ok"
+    assert len(sleeps) == 2
+    for i, d in enumerate(sleeps):
+        base = 0.25 * 2.0 ** i
+        assert 0.5 * base <= d <= 1.5 * base, (i, d)
+    # seeded: the same outage replays the same schedule
+    sleeps2 = []
+    with_retry(flaky(lambda: "ok", n_failures=2), attempts=4, backoff_s=0.25,
+               sleep=sleeps2.append, exponential=True, jitter=0.5, seed=11,
+               retryable=(ConnectionError,))
+    assert sleeps2 == sleeps
+
+
+def test_with_retry_nonretryable_raises_immediately():
+    calls = []
+    sleeps = []
+
+    def fn():
+        calls.append(1)
+        raise TypeError("programming error — retrying cannot fix this")
+
+    with pytest.raises(TypeError):
+        with_retry(fn, attempts=5, backoff_s=0.25, sleep=sleeps.append,
+                   retryable=(ConnectionError, TimeoutError))
+    assert len(calls) == 1 and sleeps == []
+
+
+def test_with_retry_exhaustion_reraises_last():
+    fn = flaky(lambda: "ok", n_failures=99)
+    sleeps = []
+    with pytest.raises(ConnectionError):
+        with_retry(fn, attempts=3, backoff_s=0.0, sleep=sleeps.append,
+                   retryable=(ConnectionError,))
+    assert len(sleeps) == 2  # no sleep after the final attempt
+
+
+def test_flaky_store_fails_then_delegates():
+    class Store:
+        def __init__(self):
+            self.rows = 0
+
+        def insert(self, name, df, unique=None):
+            self.rows += 1
+            return self.rows
+
+        def last_date(self, name):
+            return "2020-01-02"
+
+    inner = Store()
+    st = FlakyStore(inner, n_failures=2, methods=("insert",))
+    assert st.last_date("t") == "2020-01-02"  # un-wrapped methods untouched
+    for _ in range(2):
+        with pytest.raises(ConnectionError):
+            st.insert("t", None)
+    assert st.insert("t", None) == 1 and inner.rows == 1
+
+
+def test_plan_suite_is_deterministic():
+    a, b = plan_suite(5), plan_suite(5)
+    assert a == b
+    names = [p.name for p in a]
+    assert len(set(names)) == len(names)
+    assert {p.kind for p in a} == {"truncate", "corrupt", "kill", "nan_slab",
+                                   "outlier_slab", "universe_slab",
+                                   "flaky_store"}
+    assert len({p.seed for p in a}) == len(a)
+
+
+def test_chaos_point_is_inert_when_unset(monkeypatch):
+    monkeypatch.delenv("MFM_CHAOS_KILL", raising=False)
+    chaos_point("save_artifact.after_tmp", "/any/path")  # must not kill us
+    monkeypatch.setenv("MFM_CHAOS_KILL", "save_artifact.after_tmp")
+    monkeypatch.setenv("MFM_CHAOS_KILL_MATCH", "no-such-substring")
+    chaos_point("save_artifact.after_tmp", "/any/path")  # match gate holds
+
+
+# -- real crash drills (subprocess SIGKILL; pytest -m chaos) ----------------
+
+_SAVE_SCRIPT = """\
+import sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+from mfm_tpu.data.artifacts import save_artifact
+save_artifact({path!r}, {{"x": np.arange(12.0) + {stamp}}},
+              {{"kind": "test", "note": {stamp}}}, fenced=True)
+"""
+
+
+def _save_in_subprocess(path, stamp, kill_at=None):
+    env = dict(os.environ)
+    env.pop("MFM_CHAOS_KILL", None)
+    env.pop("MFM_CHAOS_KILL_MATCH", None)
+    if kill_at:
+        env["MFM_CHAOS_KILL"] = kill_at
+    return subprocess.run(
+        [sys.executable, "-c",
+         _SAVE_SCRIPT.format(repo=REPO, path=path, stamp=stamp)],
+        env=env, capture_output=True, text=True, timeout=120)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_sigkill_after_tmp_preserves_old_checkpoint(tmp_path):
+    p = str(tmp_path / "state.npz")
+    assert _save_in_subprocess(p, 1).returncode == 0
+
+    proc = _save_in_subprocess(p, 2, kill_at="save_artifact.after_tmp")
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    arrays, meta = load_artifact(p, fenced=True)
+    assert meta["note"] == 1 and meta["generation"] == 1
+    np.testing.assert_array_equal(arrays["x"], np.arange(12.0) + 1)
+    # the retried write wins cleanly over the torn tmp
+    assert _save_in_subprocess(p, 2).returncode == 0
+    _, meta = load_artifact(p, fenced=True)
+    assert meta["note"] == 2 and meta["generation"] == 2
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_sigkill_after_rename_heals_pointer(tmp_path):
+    p = str(tmp_path / "state.npz")
+    assert _save_in_subprocess(p, 1).returncode == 0
+
+    proc = _save_in_subprocess(p, 2, kill_at="save_artifact.after_rename")
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    # new file live, pointer still at generation 1 — load accepts and heals
+    assert read_pointer(p)["generation"] == 1
+    arrays, meta = load_artifact(p, fenced=True)
+    assert meta["note"] == 2 and meta["generation"] == 2
+    assert read_pointer(p)["generation"] == 2

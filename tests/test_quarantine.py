@@ -1,0 +1,314 @@
+"""Quarantine / degraded-mode serving (the guarded daily-update path).
+
+The production contract (serve/guard.py, RiskModel.update_guarded): a date
+that trips an input guard is QUARANTINED — it never enters the Newey-West /
+vol-regime EWMA carries, so the carry after (good, BAD, good) equals the
+carry after (good, good) BITWISE, and the serving layer hands out the last
+healthy covariance with an explicit staleness counter.  A clean slab must
+pass through the guards bitwise-untouched: guarded serving costs nothing
+when nothing is wrong.
+
+Everything here is assert_array_equal, not a tolerance — same discipline as
+tests/test_risk_state.py, whose donation rules also apply (guarded updates
+donate panels, carries AND guard leaves; copy states before reuse).
+"""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mfm_tpu.config import QuarantinePolicy, RiskModelConfig
+from mfm_tpu.data.artifacts import load_risk_state, save_risk_state
+from mfm_tpu.models.risk_model import RiskModel
+from mfm_tpu.serve.guard import (
+    REASON_CAP_NONPOS,
+    REASON_DATE_ORDER,
+    REASON_NAN_DENSITY,
+    REASON_RET_OUTLIER,
+    REASON_UNIVERSE_COLLAPSE,
+    host_date_reasons,
+    reason_names,
+)
+from mfm_tpu.utils.contracts import assert_max_compiles
+
+T, N, P, Q = 48, 24, 4, 3
+K = 1 + P + Q
+GCFG = RiskModelConfig(eigen_n_sims=8, eigen_sim_length=48,
+                       quarantine=QuarantinePolicy(enabled=True))
+UCFG = RiskModelConfig(eigen_n_sims=8, eigen_sim_length=48)
+
+
+def _panels(seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.normal(0, 0.02, (T, N)),
+        rng.lognormal(10, 1, (T, N)),
+        rng.normal(size=(T, N, Q)),
+        rng.integers(0, P, (T, N)),
+        rng.random((T, N)) > 0.05,
+    )
+
+
+def _model(panels, sl=slice(None), cfg=GCFG):
+    # fresh OWNED device arrays per call: the fused steps donate their
+    # inputs, and jnp.asarray can zero-copy a same-dtype numpy view (the
+    # bool valid panel) — donating that alias lets XLA scribble over the
+    # fixture's memory.  jnp.array always copies.
+    return RiskModel(*(jnp.array(np.asarray(p)[sl]) for p in panels),
+                     n_industries=P, config=cfg)
+
+
+def _copy(state):
+    return jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), state)
+
+
+def _carries(state):
+    return jax.tree_util.tree_leaves(
+        (state.nw_carry, state.vr_num, state.vr_den))
+
+
+def _assert_outputs_equal(got, want, msg, rows=None):
+    """Bitwise equality over output fields, optionally on a row subset."""
+    for i, name in enumerate(want._fields):
+        g, w = np.asarray(got[i]), np.asarray(want[i])
+        if rows is not None:
+            g, w = g[rows[0]], w[rows[1]]
+        np.testing.assert_array_equal(g, w, err_msg=f"{msg}: {name}")
+
+
+def _assert_carries_equal(a, b, msg):
+    for i, (x, y) in enumerate(zip(_carries(a), _carries(b))):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=f"{msg}: carry leaf {i}")
+
+
+def _assert_guard_equal(a, b, msg):
+    """Degraded-mode leaves, except quarantine_count (a run that excised a
+    bad date has counted it; the run that never saw it has not)."""
+    for f in ("last_good_cov", "staleness", "guard_ring", "guard_ring_pos"):
+        np.testing.assert_array_equal(np.asarray(getattr(a, f)),
+                                      np.asarray(getattr(b, f)),
+                                      err_msg=f"{msg}: {f}")
+
+
+def _poison_nan(panels, t, frac=0.6):
+    """NaN-poison date ``t``: ``frac`` of the universe's returns go
+    non-finite while valid stays True (a poisoned feed, not a thin one)."""
+    ret = np.array(panels[0], copy=True)
+    ret[t, : int(round(frac * N))] = np.nan
+    return (ret,) + tuple(panels[1:])
+
+
+@pytest.fixture(scope="module")
+def panels():
+    return _panels()
+
+
+@pytest.fixture(scope="module")
+def pref(panels):
+    """Clean guarded prefix: outputs + checkpoint after the first 20 dates."""
+    return _model(panels, slice(0, 20), cfg=GCFG).init_state()
+
+
+def test_clean_guarded_run_is_bitwise_unguarded(panels):
+    """Guards on a healthy feed are free: the guarded init and a guarded
+    slab update produce outputs BITWISE equal to the unguarded path, nothing
+    is quarantined, and served_cov is vr_cov untouched at eigen-valid
+    dates."""
+    T0 = 20
+    out_u, st_u = _model(panels, cfg=UCFG).init_state()
+    out_g, _ = _model(panels, cfg=GCFG).init_state()
+    _assert_outputs_equal(out_g, out_u, "guarded init vs unguarded init")
+
+    _, gst = _model(panels, slice(0, T0), cfg=GCFG).init_state()
+    _, ust = _model(panels, slice(0, T0), cfg=UCFG).init_state()
+    o_u, _ = _model(panels, slice(T0, T), cfg=UCFG).update(ust)
+    o_g, rep, gst2 = _model(panels, slice(T0, T), cfg=GCFG).update_guarded(gst)
+    _assert_outputs_equal(o_g, o_u, "guarded slab vs unguarded slab")
+    assert not np.asarray(rep.quarantined).any()
+    assert int(np.asarray(gst2.quarantine_count)) == 0
+
+    ev = np.asarray(o_g.eigen_valid, bool)
+    assert ev.any()
+    np.testing.assert_array_equal(
+        np.asarray(rep.served_cov)[ev], np.asarray(o_g.vr_cov)[ev],
+        err_msg="served_cov must be vr_cov bitwise at eigen-valid dates")
+    np.testing.assert_array_equal(np.asarray(rep.staleness)[ev], 0)
+
+
+# a poisoned date at absolute index 1 sits inside the q=2 Newey-West lag
+# warmup, at index 5 inside the t <= K (=8) invalid region, at 25 in plain
+# mid-history — the excision must be bitwise at every boundary
+@pytest.mark.parametrize("T0,off", [(1, 0), (2, 3), (20, 5), (40, 6)])
+def test_quarantined_date_is_excised_bitwise(panels, T0, off):
+    """The carry contract: a guarded run over (.., good, BAD, good, ..)
+    lands on the SAME carries — bitwise — as a run whose feed never
+    contained the bad date, and every healthy date's outputs match that
+    never-saw-it run row for row."""
+    t_bad = T0 + off
+    bad = _poison_nan(panels, t_bad)
+
+    _, st = _model(panels, slice(0, T0), cfg=GCFG).init_state()
+    o_g, rep, st_g = _model(bad, slice(T0, T), cfg=GCFG).update_guarded(
+        _copy(st))
+
+    q = np.asarray(rep.quarantined)
+    assert q[off] and q.sum() == 1, "exactly the poisoned date quarantines"
+    assert int(np.asarray(rep.reasons)[off]) & REASON_NAN_DENSITY
+
+    # reference: the same slab with the bad date cut out of the feed
+    keep = np.r_[T0:t_bad, t_bad + 1:T]
+    o_r, rep_r, st_r = _model(panels, keep, cfg=GCFG).update_guarded(
+        _copy(st))
+    assert not np.asarray(rep_r.quarantined).any()
+
+    healthy = np.r_[0:off, off + 1:T - T0]
+    _assert_outputs_equal(o_g, o_r, f"T0={T0} off={off} healthy rows",
+                          rows=(healthy, slice(None)))
+    _assert_carries_equal(st_g, st_r, f"T0={T0} off={off}")
+    _assert_guard_equal(st_g, st_r, f"T0={T0} off={off}")
+    assert int(np.asarray(st_g.quarantine_count)) == 1
+
+
+def test_reason_bits_per_check(panels, pref):
+    """Each guard trips its own bit, and only its own, on a single-date
+    slab: NaN density, return outliers, universe collapse, non-positive
+    caps, and the host-side date-order pre-check."""
+    _, st = pref
+    t = 20  # the first un-fitted date
+
+    def verdict(mod_panels, pre=None):
+        _, rep, _ = _model(mod_panels, slice(t, t + 1), cfg=GCFG).\
+            update_guarded(_copy(st), pre_reasons=pre)
+        return int(np.asarray(rep.reasons)[0])
+
+    ret, cap, styles, ind, valid = (np.array(p, copy=True) for p in panels)
+
+    nan = _poison_nan(panels, t)
+    assert verdict(nan) == REASON_NAN_DENSITY
+    assert reason_names(REASON_NAN_DENSITY) == ["nan_density"]
+
+    out_ret = np.array(ret, copy=True)
+    out_ret[t, : N // 4] += 50.0  # ~25% of cells at ~2500 MADs
+    assert verdict((out_ret, cap, styles, ind, valid)) == REASON_RET_OUTLIER
+
+    thin = np.array(valid, copy=True)
+    thin[t] = False
+    thin[t, :3] = True  # 3 of ~23 — far below half the trailing median
+    assert verdict((ret, cap, styles, ind, thin)) == REASON_UNIVERSE_COLLAPSE
+
+    bad_cap = np.array(cap, copy=True)
+    bad_cap[t, 5] = -1.0
+    assert verdict((ret, bad_cap, styles, ind, valid)) == REASON_CAP_NONPOS
+
+    pre = host_date_reasons(["2020-01-02"], last_date="2020-01-02")
+    assert verdict(panels, pre=pre) == REASON_DATE_ORDER
+
+
+def test_staleness_counts_and_served_cov(panels, pref):
+    """Across (good, BAD, BAD, good): staleness reads 0, 1, 2, 0; both bad
+    dates serve the good date's covariance bitwise; the recovery date
+    serves its own."""
+    _, st = pref
+    bad = _poison_nan(_poison_nan(panels, 21), 22)
+    o, rep, _ = _model(bad, slice(20, 24), cfg=GCFG).update_guarded(_copy(st))
+
+    np.testing.assert_array_equal(np.asarray(rep.quarantined),
+                                  [False, True, True, False])
+    np.testing.assert_array_equal(np.asarray(rep.staleness), [0, 1, 2, 0])
+    vr = np.asarray(o.vr_cov)
+    served = np.asarray(rep.served_cov)
+    np.testing.assert_array_equal(served[1], vr[0])
+    np.testing.assert_array_equal(served[2], vr[0])
+    np.testing.assert_array_equal(served[3], vr[3])
+
+
+def test_guard_leaves_survive_npz_roundtrip(panels, pref, tmp_path):
+    """A guarded checkpoint written to disk resumes bitwise: guard leaves
+    round-trip exactly and a guarded update from the loaded state matches
+    the in-process continuation, verdicts included."""
+    _, st = pref
+    p = str(tmp_path / "state.npz")
+    save_risk_state(p, _copy(st))
+    loaded, meta = load_risk_state(p)
+    assert meta["kind"] == "risk_state"
+    assert loaded.guarded
+    _assert_guard_equal(loaded, st, "roundtrip")
+    np.testing.assert_array_equal(np.asarray(loaded.quarantine_count),
+                                  np.asarray(st.quarantine_count))
+
+    bad = _poison_nan(panels, 23)
+    o_mem, rep_mem, st_mem = _model(bad, slice(20, 26), cfg=GCFG).\
+        update_guarded(_copy(st))
+    o_dsk, rep_dsk, st_dsk = _model(bad, slice(20, 26), cfg=GCFG).\
+        update_guarded(loaded)
+    _assert_outputs_equal(o_dsk, o_mem, "disk-vs-memory guarded update")
+    np.testing.assert_array_equal(np.asarray(rep_dsk.quarantined),
+                                  np.asarray(rep_mem.quarantined))
+    np.testing.assert_array_equal(np.asarray(rep_dsk.served_cov),
+                                  np.asarray(rep_mem.served_cov))
+    _assert_carries_equal(st_dsk, st_mem, "disk-vs-memory carry")
+    _assert_guard_equal(st_dsk, st_mem, "disk-vs-memory guard")
+
+
+def test_changed_policy_rejects_checkpoint(panels, pref):
+    """Quarantine thresholds are math identity (they decide which dates
+    enter the EWMA sums): a checkpoint fitted under one policy must refuse
+    to continue under another."""
+    _, st = pref
+    retuned = RiskModelConfig(
+        eigen_n_sims=8, eigen_sim_length=48,
+        quarantine=QuarantinePolicy(enabled=True, mad_k=5.0))
+    with pytest.raises(ValueError, match="stamp"):
+        _model(panels, slice(20, T), cfg=retuned).update_guarded(_copy(st))
+
+
+def test_update_guarded_refusals(panels, pref):
+    """update_guarded refuses a quarantine-disabled config outright, and a
+    state lacking the degraded-mode leaves (initialized unguarded)."""
+    _, st = pref
+    with pytest.raises(ValueError, match="quarantine.enabled"):
+        _model(panels, slice(20, T), cfg=UCFG).update_guarded(_copy(st))
+
+    stripped = dataclasses.replace(
+        _copy(st), last_good_cov=None, staleness=None, quarantine_count=None,
+        guard_ring=None, guard_ring_pos=None)
+    assert not stripped.guarded
+    with pytest.raises(ValueError, match="degraded-mode leaves"):
+        _model(panels, slice(20, T), cfg=GCFG).update_guarded(stripped)
+
+
+def test_guarded_daily_loop_compiles_once(panels, pref):
+    """The guarded serving loop keeps the compile-once contract — and a
+    quarantine verdict mid-loop must NOT retrace (the verdict is data, not
+    program structure)."""
+    _, st = pref
+    bad = _poison_nan(panels, 24)
+    st_seq = _copy(st)
+    # warm the single-date guarded signature
+    _, _, st_seq = _model(bad, slice(20, 21), cfg=GCFG).update_guarded(st_seq)
+    hits = 0
+    with assert_max_compiles(1, what="guarded daily loop"):
+        for t in range(21, 28):
+            _, rep, st_seq = _model(bad, slice(t, t + 1), cfg=GCFG).\
+                update_guarded(st_seq)
+            hits += int(np.asarray(rep.quarantined)[0])
+    assert hits == 1, "the poisoned date must quarantine inside the loop"
+    assert int(np.asarray(st_seq.quarantine_count)) == 1
+
+
+def test_host_date_reasons_flags_order_violations():
+    """Non-monotone and duplicate dates get REASON_DATE_ORDER; the monotone
+    subsequence survives (a flagged date does not become the new
+    watermark)."""
+    out = host_date_reasons(
+        ["2020-01-02", "2020-01-02", "2020-01-03", "2020-01-01"],
+        last_date="2020-01-01")
+    np.testing.assert_array_equal(
+        out, [0, REASON_DATE_ORDER, 0, REASON_DATE_ORDER])
+    assert host_date_reasons(["2020-01-02"], last_date="2020-01-02")[0] \
+        == REASON_DATE_ORDER
+    assert not host_date_reasons(["2020-01-02", "2020-01-03"]).any()

@@ -39,8 +39,11 @@ def _panels(seed=0):
 
 
 def _model(panels, sl=slice(None), cfg=CFG):
-    # fresh device arrays per call: init_state/update donate their inputs
-    return RiskModel(*(jnp.asarray(np.asarray(p)[sl]) for p in panels),
+    # fresh OWNED device arrays per call: init_state/update donate their
+    # inputs, and jnp.asarray can zero-copy a same-dtype numpy view (the
+    # bool valid panel) — donating that alias lets XLA scribble over the
+    # fixture's memory.  jnp.array always copies.
+    return RiskModel(*(jnp.array(np.asarray(p)[sl]) for p in panels),
                      n_industries=P, config=cfg)
 
 

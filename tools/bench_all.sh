@@ -8,6 +8,12 @@
 # Configs (bench.py): default = config 1 (risk model e2e, the driver metric),
 # beta, factors, alla, alpha.  Each prints ONE JSON line; a dead TPU tunnel
 # falls back to CPU with an `errors` field rather than hanging.
+#
+# The config-1 record also carries the serving metrics: daily_update_latency_s
+# (one-date append to the resumable state), guarded_update_latency_s +
+# guard_overhead_frac (the same append through the production input guards,
+# docs/SERVING.md), and the observed quarantine_rate (0.0 on the clean
+# synthetic panel — the guards-are-free evidence).
 set -eo pipefail
 cd "$(dirname "$0")/.."
 out=${1:-/tmp/bench_all}

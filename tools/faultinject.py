@@ -1,0 +1,507 @@
+"""Deterministic fault-injection harness for the hardened serving loop.
+
+Drives the seeded scenario matrix (``mfm_tpu/utils/chaos.py::plan_suite``)
+against a real daily-serving sequence — synthetic history -> fenced
+checkpoint -> per-slab ``append_risk_pipeline`` updates — and asserts the
+recovery contracts the production loop promises (docs/SERVING.md):
+
+- **Torn / corrupt checkpoints** (truncate-*, corrupt-*): the fenced load
+  refuses the damaged file with :class:`ArtifactCorruptError`; restoring
+  the previous generation and replaying the append reproduces the
+  fault-free run BITWISE.
+- **Crash mid-write** (kill-*): a real ``mfm-tpu risk --update`` subprocess
+  is SIGKILLed at a named protocol point (``MFM_CHAOS_KILL``).  Killed
+  after the tmp write: the old checkpoint still loads and the replay is
+  bitwise the fault-free run.  Killed after the rename (pointer not yet
+  swapped): the NEW checkpoint loads, the pointer heals forward, and the
+  subsequent slab is bitwise the fault-free run — proving the subprocess's
+  checkpoint is interchangeable with the in-process one.
+- **Poisoned slabs** (nan-slab, outlier-slab, universe-collapse): the bad
+  date is quarantined with a reported reason, and every healthy date's
+  outputs — plus the final carries — are bitwise what a run that NEVER SAW
+  the poisoned date produces (the carry-freeze contract).
+- **Flaky transport** (flaky-store): ``with_retry`` over a
+  :class:`FlakyStore` recovers from transient errors on the documented
+  backoff schedule and re-raises non-retryable errors immediately.
+- **Steady state**: after warmup, the per-date guarded serving loop stays
+  within ONE jit compile (``assert_max_compiles``).
+
+Everything is seeded (fault plans, synthetic panel); a failing plan
+replays exactly.  Exit 0 iff every plan passes; ``--out`` writes the JSON
+report.
+
+    JAX_PLATFORMS=cpu python tools/faultinject.py --out /tmp/faults.json
+    python tools/faultinject.py --plans nan-slab,kill-after-tmp
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+# harness geometry: enough dates for warmup + three 4-date serving slabs,
+# small enough that the whole matrix runs in minutes on CPU
+T_TOTAL, T_HIST, SLAB = 44, 32, 4
+N_STOCKS, N_IND, N_STYLES = 20, 3, 2
+EIGEN_SIMS = 8
+
+
+def _config():
+    from mfm_tpu.config import PipelineConfig, QuarantinePolicy, RiskModelConfig
+
+    # eigen_sim_length pinned: a checkpoint freezes its Monte-Carlo draws,
+    # and only a pinned length keeps the replay on the same draws
+    return PipelineConfig(
+        risk=RiskModelConfig(eigen_n_sims=EIGEN_SIMS, eigen_sim_length=T_TOTAL,
+                             quarantine=QuarantinePolicy(enabled=True)),
+        dtype="float32",
+    )
+
+
+def _make_tables(seed: int):
+    """Synthetic barra table split into history + cumulative slab tables
+    (``append_risk_pipeline`` takes the full table and serves the dates
+    past the checkpoint)."""
+    from mfm_tpu.data.synthetic import synthetic_barra_table
+
+    df, _ = synthetic_barra_table(T=T_TOTAL, N=N_STOCKS, P=N_IND,
+                                  Q=N_STYLES, seed=seed)
+    dates = sorted(df["date"].unique())
+    cuts = [dates[T_HIST - 1]] + [dates[T_HIST + (i + 1) * SLAB - 1]
+                                  for i in range((T_TOTAL - T_HIST) // SLAB)]
+    hist = df[df["date"] <= cuts[0]]
+    slabs = [df[df["date"] <= c] for c in cuts[1:]]
+    slab_dates = [dates[T_HIST + i * SLAB: T_HIST + (i + 1) * SLAB]
+                  for i in range(len(slabs))]
+    return df, hist, slabs, slab_dates
+
+
+def _carries(state):
+    import jax
+
+    # copy=True: on CPU the numpy conversion can alias the device buffer,
+    # and these snapshots must outlive the donating update calls that
+    # recycle it
+    return [np.array(x, copy=True) for x in jax.tree_util.tree_leaves(
+        (state.nw_carry, state.vr_num, state.vr_den))]
+
+
+def _outputs_by_date(res):
+    """{date -> {field -> (row,) array}} over the appended slab."""
+    from mfm_tpu.pipeline import date_stamp
+
+    out = {}
+    for i, d in enumerate(res.arrays.dates):
+        out[date_stamp(d)] = {
+            f: np.array(np.asarray(getattr(res.outputs, f))[i], copy=True)
+            for f in res.outputs._fields}
+    return out
+
+
+def _init_checkpoint(workdir: str, hist, cfg) -> str:
+    from mfm_tpu.pipeline import run_risk_pipeline, save_pipeline_state
+
+    res = run_risk_pipeline(barra_df=hist, config=cfg, with_state=True)
+    path = os.path.join(workdir, "state.npz")
+    save_pipeline_state(path, res)
+    return path
+
+
+def _append(path: str, table, cfg, force: bool = False):
+    from mfm_tpu.pipeline import append_risk_pipeline, save_pipeline_state
+
+    res = append_risk_pipeline(path, table, config=cfg, force=force)
+    save_pipeline_state(path, res)
+    return res
+
+
+def _snapshot(workdir: str, tag: str):
+    """Copy the checkpoint AND its fencing pointer as one consistent pair."""
+    snap = os.path.join(workdir, f"snap_{tag}")
+    os.makedirs(snap, exist_ok=True)
+    for f in ("state.npz", "latest.json"):
+        shutil.copy(os.path.join(workdir, f), os.path.join(snap, f))
+    return snap
+
+
+def _restore(workdir: str, snap: str, pointer: bool = True):
+    shutil.copy(os.path.join(snap, "state.npz"),
+                os.path.join(workdir, "state.npz"))
+    if pointer:
+        shutil.copy(os.path.join(snap, "latest.json"),
+                    os.path.join(workdir, "latest.json"))
+
+
+def _assert_outputs_equal(got: dict, want: dict, dates, what: str):
+    for d in dates:
+        for f, w in want[d].items():
+            g = got[d][f]
+            if not np.array_equal(g, w, equal_nan=True):
+                raise AssertionError(
+                    f"{what}: output {f!r} at {d} diverged from the "
+                    f"fault-free run (max |diff| "
+                    f"{np.nanmax(np.abs(g.astype(np.float64) - w.astype(np.float64)))})")
+
+
+def _assert_carries_equal(got, want, what: str):
+    for i, (g, w) in enumerate(zip(got, want)):
+        if not np.array_equal(g, w, equal_nan=True):
+            raise AssertionError(
+                f"{what}: carry leaf {i} diverged from the fault-free run")
+
+
+class Baseline:
+    """The fault-free serving sequence, snapshotted after every stage so a
+    plan can start from any point with a consistent (file, pointer) pair."""
+
+    def __init__(self, workdir: str, seed: int):
+        self.cfg = _config()
+        self.full, self.hist, self.slabs, self.slab_dates = _make_tables(seed)
+        self.dir = os.path.join(workdir, "baseline")
+        os.makedirs(self.dir)
+        self.path = _init_checkpoint(self.dir, self.hist, self.cfg)
+        self.snaps = [_snapshot(self.dir, "hist")]
+        self.outputs, self.reports, self.carries = [], [], []
+        for i, table in enumerate(self.slabs):
+            res = _append(self.path, table, self.cfg)
+            if res.report is None:
+                raise AssertionError("baseline lost its guard report")
+            q = np.asarray(res.report.quarantined)
+            if q.any():
+                raise AssertionError(
+                    f"baseline slab {i} quarantined {int(q.sum())} clean "
+                    "date(s) — guard thresholds are mis-tuned for the "
+                    "synthetic panel")
+            self.outputs.append(_outputs_by_date(res))
+            self.reports.append(res.report)
+            self.carries.append(_carries(res.state))
+            self.snaps.append(_snapshot(self.dir, f"slab{i}"))
+
+
+def _fresh_workdir(root: str, plan_name: str, snap: str) -> str:
+    d = os.path.join(root, plan_name)
+    os.makedirs(d)
+    _restore(d, snap)
+    return d
+
+
+# -- plan runners ------------------------------------------------------------
+
+def run_byte_fault(plan, base: Baseline, root: str) -> dict:
+    """truncate-* / corrupt-*: damaged checkpoint refused, previous
+    generation replays bitwise."""
+    from mfm_tpu.data.artifacts import ArtifactCorruptError, load_risk_state
+    from mfm_tpu.utils.chaos import corrupt_file, truncate_file
+
+    d = _fresh_workdir(root, plan.name, base.snaps[1])  # state after slab 0
+    path = os.path.join(d, "state.npz")
+    if plan.kind == "truncate":
+        frac = plan.param("frac")
+        n = (int(frac * os.path.getsize(path)) if frac is not None
+             else int(plan.param("n_bytes")))
+        truncate_file(path, n)
+    else:
+        corrupt_file(path, int(plan.param("n_bytes")), plan.seed)
+    try:
+        load_risk_state(path)
+    except ArtifactCorruptError as err:
+        detected = str(err)
+    else:
+        raise AssertionError(f"{plan.name}: corrupt checkpoint loaded clean")
+    # recovery: previous generation (the slab-0 producer's input) + replay
+    _restore(d, base.snaps[0])
+    res = _append(path, base.slabs[0], base.cfg)
+    _assert_outputs_equal(_outputs_by_date(res), base.outputs[0],
+                          base.slab_dates[0], plan.name)
+    _assert_carries_equal(_carries(res.state), base.carries[0], plan.name)
+    return {"detected": detected.split(" — ")[0]}
+
+
+def run_kill(plan, base: Baseline, root: str) -> dict:
+    """kill-*: SIGKILL a real `risk --update` subprocess at a protocol
+    point, then prove the recovery the fence promises."""
+    from mfm_tpu.data.artifacts import load_risk_state, read_pointer
+
+    point = plan.param("point")
+    d = _fresh_workdir(root, plan.name, base.snaps[0])  # state after history
+    path = os.path.join(d, "state.npz")
+    table_csv = os.path.join(d, "slab0.csv")
+    base.slabs[0].to_csv(table_csv, index=False)
+    cmd = [sys.executable, "-m", "mfm_tpu.cli", "risk",
+           "--barra", table_csv, "--update", path, "--quarantine",
+           "--eigen-sims", str(EIGEN_SIMS),
+           "--eigen-sim-length", str(T_TOTAL),
+           "--out", os.path.join(d, "tables")]
+    env = {**os.environ, "MFM_CHAOS_KILL": point, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": os.path.dirname(os.path.dirname(
+               os.path.abspath(__file__)))}
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=600)
+    if proc.returncode != -signal.SIGKILL:
+        raise AssertionError(
+            f"{plan.name}: expected the subprocess to die by SIGKILL at "
+            f"{point}, got rc={proc.returncode}\n{proc.stderr[-2000:]}")
+    state, meta = load_risk_state(path)  # fenced: must load clean
+    ptr = read_pointer(path)
+    if point == "save_artifact.after_tmp":
+        # old checkpoint must be intact and untouched; replay is bitwise
+        if meta["last_date"] != str(base.hist["date"].max()):
+            raise AssertionError(f"{plan.name}: checkpoint advanced past a "
+                                 "write that never completed")
+        res = _append(path, base.slabs[0], base.cfg)
+        _assert_outputs_equal(_outputs_by_date(res), base.outputs[0],
+                              base.slab_dates[0], plan.name)
+        _assert_carries_equal(_carries(res.state), base.carries[0], plan.name)
+        healed = False
+    else:  # after_rename: new file live, pointer was stale -> healed forward
+        if meta["last_date"] != base.slab_dates[0][-1]:
+            raise AssertionError(f"{plan.name}: renamed checkpoint does not "
+                                 "carry the appended dates")
+        if read_pointer(path)["generation"] != meta["generation"]:
+            raise AssertionError(f"{plan.name}: pointer not healed forward")
+        ptr = read_pointer(path)
+        # the subprocess's checkpoint must be interchangeable with the
+        # in-process one: carries bitwise, and the NEXT slab bitwise
+        _assert_carries_equal(_carries(state), base.carries[0],
+                              f"{plan.name} (subprocess checkpoint)")
+        res = _append(path, base.slabs[1], base.cfg)
+        _assert_outputs_equal(_outputs_by_date(res), base.outputs[1],
+                              base.slab_dates[1], plan.name)
+        healed = True
+    return {"killed_at": point, "pointer": ptr, "pointer_healed": healed}
+
+
+_POISON_OK_REASONS = {
+    # NaN returns are dropped by the frame->arrays conversion, so a
+    # NaN-poisoned CSV date manifests as universe collapse downstream of
+    # the ETL; the raw-array nan_density path is proven in
+    # tests/test_quarantine.py
+    "nan_slab": {"nan_density", "universe_collapse"},
+    "outlier_slab": {"ret_outlier"},
+    "universe_slab": {"universe_collapse"},
+}
+
+
+def run_poison(plan, base: Baseline, root: str) -> dict:
+    """nan-slab / outlier-slab / universe-collapse: the poisoned date is
+    quarantined with a reported reason; healthy dates and the final carries
+    are bitwise a run that never saw it."""
+    from mfm_tpu.serve.guard import reason_names
+
+    rng = np.random.default_rng(plan.seed)
+    bad_date = base.slab_dates[0][2]
+    table = base.slabs[0].copy()
+    mask = table["date"] == bad_date
+    stocks = table.loc[mask, "stocknames"].unique()
+    if plan.kind == "nan_slab":
+        # 60% of the date's stocks, not the plan's full frac: all-NaN rows
+        # would drop the DATE itself in the frame->arrays conversion and
+        # the guard would never see it
+        hit = rng.choice(stocks, size=max(1, int(round(0.6 * len(stocks)))),
+                         replace=False)
+        table.loc[mask & table["stocknames"].isin(hit), "ret"] = np.nan
+    elif plan.kind == "outlier_slab":
+        k = max(1, int(round(float(plan.param("frac", 0.3)) * len(stocks))))
+        hit = rng.choice(stocks, size=k, replace=False)
+        sel = mask & table["stocknames"].isin(hit)
+        table.loc[sel, "ret"] = 0.5 * rng.choice([-1.0, 1.0], size=int(sel.sum()))
+    else:  # universe_slab
+        keep = float(plan.param("keep_frac", 0.2))
+        hit = rng.choice(stocks, size=int(round((1 - keep) * len(stocks))),
+                         replace=False)
+        table = table[~(mask & table["stocknames"].isin(hit))]
+
+    d = _fresh_workdir(root, plan.name, base.snaps[0])
+    path = os.path.join(d, "state.npz")
+    res = _append(path, table, base.cfg)
+    rep = res.report
+    by_date = _outputs_by_date(res)
+    dates = [s for s in by_date]
+    q = {dt: bool(np.asarray(rep.quarantined)[i])
+         for i, dt in enumerate(dates)}
+    if not q.get(bad_date):
+        raise AssertionError(f"{plan.name}: poisoned date {bad_date} was "
+                             "NOT quarantined")
+    reasons = reason_names(int(np.asarray(rep.reasons)[dates.index(bad_date)]))
+    if not set(reasons) & _POISON_OK_REASONS[plan.kind]:
+        raise AssertionError(
+            f"{plan.name}: expected a reason in "
+            f"{sorted(_POISON_OK_REASONS[plan.kind])}, got {reasons}")
+    healthy = [dt for dt in dates if not q[dt]]
+    if [dt for dt in dates if q[dt]] != [bad_date]:
+        raise AssertionError(f"{plan.name}: quarantined more than the "
+                             f"poisoned date: {[d for d in dates if q[d]]}")
+    # the carry-freeze contract: a run that NEVER saw the poisoned date
+    d2 = _fresh_workdir(root, plan.name + "-ref", base.snaps[0])
+    ref = _append(os.path.join(d2, "state.npz"),
+                  base.slabs[0][base.slabs[0]["date"] != bad_date], base.cfg)
+    _assert_outputs_equal(by_date, _outputs_by_date(ref), healthy, plan.name)
+    _assert_carries_equal(_carries(res.state), _carries(ref.state), plan.name)
+    # served_cov at the quarantined date is the last healthy covariance
+    served = np.asarray(rep.served_cov)[dates.index(bad_date)]
+    prev = by_date[healthy[1]]  # the healthy date right before bad_date
+    if not np.array_equal(served,
+                          np.asarray(rep.served_cov)[dates.index(healthy[1])]):
+        raise AssertionError(f"{plan.name}: degraded serve is not the last "
+                             "healthy covariance")
+    del prev
+    stale = int(np.asarray(rep.staleness)[dates.index(bad_date)])
+    return {"quarantined": bad_date, "reasons": reasons, "staleness": stale}
+
+
+def run_flaky_store(plan, base: Baseline, root: str) -> dict:
+    """flaky-store: with_retry + FlakyStore recover on the documented
+    schedule; non-retryable errors surface immediately."""
+    import pandas as pd
+
+    from mfm_tpu.data.etl import PanelStore, with_retry
+    from mfm_tpu.utils.chaos import FlakyStore
+
+    d = os.path.join(root, plan.name)
+    os.makedirs(d)
+    store = PanelStore(os.path.join(d, "store"))
+    n_failures = int(plan.param("n_failures", 2))
+    fs = FlakyStore(store, n_failures=n_failures, methods=("insert",))
+    df = pd.DataFrame({"ts_code": ["a", "b"], "trade_date": [1, 1],
+                       "x": [1.0, 2.0]})
+    delays = []
+    inserted = with_retry(
+        lambda: fs.insert("t", df, unique=("ts_code", "trade_date")),
+        attempts=n_failures + 1, backoff_s=0.25, sleep=delays.append,
+        exponential=True, jitter=0.5, seed=plan.seed,
+        retryable=(ConnectionError,))
+    if inserted != 2 or len(store.read("t")) != 2:
+        raise AssertionError(f"{plan.name}: retries did not complete the "
+                             f"insert (inserted={inserted})")
+    if len(delays) != n_failures:
+        raise AssertionError(f"{plan.name}: expected {n_failures} backoff "
+                             f"sleeps, saw {len(delays)}")
+    for i, dl in enumerate(delays):
+        lo, hi = 0.25 * 2 ** i * 0.5, 0.25 * 2 ** i * 1.5
+        if not lo <= dl <= hi:
+            raise AssertionError(f"{plan.name}: delay {i} = {dl} outside "
+                                 f"the jittered exponential band [{lo}, {hi}]")
+    # a non-retryable error must pass through with zero sleeps
+    bombs = FlakyStore(store, n_failures=1, methods=("insert",),
+                       exc_factory=TypeError)
+    hard_delays = []
+    try:
+        with_retry(lambda: bombs.insert("t", df), attempts=3, backoff_s=0.25,
+                   sleep=hard_delays.append, retryable=(ConnectionError,))
+    except TypeError:
+        pass
+    else:
+        raise AssertionError(f"{plan.name}: non-retryable error was retried")
+    if hard_delays:
+        raise AssertionError(f"{plan.name}: slept before re-raising a "
+                             "non-retryable error")
+    return {"injected_failures": n_failures,
+            "backoff_schedule_s": [round(x, 4) for x in delays]}
+
+
+def run_steady_state(base: Baseline, root: str) -> dict:
+    """After warmup, the per-date guarded serving loop compiles at most
+    once across an arbitrary number of dates (the <=1-compile contract)."""
+    from mfm_tpu.utils.contracts import assert_max_compiles
+
+    full = base.full
+    dates = sorted(full["date"].unique())
+    d = _fresh_workdir(root, "steady-state", base.snaps[0])
+    path = os.path.join(d, "state.npz")
+    # warmup: first 1-date append compiles the (T=1)-shaped guarded step
+    _append(path, full[full["date"] <= dates[T_HIST]], base.cfg)
+    with assert_max_compiles(1, "steady-state guarded serving loop") as c:
+        for t in range(T_HIST + 1, T_HIST + 4):
+            _append(path, full[full["date"] <= dates[t]], base.cfg)
+    return {"dates_served": 3, "compiles": c.count}
+
+
+RUNNERS = {"truncate": run_byte_fault, "corrupt": run_byte_fault,
+           "kill": run_kill, "nan_slab": run_poison,
+           "outlier_slab": run_poison, "universe_slab": run_poison,
+           "flaky_store": run_flaky_store}
+
+
+def main(argv=None) -> int:
+    from mfm_tpu.utils.chaos import plan_suite
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--seed", type=int, default=0,
+                    help="master seed for the panel AND the fault plans")
+    ap.add_argument("--plans", default=None,
+                    help="comma-separated plan names (default: all, plus "
+                         "the steady-state compile check)")
+    ap.add_argument("--out", default=None, metavar="FILE.json",
+                    help="write the full JSON report here too")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch directory for post-mortems")
+    args = ap.parse_args(argv)
+
+    plans = plan_suite(args.seed)
+    if args.plans:
+        want = set(args.plans.split(","))
+        unknown = want - {p.name for p in plans} - {"steady-state"}
+        if unknown:
+            raise SystemExit(f"unknown plan(s): {sorted(unknown)} "
+                             f"(have: {[p.name for p in plans]})")
+        plans = tuple(p for p in plans if p.name in want)
+
+    root = tempfile.mkdtemp(prefix="mfm_faultinject_")
+    results = []
+    try:
+        t0 = time.perf_counter()
+        base = Baseline(root, args.seed)
+        baseline_s = time.perf_counter() - t0
+        for plan in plans:
+            t0 = time.perf_counter()
+            rec = {"plan": plan.name, "kind": plan.kind, "seed": plan.seed}
+            try:
+                rec.update(RUNNERS[plan.kind](plan, base, root))
+                rec["status"] = "pass"
+            except AssertionError as err:
+                rec["status"] = "FAIL"
+                rec["error"] = str(err)
+            rec["wall_s"] = round(time.perf_counter() - t0, 3)
+            results.append(rec)
+            print(json.dumps(rec), flush=True)
+        if args.plans is None or "steady-state" in args.plans:
+            t0 = time.perf_counter()
+            rec = {"plan": "steady-state", "kind": "compile_contract"}
+            try:
+                rec.update(run_steady_state(base, root))
+                rec["status"] = "pass"
+            except AssertionError as err:
+                rec["status"] = "FAIL"
+                rec["error"] = str(err)
+            rec["wall_s"] = round(time.perf_counter() - t0, 3)
+            results.append(rec)
+            print(json.dumps(rec), flush=True)
+    finally:
+        if args.keep:
+            print(f"scratch kept at {root}", file=sys.stderr)
+        else:
+            shutil.rmtree(root, ignore_errors=True)
+
+    failed = [r["plan"] for r in results if r["status"] != "pass"]
+    summary = {"plans": len(results), "failed": failed,
+               "baseline_wall_s": round(baseline_s, 3)}
+    print(json.dumps(summary))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump({"summary": summary, "results": results}, fh, indent=1)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
