@@ -202,11 +202,14 @@ def bench_riskmodel():
     # the observed quarantine_rate doubles as the guards-are-free evidence.
     import dataclasses as _dcg
     from mfm_tpu.config import QuarantinePolicy
+    from mfm_tpu.obs import instrument as _telemetry
+    from mfm_tpu.obs.metrics import REGISTRY
     gcfg = _dcg.replace(cfg, quarantine=QuarantinePolicy(enabled=True))
     rm_gh = RiskModel(*[_prefix(a) for a in args], n_industries=P, config=gcfg)
     _, gstate0 = rm_gh.init_state(sim_covs=jnp.array(sim_covs, copy=True),
                                   sim_length=T)
-    quarantined = []
+
+    last_report = []
 
     def guarded_update_step():
         st = jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True),
@@ -214,11 +217,31 @@ def bench_riskmodel():
         fresh = [jnp.array(a[-1:], copy=True) for a in args]
         m = RiskModel(*fresh, n_industries=P, config=gcfg)
         out, rep, _ = m.update_guarded(st)
-        quarantined.append(float(np.asarray(rep.quarantined).mean()))
+        # exactly what the production loop records per served date
+        _telemetry.record_guard_report(rep)
+        last_report[:] = [rep]
         return _checksum(out) + jnp.sum(rep.staleness)
 
+    # production latency WITH telemetry (the serving loop's configuration)
     gupd_s = _time3(guarded_update_step)
-    quarantine_rate = float(np.mean(quarantined)) if quarantined else None
+    _telemetry.record_update_latency(gupd_s)
+    # the telemetry overhead claim (docs/OBSERVABILITY.md: <= 1% of the
+    # guarded update) is measured, not asserted — and measured DIRECTLY:
+    # the per-date recording (guard-report tallies + latency observe) timed
+    # alone on the already-materialized report, as a fraction of the step.
+    # Differencing two ~ms jit walls would bury a ~30 us cost in scheduler
+    # noise; timing the host-only recording isolates it exactly.
+    rep0 = last_report[0]
+    reps = 200
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        _telemetry.record_guard_report(rep0)
+        _telemetry.record_update_latency(gupd_s)
+    telemetry_s = (time.perf_counter() - t0) / reps
+    telemetry_overhead = telemetry_s / gupd_s
+    gsum = _telemetry.guard_summary_from_registry()
+    quarantine_rate = (gsum["quarantine_rate"] if gsum["served_dates"]
+                       else None)
 
     # per-stage split (VERDICT r3 weak #4): each stage jitted alone with its
     # real inputs passed as jit ARGUMENTS (closed-over arrays would embed as
@@ -277,18 +300,31 @@ def bench_riskmodel():
         "eigen": compiled_memory(eig_f, *args, nw_cov, nw_valid, sim_covs),
         "vol_regime": compiled_memory(
             vr_f, *args, factor_ret, eigen_cov, eigen_valid),
+        "eigen_unchunked": compiled_memory(
+            eigen_fn(None), *args, nw_cov, nw_valid, sim_covs),
     }
-    eig_unchunked_mem = compiled_memory(
-        eigen_fn(None), *args, nw_cov, nw_valid, sim_covs)
+    # device-memory watermarks flow through the registry
+    # (mfm_compiled_bytes{stage,kind}) and the JSON record below reads the
+    # gauges back — one source of truth for bench output and a scrape
+    for k, v in stage_mem.items():
+        _telemetry.record_compiled_memory(k, v)
+    scal = REGISTRY.scalar_values()
+
+    def _mem_bytes(stage, kind):
+        v = scal.get(f"mfm_compiled_bytes{{stage={stage},kind={kind}}}")
+        return None if v is None else int(v)
+
     auto_chunk = auto_eigen_chunk(T, M, K, itemsize=4)
+    stages4 = ("regression", "newey_west", "eigen", "vol_regime")
     mem_rec = {
-        "stages_temp_bytes": {k: v.get("temp_bytes")
-                              for k, v in stage_mem.items()},
-        "stages_peak_bytes": {k: v.get("peak_bytes")
-                              for k, v in stage_mem.items()},
+        "stages_temp_bytes": {k: _mem_bytes(k, "temp_bytes")
+                              for k in stages4},
+        "stages_peak_bytes": {k: _mem_bytes(k, "peak_bytes")
+                              for k in stages4},
         "eigen_auto_chunk": auto_chunk,
-        "eigen_unchunked_temp_bytes": eig_unchunked_mem.get("temp_bytes"),
-        "eigen_auto_temp_bytes": stage_mem["eigen"].get("temp_bytes"),
+        "eigen_unchunked_temp_bytes": _mem_bytes("eigen_unchunked",
+                                                 "temp_bytes"),
+        "eigen_auto_temp_bytes": _mem_bytes("eigen", "temp_bytes"),
     }
     if mem_rec["eigen_unchunked_temp_bytes"] and \
             mem_rec["eigen_auto_temp_bytes"]:
@@ -305,13 +341,26 @@ def bench_riskmodel():
             _force(fused_step())
 
     from mfm_tpu.models.eigen import sim_sweeps_for
-    stage_s = {"regression": reg_s, "newey_west": nw_s, "eigen": eig_s,
-               "vol_regime": vr_s}
+    # every wall number lands in the registry first and the JSON record is
+    # assembled from the registry's flat view — bench output and a metrics
+    # scrape can never disagree
+    for name, s in (("fused_e2e", tpu_s), ("daily_update", upd_s),
+                    ("guarded_update", gupd_s), ("regression", reg_s),
+                    ("newey_west", nw_s), ("eigen", eig_s),
+                    ("vol_regime", vr_s)):
+        _telemetry.record_stage_seconds(name, s)
+    scal = REGISTRY.scalar_values()
+
+    def _stage_s(name):
+        return scal[f"mfm_stage_seconds{{stage={name}}}"]
+
+    stage_s = {k: _stage_s(k) for k in stages4}
     models = _riskmodel_stage_models(
         T, N, P, Q, K, M, sweeps=sim_sweeps_for(K, jnp.float32, T))
 
     cpu_s = _cpu_baseline_riskmodel((T, N, P, Q, K, M), args)
-    return {"metric": "csi300_riskmodel_e2e_wall", "value": round(tpu_s, 4),
+    return {"metric": "csi300_riskmodel_e2e_wall",
+            "value": round(_stage_s("fused_e2e"), 4),
             "unit": "s", "vs_baseline": round(cpu_s / tpu_s, 2),
             # the denominator is the golden-NumPy serial proxy timed on
             # subsamples and extrapolated (statsmodels absent) — a LOWER
@@ -326,13 +375,16 @@ def bench_riskmodel():
             # the incremental serving metrics: latency of appending ONE date
             # to a (T-1)-date resumable state (RiskModel.update) vs
             # rebuilding the whole history (the e2e number above)
-            "daily_update_latency_s": round(upd_s, 4),
+            "daily_update_latency_s": round(_stage_s("daily_update"), 4),
             "update_dates_per_sec": round(1.0 / upd_s),
             "update_speedup_vs_e2e": round(tpu_s / upd_s, 1),
             # the guarded (production) serving path: input guards +
-            # degraded-mode quarantine run inside the same fused step
-            "guarded_update_latency_s": round(gupd_s, 4),
+            # degraded-mode quarantine run inside the same fused step,
+            # WITH per-date telemetry recording (the production loop's
+            # configuration); the frac below is its measured cost
+            "guarded_update_latency_s": round(_stage_s("guarded_update"), 4),
             "guard_overhead_frac": round(gupd_s / upd_s - 1.0, 4),
+            "telemetry_overhead_frac": round(telemetry_overhead, 4),
             # fraction of served dates quarantined during the timed runs —
             # 0.0 on the clean synthetic panel (guards must cost nothing
             # and flag nothing when nothing is wrong)

@@ -140,6 +140,67 @@ def _report_json(res) -> dict:
     }
 
 
+def _metrics_init(args) -> None:
+    """``--metrics-dir``: route the JSONL event stream there and start the
+    compile-event counter (telemetry records regardless; this adds sinks)."""
+    mdir = getattr(args, "metrics_dir", None)
+    if not mdir:
+        return
+    from mfm_tpu.obs.exporters import emit_event, route_events_to
+    from mfm_tpu.obs.instrument import watch_compiles
+
+    os.makedirs(mdir, exist_ok=True)
+    route_events_to(os.path.join(mdir, "events.jsonl"))
+    watch_compiles()
+    emit_event("info", "run_start", cmd=args.cmd)
+
+
+def _metrics_flush(args) -> None:
+    """``--metrics-dir``: write the Prometheus textfile + snapshot JSON."""
+    mdir = getattr(args, "metrics_dir", None)
+    if not mdir:
+        return
+    from mfm_tpu.obs.exporters import emit_event, write_prometheus_textfile
+    from mfm_tpu.obs.metrics import snapshot_json
+
+    write_prometheus_textfile(os.path.join(mdir, "metrics.prom"))
+    with open(os.path.join(mdir, "metrics.json"), "w") as fh:
+        fh.write(snapshot_json() + "\n")
+    emit_event("info", "run_end", cmd=args.cmd)
+
+
+def _write_manifest_beside(state_path: str, res) -> dict:
+    """After a checkpoint save: run-manifest next to it (atomic), carrying
+    the checkpoint's identity stamp, the guard verdict summary, the live
+    metrics snapshot, and the model-health verdict.  Returns the health
+    dict.  This is the CLI layer on purpose: the health monitors compile
+    their own small programs, which must never ride the ≤1-compile
+    steady-state update path."""
+    import jax
+
+    from mfm_tpu.data.artifacts import _stamp_to_json
+    from mfm_tpu.obs.health import evaluate_health
+    from mfm_tpu.obs.instrument import guard_summary_from_registry
+    from mfm_tpu.obs.manifest import (
+        build_run_manifest, manifest_path_for, write_run_manifest,
+    )
+    from mfm_tpu.obs.metrics import REGISTRY
+
+    guard = guard_summary_from_registry()
+    health = evaluate_health(res.outputs, guard_summary=guard)
+    manifest = build_run_manifest(
+        stamp_json=(_stamp_to_json(res.state.stamp)
+                    if res.state is not None else None),
+        checkpoint=state_path,
+        backend=jax.devices()[0].platform,
+        metrics_snapshot=REGISTRY.snapshot(),
+        guard_summary=guard,
+        health=health,
+    )
+    write_run_manifest(manifest_path_for(state_path), manifest)
+    return health
+
+
 def _risk(args):
     import numpy as np
     import pandas as pd
@@ -161,6 +222,7 @@ def _risk(args):
         # bias statistics need history; an appended slab has none
         raise SystemExit("--update serves new dates only — run the bias "
                          "acceptance tests on a full-history run instead")
+    _metrics_init(args)
 
     cfg = PipelineConfig(
         risk=RiskModelConfig(
@@ -226,6 +288,10 @@ def _risk(args):
         _write_result_tables(res, args.out, args.specific_risk)
         save_pipeline_state(args.update, res)  # advance the checkpoint
         wall = time.perf_counter() - t0
+        from mfm_tpu.obs.instrument import record_stage_seconds
+
+        record_stage_seconds("update_total", wall)
+        health = _write_manifest_beside(args.update, res)
         if args.save_outputs:
             _save_outputs_npz(res, args.out,
                               args.barra or args.barra_store)
@@ -237,9 +303,11 @@ def _risk(args):
             "update_wall_s": round(wall, 3),
             "mean_r2": float(np.nanmean(np.asarray(res.outputs.r2))),
             "state": args.update,
+            "health": health["status"],
         }
         if res.report is not None:
             rec.update(_report_json(res))
+        _metrics_flush(args)
         print(json.dumps(rec))
         return
 
@@ -251,6 +319,9 @@ def _risk(args):
                                 with_state=bool(args.save_state))
     _write_result_tables(res, args.out, args.specific_risk)
     wall = time.perf_counter() - t0
+    from mfm_tpu.obs.instrument import record_stage_seconds
+
+    record_stage_seconds("risk_full", wall)
     if args.save_state:
         # checkpoint the resumable scan state (outside the timed region,
         # like the artifact/plot writes below); `risk --update FILE` serves
@@ -258,6 +329,7 @@ def _risk(args):
         from mfm_tpu.pipeline import save_pipeline_state
 
         save_pipeline_state(args.save_state, res)
+        _write_manifest_beside(args.save_state, res)
     if args.save_outputs:
         # the full (T, K, K) covariance series + every stage output as one
         # artifact (the CSV tables only carry the last date's covariance,
@@ -289,6 +361,7 @@ def _risk(args):
     # reference only runs the eigen-portfolio variant
     _maybe_portfolio_bias(res, args)
     _maybe_portfolio_risk(res, args)
+    _metrics_flush(args)
     print(json.dumps({
         "dates": int(arrays.ret.shape[0]), "stocks": int(arrays.ret.shape[1]),
         "factors": len(arrays.factor_names()), "wall_s": round(wall, 3),
@@ -674,6 +747,7 @@ def _pipeline(args):
     if args.append and args.nw_method != "scan":
         raise SystemExit("the resumable state is the serial scan's carry; "
                          "--append needs --nw-method scan")
+    _metrics_init(args)
     cfg = PipelineConfig(
         risk=RiskModelConfig(
             nw_lags=args.nw_lags, nw_half_life=args.nw_half_life,
@@ -771,12 +845,19 @@ def _pipeline(args):
                                     with_state=cfg.risk.nw_method == "scan")
     _write_result_tables(res, args.out, args.specific_risk)
     wall = time.perf_counter() - t0
+    from mfm_tpu.obs.instrument import record_stage_seconds
+
+    record_stage_seconds("factor", factor_wall)
+    record_stage_seconds("pipeline_total", wall)
     _save_outputs_npz(res, args.out, args.store)  # outside the timed region
+    health = None
     if res.state is not None:
         # the daily-serving checkpoint `pipeline --append` resumes from
         from mfm_tpu.pipeline import save_pipeline_state
 
-        save_pipeline_state(os.path.join(args.out, "risk_state.npz"), res)
+        state_path = os.path.join(args.out, "risk_state.npz")
+        save_pipeline_state(state_path, res)
+        health = _write_manifest_beside(state_path, res)
     # acceptance-test compute stays OUT of the reported wall (same policy
     # as _risk's bias block)
     _maybe_portfolio_bias(res, args)
@@ -792,11 +873,14 @@ def _pipeline(args):
         "alpha_styles": n_alpha_styles,
         "out": args.out,
     }
+    if health is not None:
+        rec["health"] = health["status"]
     if appended is not None:
         rec["appended_dates"] = appended
         rec["update_wall_s"] = round(update_wall, 3)
     if res.report is not None:
         rec.update(_report_json(res))
+    _metrics_flush(args)
     print(json.dumps(rec))
 
 
@@ -1146,7 +1230,7 @@ def _doctor(args):
     else:
         raise SystemExit(f"{args.path}: not found")
 
-    records, unhealthy = [], 0
+    records, unhealthy, metas = [], 0, {}
     for p in paths:
         rec = {"file": p, "status": "ok", "problems": [], "warnings": []}
         records.append(rec)
@@ -1160,6 +1244,7 @@ def _doctor(args):
             rec["status"] = "corrupt"
             rec["problems"].append(str(err))
             continue
+        metas[os.path.basename(p)] = meta
         rec["kind"] = meta.get("kind", "raw")
         rec["arrays"] = len(arrays)
         if meta.get("sha256") is None:
@@ -1209,10 +1294,116 @@ def _doctor(args):
         if rec["problems"]:
             rec["status"] = "unhealthy" if rec["status"] == "ok" \
                 else rec["status"]
+
+    # the newest run manifest, when one sits beside the artifacts: schema,
+    # health field, and stamp-vs-checkpoint identity (a mismatch means the
+    # directory mixes artifacts from different runs)
+    man_dir = (args.path if os.path.isdir(args.path)
+               else os.path.dirname(args.path) or ".")
+    mpath = os.path.join(man_dir, "run_manifest.json")
+    if os.path.exists(mpath):
+        from mfm_tpu.obs.manifest import ManifestError, read_run_manifest
+
+        rec = {"file": mpath, "kind": "run_manifest", "status": "ok",
+               "problems": [], "warnings": []}
+        records.append(rec)
+        try:
+            man = read_run_manifest(mpath)
+        except ManifestError as err:
+            rec["status"] = "corrupt"
+            rec["problems"].append(str(err))
+        else:
+            rec["health"] = man["health"].get("status")
+            ckpt = man.get("checkpoint")
+            meta = metas.get(ckpt)
+            if ckpt and meta is None:
+                rec["problems"].append(
+                    f"manifest names checkpoint {ckpt!r}, which is missing "
+                    "or failed its own audit")
+            elif meta is not None \
+                    and man.get("config_stamp") != meta.get("stamp"):
+                rec["problems"].append(
+                    "manifest config_stamp does not match the checkpoint's "
+                    "identity stamp — artifacts from different runs in one "
+                    "directory")
+            if rec["health"] == "degraded":
+                rec["warnings"].append(
+                    "model health was degraded at manifest write time "
+                    "(see manifest health.checks)")
+            if rec["problems"]:
+                rec["status"] = "unhealthy"
     unhealthy = sum(r["status"] != "ok" for r in records)
     print(json.dumps({"audited": len(records), "unhealthy": unhealthy,
                       "records": records}, indent=1))
     raise SystemExit(1 if unhealthy else 0)
+
+
+def _metrics_paths(path: str, filename: str) -> str:
+    """Resolve a metrics artifact: PATH itself when it's a file, else
+    PATH/<filename>."""
+    p = os.path.join(path, filename) if os.path.isdir(path) else path
+    if not os.path.exists(p):
+        raise SystemExit(f"{p}: not found — run with --metrics-dir first")
+    return p
+
+
+def _load_metrics_snapshot(path: str) -> dict:
+    p = _metrics_paths(path, "metrics.json")
+    try:
+        with open(p, encoding="utf-8") as fh:
+            snap = json.load(fh)
+    except ValueError as err:
+        raise SystemExit(f"{p}: not valid JSON ({err})") from err
+    if not isinstance(snap, dict) or snap.get("schema") != 1 \
+            or not isinstance(snap.get("metrics"), dict):
+        raise SystemExit(f"{p}: not a metrics snapshot (schema 1)")
+    return snap
+
+
+def _snapshot_scalars(snap: dict) -> dict:
+    """Flatten a snapshot to {series key -> value} for diffing: counters/
+    gauges by value, histograms by their _count and _sum."""
+    out = {}
+    for name, m in snap["metrics"].items():
+        for s in m.get("series", []):
+            lbl = ",".join(f"{k}={v}" for k, v in sorted(s["labels"].items()))
+            key = f"{name}{{{lbl}}}" if lbl else name
+            if m.get("type") == "histogram":
+                out[key + ":count"] = s.get("count", 0)
+                out[key + ":sum"] = s.get("sum", 0.0)
+            else:
+                out[key] = s.get("value")
+    return out
+
+
+def _metrics(args):
+    """dump: print + parse-validate the Prometheus textfile; snapshot:
+    print the validated snapshot JSON; diff: per-series deltas between two
+    snapshots (counters/gauges by value, histograms by count/sum)."""
+    from mfm_tpu.obs.exporters import parse_prometheus
+
+    if args.action == "dump":
+        p = _metrics_paths(args.path, "metrics.prom")
+        with open(p, encoding="utf-8") as fh:
+            text = fh.read()
+        parse_prometheus(text)  # malformed exposition exits via ValueError
+        print(text, end="")
+        return
+    if args.action == "snapshot":
+        print(json.dumps(_load_metrics_snapshot(args.path), indent=1,
+                         sort_keys=True))
+        return
+    # diff
+    a = _snapshot_scalars(_load_metrics_snapshot(args.a))
+    b = _snapshot_scalars(_load_metrics_snapshot(args.b))
+    delta = {}
+    for key in sorted(set(a) | set(b)):
+        va, vb = a.get(key), b.get(key)
+        if va != vb:
+            delta[key] = {"a": va, "b": vb,
+                          "delta": (None if va is None or vb is None
+                                    else round(vb - va, 9))}
+    print(json.dumps({"changed": len(delta), "series": delta}, indent=1))
 
 
 def _lint_cmd(args):
@@ -1339,6 +1530,14 @@ def main(argv=None):
                    help="with --update: accept a checkpoint whose "
                         "generation is older than the latest.json pointer "
                         "(deliberate rollback; never bypasses the checksum)")
+    _metrics_dir_help = (
+        "write telemetry here: events.jsonl (structured event stream), "
+        "metrics.prom (Prometheus textfile exposition) and metrics.json "
+        "(snapshot, diffable with `mfm-tpu metrics diff`).  The run "
+        "manifest is independent of this flag — it always lands beside "
+        "the checkpoint.  docs/OBSERVABILITY.md")
+    r.add_argument("--metrics-dir", default=None, metavar="DIR",
+                   help=_metrics_dir_help)
     r.set_defaults(fn=_risk)
 
     f = sub.add_parser("factors", help="style-factor production (main.py path)")
@@ -1470,6 +1669,8 @@ def main(argv=None):
                     help="with --append: accept a checkpoint whose "
                          "generation is older than the latest.json pointer "
                          "(deliberate rollback; never bypasses the checksum)")
+    pl.add_argument("--metrics-dir", default=None, metavar="DIR",
+                    help=_metrics_dir_help)
     pl.set_defaults(fn=_pipeline)
 
     al = sub.add_parser("alpha",
@@ -1611,9 +1812,29 @@ def main(argv=None):
     em.add_argument("--token", default=None)
     em.set_defaults(fn=_etl_missing)
 
+    mt = sub.add_parser(
+        "metrics",
+        help="inspect telemetry artifacts a --metrics-dir run wrote "
+             "(docs/OBSERVABILITY.md)")
+    mts = mt.add_subparsers(dest="action", required=True)
+    md = mts.add_parser("dump",
+                        help="print a metrics.prom textfile after "
+                             "parse-validating the exposition format")
+    md.add_argument("path", help="metrics dir or .prom file")
+    msn = mts.add_parser("snapshot",
+                         help="print a validated metrics.json snapshot")
+    msn.add_argument("path", help="metrics dir or metrics.json file")
+    mdf = mts.add_parser("diff",
+                         help="per-series deltas between two snapshots "
+                              "(counters/gauges by value, histograms by "
+                              "count/sum)")
+    mdf.add_argument("a", help="older metrics dir or metrics.json")
+    mdf.add_argument("b", help="newer metrics dir or metrics.json")
+    mt.set_defaults(fn=_metrics)
+
     ln = sub.add_parser(
         "lint",
-        help="the JAX-doctrine linter (rules R1-R6, docs/DOCTRINE.md) over "
+        help="the JAX-doctrine linter (rules R1-R7, docs/DOCTRINE.md) over "
              "mfm_tpu/, bench.py and tools/")
     ln.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: mfm_tpu bench.py "
@@ -1630,8 +1851,9 @@ def main(argv=None):
     dr = sub.add_parser(
         "doctor",
         help="audit serving artifacts: payload checksums, fencing "
-             "generations vs latest.json, risk-state schema/stamp "
-             "(exit 1 on any problem; docs/SERVING.md)")
+             "generations vs latest.json, risk-state schema/stamp, and "
+             "the run manifest beside them (schema/stamp-match/health; "
+             "exit 1 on any problem; docs/SERVING.md)")
     dr.add_argument("path",
                     help=".npz artifact or a directory of them (e.g. a "
                          "pipeline OUT dir or checkpoint dir)")

@@ -12,12 +12,14 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import time
 import zipfile
 import zlib
 from typing import Mapping
 
 import numpy as np
 
+from mfm_tpu.obs import instrument as _telemetry
 from mfm_tpu.utils.chaos import chaos_point
 
 FORMAT_VERSION = 1
@@ -126,6 +128,7 @@ def save_artifact(path: str, arrays: Mapping[str, object],
     directory's ``latest.json`` pointer after the rename; loaders then
     refuse generations older than the pointer (:func:`load_artifact`).
     """
+    t0 = time.perf_counter()
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     payload = {k: np.asarray(v) for k, v in arrays.items()}
     meta = dict(meta or {})
@@ -160,6 +163,9 @@ def save_artifact(path: str, arrays: Mapping[str, object],
     chaos_point("save_artifact.after_rename", path)
     if fenced:
         _swap_pointer(path, generation, file_sha)
+        _telemetry.CHECKPOINT_GENERATION.set_value(generation)
+    _telemetry.CHECKPOINT_SAVES_TOTAL.inc()
+    _telemetry.CHECKPOINT_SAVE_SECONDS.observe(time.perf_counter() - t0)
 
 
 def load_artifact(path: str, *, fenced: bool = False, force: bool = False):
@@ -175,18 +181,21 @@ def load_artifact(path: str, *, fenced: bool = False, force: bool = False):
     pointer swap — the file is complete (it passed its checksum), so the
     pointer is healed forward and the load succeeds.
     """
+    t0 = time.perf_counter()
     try:
         with np.load(path, allow_pickle=False) as z:
             arrays = {k: z[k] for k in z.files if k != "__meta__"}
             meta = (json.loads(bytes(z["__meta__"]).decode())
                     if "__meta__" in z.files else {})
     except (zipfile.BadZipFile, zlib.error, EOFError) as e:
+        _telemetry.CHECKPOINT_CORRUPT_TOTAL.inc()
         raise ArtifactCorruptError(
             f"{path}: truncated or corrupt npz ({e}) — suspected torn "
             f"write; recover from the previous generation or re-run the "
             f"producing stage (docs/SERVING.md)") from e
     except ValueError as e:
         # np.load raises bare ValueError on non-zip magic / header damage
+        _telemetry.CHECKPOINT_CORRUPT_TOTAL.inc()
         raise ArtifactCorruptError(
             f"{path}: unreadable artifact ({e}) — suspected torn write or "
             f"foreign file; recover per docs/SERVING.md") from e
@@ -196,6 +205,7 @@ def load_artifact(path: str, *, fenced: bool = False, force: bool = False):
     if want is not None:
         got = _payload_sha256(arrays)
         if got != want:
+            _telemetry.CHECKPOINT_CORRUPT_TOTAL.inc()
             raise ArtifactCorruptError(
                 f"{path}: payload sha256 mismatch (stored {want[:12]}…, "
                 f"recomputed {got[:12]}…) — corrupt or tampered artifact")
@@ -206,6 +216,7 @@ def load_artifact(path: str, *, fenced: bool = False, force: bool = False):
             ptr_gen = entry.get("generation")
             if isinstance(ptr_gen, int):
                 if gen < ptr_gen:
+                    _telemetry.CHECKPOINT_STALE_TOTAL.inc()
                     raise ArtifactStaleError(
                         f"{path}: generation {gen} is older than the "
                         f"latest.json pointer ({ptr_gen}) — stale state "
@@ -214,6 +225,11 @@ def load_artifact(path: str, *, fenced: bool = False, force: bool = False):
                 if gen > ptr_gen:
                     # crash between rename and pointer swap: heal forward
                     _swap_pointer(path, gen, _file_sha256(path))
+                    _telemetry.CHECKPOINT_HEAL_FORWARD_TOTAL.inc()
+    if fenced and isinstance(meta.get("generation"), int):
+        _telemetry.CHECKPOINT_GENERATION.set_value(meta["generation"])
+    _telemetry.CHECKPOINT_LOADS_TOTAL.inc()
+    _telemetry.CHECKPOINT_LOAD_SECONDS.observe(time.perf_counter() - t0)
     return arrays, meta
 
 

@@ -78,11 +78,16 @@ def with_retry(fn: Callable, attempts: int = 3, backoff_s: float = 5.0,
     """
     import random
 
+    from mfm_tpu.obs import instrument as _telemetry
+
     rng = random.Random(seed)
     last = None
     for i in range(attempts):
         try:
-            return fn()
+            result = fn()
+            _telemetry.RETRY_ATTEMPTS_TOTAL.inc(
+                outcome="ok" if i == 0 else "retried")
+            return result
         except retryable as e:
             last = e
             if i < attempts - 1:
@@ -90,7 +95,9 @@ def with_retry(fn: Callable, attempts: int = 3, backoff_s: float = 5.0,
                          if exponential else backoff_s)
                 if jitter:
                     delay *= 1.0 + jitter * (2.0 * rng.random() - 1.0)
+                _telemetry.RETRY_BACKOFF_SECONDS.observe(delay)
                 sleep(delay)
+    _telemetry.RETRY_ATTEMPTS_TOTAL.inc(outcome="exhausted")
     raise last
 
 

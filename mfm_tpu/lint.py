@@ -14,6 +14,8 @@ incident record lives in docs/DOCTRINE.md):
       force results (block_until_ready) inside the span
   R6  PartitionSpec axis names must come from the mesh doctrine
       (parallel/mesh.py)
+  R7  no metrics/logging (mfm_tpu.obs / utils.obs) reachable from traced
+      code — telemetry is host-side only; record around the jit boundary
 
 The analysis is a conservative intra-package call graph over the linted
 files: functions reachable from ``jax.jit``/``pjit``/``vmap``/``lax.scan``/
@@ -61,6 +63,9 @@ RULES = {
           "it (block_until_ready) — the span measures dispatch, not compute",
     "R6": "PartitionSpec axis name outside the mesh doctrine "
           "(parallel/mesh.py defines the only legal mesh axes)",
+    "R7": "metrics/logging call reachable from traced code — telemetry "
+          "(mfm_tpu.obs, utils/obs.py) is host-side only; it syncs or "
+          "concretizes under trace.  Record around the jit boundary",
 }
 
 # numpy attributes that are dtype/constant plumbing, not compute — legal
@@ -79,6 +84,13 @@ _NP_ALLOWED = {
 # designated config owners; bench.py is a standalone entrypoint.
 _R3_ALLOWED_MODULES = ("mfm_tpu.cli", "mfm_tpu.utils.cache", "bench")
 _R3_ALLOWED_PREFIXES = ("tools.",)
+
+# telemetry modules: host-side only, never reachable from traced code (R7)
+_R7_OBS_MODULES = ("mfm_tpu.utils.obs", "mfm_tpu.obs")
+
+
+def _is_obs_module(module: str) -> bool:
+    return module in _R7_OBS_MODULES or module.startswith("mfm_tpu.obs.")
 
 _TRACER_JIT = {"jit", "pjit", "vmap", "pmap", "checkpoint", "remat", "grad",
                "value_and_grad"}
@@ -722,6 +734,14 @@ class Linter:
                                    "explicitly s32 (python ints/expressions "
                                    "canonicalize the counter to s64 under "
                                    "x64) — wrap with jnp.int32(...)")
+            # R7: telemetry reachable from traced code
+            obs_tgts = [t for t in self._resolve_call(info, n.func)
+                        if _is_obs_module(t.split(":", 1)[0])]
+            if obs_tgts:
+                self._emit(info, n, "R7",
+                           f"call resolves into {obs_tgts[0].split(':', 1)[0]}"
+                           " from traced code — record metrics/events around "
+                           "the jit boundary, never inside it")
 
     def _check_r3(self, mod: ModuleInfo):
         allowed = (mod.name in _R3_ALLOWED_MODULES
@@ -949,7 +969,7 @@ def run_lint(paths: Iterable[str], baseline: list[dict] | None = None,
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="mfmlint",
-        description="AST lint for the repo's JAX doctrine (R1-R6; see "
+        description="AST lint for the repo's JAX doctrine (R1-R7; see "
                     "docs/DOCTRINE.md)")
     ap.add_argument("paths", nargs="*", default=list(DEFAULT_TARGETS),
                     help="files/dirs to lint (default: mfm_tpu bench.py "

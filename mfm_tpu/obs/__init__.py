@@ -1,0 +1,30 @@
+"""``mfm_tpu.obs`` — the telemetry subsystem (host-side ONLY; mfmlint R7).
+
+- :mod:`~mfm_tpu.obs.metrics` — counters/gauges/histograms + REGISTRY
+- :mod:`~mfm_tpu.obs.exporters` — JSONL events, Prometheus textfile
+- :mod:`~mfm_tpu.obs.instrument` — metric catalog + recording helpers
+- :mod:`~mfm_tpu.obs.manifest` — atomic per-run manifest beside checkpoints
+- :mod:`~mfm_tpu.obs.health` — USE4 bias / R² drift / outlier monitors
+
+Catalog + schemas: ``docs/OBSERVABILITY.md``.
+"""
+
+from mfm_tpu.obs.exporters import (EventLog, emit_event, parse_prometheus,
+                                   render_prometheus, route_events_to,
+                                   write_prometheus_textfile)
+from mfm_tpu.obs.manifest import (MANIFEST_SCHEMA_VERSION, ManifestError,
+                                  build_run_manifest, manifest_path_for,
+                                  read_run_manifest, write_run_manifest)
+from mfm_tpu.obs.health import HealthThresholds, evaluate_health
+from mfm_tpu.obs.metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                                 REGISTRY, is_enabled, set_enabled,
+                                 snapshot_json)
+
+__all__ = [
+    "Counter", "EventLog", "Gauge", "HealthThresholds", "Histogram",
+    "MANIFEST_SCHEMA_VERSION", "ManifestError", "MetricsRegistry", "REGISTRY",
+    "build_run_manifest", "emit_event", "evaluate_health", "is_enabled",
+    "manifest_path_for", "parse_prometheus", "read_run_manifest",
+    "render_prometheus", "route_events_to", "set_enabled", "snapshot_json",
+    "write_prometheus_textfile", "write_run_manifest",
+]

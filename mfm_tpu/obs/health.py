@@ -1,0 +1,184 @@
+"""Model-health monitors: is the served risk model still trustworthy.
+
+Three USE4-flavoured monitors computed from served outputs (reusing
+``models/bias.py`` for the statistic itself):
+
+- **rolling bias statistic** — eigenfactor bias stat over a trailing
+  window; a well-calibrated model keeps it near 1, so the monitored value
+  is the mean |b - 1| across eigenfactor ranks.
+- **cross-sectional R² drift** — trailing-window mean of the regression R²
+  against the run's own earlier baseline; a drop means the factor structure
+  stopped explaining the cross-section.
+- **factor-return outliers** — fraction of recent factor returns beyond a
+  MAD-based z threshold computed from the full history (the serving guards
+  watch raw *asset* returns; this watches the *fitted* factor returns,
+  which is where a broken universe or bad regression shows up first).
+
+Each monitor exports a gauge and contributes a check to the health verdict
+(``{"status": "ok"|"degraded"|"unknown", "checks": {...}}``) that the run
+manifest embeds and ``mfm-tpu doctor`` audits.
+
+CLI-layer only by design: the bias statistic compiles its own small jax
+programs, so this must never run inside the steady-state ≤1-compile update
+path (pipeline/faultinject call ``update_guarded`` directly and stay
+clean).  mfmlint R7 additionally forbids reaching any of this from traced
+code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+from mfm_tpu.obs.metrics import REGISTRY, MetricsRegistry
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthThresholds:
+    """Alert thresholds; ``docs/OBSERVABILITY.md`` discusses tuning."""
+
+    #: mean |bias - 1| across eigenfactor ranks over the trailing window
+    bias_max_mean_abs_dev: float = 0.5
+    #: trailing window (dates) for the rolling bias statistic
+    bias_window: int = 120
+    #: fewest valid window dates before the bias monitor reports at all
+    bias_min_dates: int = 40
+    #: allowed drop of trailing-mean R² below the baseline mean (absolute)
+    r2_max_drop: float = 0.15
+    #: trailing window (dates) for the R² mean
+    r2_window: int = 60
+    #: MAD-z beyond which a factor return counts as an outlier
+    factor_ret_outlier_z: float = 8.0
+    #: allowed fraction of outlier (date, factor) cells in the window
+    factor_ret_max_outlier_frac: float = 0.01
+    #: trailing window (dates) for the outlier fraction
+    factor_ret_window: int = 60
+    #: allowed quarantine rate over the run (quarantined / served dates)
+    max_quarantine_rate: float = 0.02
+
+
+def _check(value, threshold, ok, note: str | None = None) -> dict:
+    rec = {
+        "value": None if value is None or not math.isfinite(value)
+        else round(float(value), 6),
+        "threshold": threshold,
+        "ok": bool(ok),
+    }
+    if note:
+        rec["note"] = note
+    return rec
+
+
+def rolling_bias_check(outputs, thresholds: HealthThresholds) -> dict:
+    """Mean |bias - 1| of the eigen-adjusted covariance over the trailing
+    window (``models.bias.eigenfactor_bias_stat``)."""
+    from mfm_tpu.models.bias import eigenfactor_bias_stat
+
+    valid = np.asarray(outputs.eigen_valid).astype(bool)
+    T = valid.shape[0]
+    lo = max(0, T - int(thresholds.bias_window))
+    n_valid = int(valid[lo:].sum())
+    if n_valid < thresholds.bias_min_dates:
+        return _check(None, thresholds.bias_max_mean_abs_dev, True,
+                      note=f"only {n_valid} valid dates in window "
+                           f"(need {thresholds.bias_min_dates}) — skipped")
+    b = np.asarray(eigenfactor_bias_stat(
+        outputs.eigen_cov[lo:], outputs.eigen_valid[lo:],
+        outputs.factor_ret[lo:]))
+    dev = np.abs(b[np.isfinite(b)] - 1.0)
+    if dev.size == 0:
+        return _check(None, thresholds.bias_max_mean_abs_dev, True,
+                      note="no finite bias ranks — skipped")
+    mean_dev = float(dev.mean())
+    return _check(mean_dev, thresholds.bias_max_mean_abs_dev,
+                  mean_dev <= thresholds.bias_max_mean_abs_dev)
+
+
+def r2_drift_check(outputs, thresholds: HealthThresholds) -> dict:
+    """Trailing-mean R² vs the pre-window baseline mean; the monitored
+    value is ``baseline - recent`` (positive = explanatory power lost)."""
+    r2 = np.asarray(outputs.r2, dtype=np.float64)
+    finite = np.isfinite(r2)
+    w = int(thresholds.r2_window)
+    recent, base = r2[-w:][finite[-w:]], r2[:-w][finite[:-w]]
+    if recent.size == 0 or base.size < w:
+        return _check(None, thresholds.r2_max_drop, True,
+                      note="history shorter than baseline+window — skipped")
+    drop = float(base.mean() - recent.mean())
+    return _check(drop, thresholds.r2_max_drop,
+                  drop <= thresholds.r2_max_drop)
+
+
+def factor_ret_outlier_check(outputs, thresholds: HealthThresholds) -> dict:
+    """Fraction of trailing-window factor returns with MAD-z beyond the
+    threshold, scale fit on the full history per factor."""
+    fr = np.asarray(outputs.factor_ret, dtype=np.float64)
+    finite = np.isfinite(fr)
+    if not finite.any():
+        return _check(None, thresholds.factor_ret_max_outlier_frac, True,
+                      note="no finite factor returns — skipped")
+    med = np.nanmedian(np.where(finite, fr, np.nan), axis=0)
+    mad = np.nanmedian(np.abs(np.where(finite, fr, np.nan) - med), axis=0)
+    scale = np.where(mad > 0, 1.4826 * mad, np.inf)  # degenerate -> no flags
+    w = int(thresholds.factor_ret_window)
+    z = np.abs(fr[-w:] - med) / scale
+    cells = finite[-w:]
+    n = int(cells.sum())
+    if n == 0:
+        return _check(None, thresholds.factor_ret_max_outlier_frac, True,
+                      note="empty window — skipped")
+    frac = float((z[cells] > thresholds.factor_ret_outlier_z).sum() / n)
+    return _check(frac, thresholds.factor_ret_max_outlier_frac,
+                  frac <= thresholds.factor_ret_max_outlier_frac)
+
+
+def quarantine_rate_check(guard_summary: dict,
+                          thresholds: HealthThresholds) -> dict:
+    """Run-level quarantine rate vs threshold (off the guard verdict
+    summary :func:`mfm_tpu.obs.instrument.guard_summary_from_registry`
+    assembles)."""
+    served = guard_summary.get("served_dates", 0)
+    if not served:
+        return _check(None, thresholds.max_quarantine_rate, True,
+                      note="no guarded dates served — skipped")
+    rate = float(guard_summary.get("quarantine_rate", 0.0))
+    return _check(rate, thresholds.max_quarantine_rate,
+                  rate <= thresholds.max_quarantine_rate)
+
+
+def evaluate_health(outputs, thresholds: HealthThresholds | None = None,
+                    registry: MetricsRegistry | None = None,
+                    guard_summary: dict | None = None) -> dict:
+    """Run all monitors over served outputs; export gauges; return the
+    manifest's ``health`` verdict.
+
+    ``status`` is ``degraded`` if any check with a value fails, ``unknown``
+    if every check had to skip (short history), else ``ok``.
+    """
+    th = thresholds or HealthThresholds()
+    reg = registry if registry is not None else REGISTRY
+    checks = {
+        "bias_mean_abs_dev": rolling_bias_check(outputs, th),
+        "r2_drop": r2_drift_check(outputs, th),
+        "factor_ret_outlier_frac": factor_ret_outlier_check(outputs, th),
+    }
+    if guard_summary is not None:
+        checks["quarantine_rate"] = quarantine_rate_check(guard_summary, th)
+    for name, rec in checks.items():
+        if rec["value"] is not None:
+            reg.gauge(f"mfm_health_{name}",
+                      "model-health monitor (see docs/OBSERVABILITY.md)"
+                      ).set_value(rec["value"])
+    measured = [rec for rec in checks.values() if rec["value"] is not None]
+    if not measured:
+        status = "unknown"
+    elif all(rec["ok"] for rec in measured):
+        status = "ok"
+    else:
+        status = "degraded"
+    reg.gauge("mfm_model_health",
+              "1 healthy / 0 degraded / -1 unknown (short history)"
+              ).set_value({"ok": 1.0, "degraded": 0.0}.get(status, -1.0))
+    return {"status": status, "checks": checks}

@@ -1,0 +1,184 @@
+"""Instrumentation: the metric catalog + host-side recording helpers.
+
+This module is the single place where the serving stack's metric names are
+declared (``docs/OBSERVABILITY.md`` mirrors this catalog).  Everything
+records CONCRETE host values — numpy scalars off already-materialized jit
+outputs, wall-clock spans around jit calls, filesystem events — never
+tracers; recording around the jit boundary is what keeps the fused update
+at ≤1 compile with telemetry on (and mfmlint R7 makes reaching these from
+traced code a lint error).
+
+Compile visibility reuses the :class:`~mfm_tpu.utils.contracts.CompileCounter`
+lowering hook: :func:`watch_compiles` registers a process-lifetime listener
+that tallies ``mfm_jit_compiles_total``, so a steady-state recompile shows
+up on a dashboard instead of only in a test assertion.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import numpy as np
+
+from mfm_tpu.obs.metrics import REGISTRY
+
+# -- catalog ------------------------------------------------------------------
+
+GUARD_REASON_TOTAL = REGISTRY.counter(
+    "mfm_guard_reason_total",
+    "guard trips by reason bit (one date may tally several reasons)",
+    labelnames=("reason",))
+QUARANTINED_DATES_TOTAL = REGISTRY.counter(
+    "mfm_quarantined_dates_total", "dates excised by the quarantine policy")
+SERVED_DATES_TOTAL = REGISTRY.counter(
+    "mfm_served_dates_total", "dates served (healthy + degraded-mode)")
+SERVED_COV_STALENESS = REGISTRY.gauge(
+    "mfm_served_cov_staleness",
+    "dates since the most recently served covariance was fit (0 = fresh)")
+QUARANTINE_COUNT = REGISTRY.gauge(
+    "mfm_quarantine_count", "quarantined dates in the last guarded step")
+UPDATE_LATENCY = REGISTRY.histogram(
+    "mfm_update_latency_seconds", "guarded/unguarded update step wall time")
+
+STAGE_SECONDS = REGISTRY.gauge(
+    "mfm_stage_seconds", "last wall time of a pipeline/risk stage",
+    labelnames=("stage",))
+COMPILED_BYTES = REGISTRY.gauge(
+    "mfm_compiled_bytes",
+    "compiled-program memory analysis (utils.obs.compiled_memory)",
+    labelnames=("stage", "kind"))
+
+CHECKPOINT_SAVES_TOTAL = REGISTRY.counter(
+    "mfm_checkpoint_saves_total", "fenced artifact saves")
+CHECKPOINT_LOADS_TOTAL = REGISTRY.counter(
+    "mfm_checkpoint_loads_total", "fenced artifact loads")
+CHECKPOINT_CORRUPT_TOTAL = REGISTRY.counter(
+    "mfm_checkpoint_corrupt_total",
+    "checksum/fence verification failures on load")
+CHECKPOINT_STALE_TOTAL = REGISTRY.counter(
+    "mfm_checkpoint_stale_total", "generation-fence rejections on load")
+CHECKPOINT_HEAL_FORWARD_TOTAL = REGISTRY.counter(
+    "mfm_checkpoint_heal_forward_total",
+    "pointer heal-forwards after a crash between rename and pointer swap")
+CHECKPOINT_GENERATION = REGISTRY.gauge(
+    "mfm_checkpoint_generation", "generation fence of the last save/load")
+CHECKPOINT_SAVE_SECONDS = REGISTRY.histogram(
+    "mfm_checkpoint_save_seconds", "artifact save wall time")
+CHECKPOINT_LOAD_SECONDS = REGISTRY.histogram(
+    "mfm_checkpoint_load_seconds", "artifact load wall time")
+
+RETRY_ATTEMPTS_TOTAL = REGISTRY.counter(
+    "mfm_retry_attempts_total", "with_retry attempts by outcome",
+    labelnames=("outcome",))   # outcome: ok | retried | exhausted
+RETRY_BACKOFF_SECONDS = REGISTRY.histogram(
+    "mfm_retry_backoff_seconds", "with_retry sleep durations",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0))
+
+JIT_COMPILES_TOTAL = REGISTRY.counter(
+    "mfm_jit_compiles_total",
+    "jit lowerings observed since watch_compiles() (steady state: flat)")
+
+
+# -- recording helpers --------------------------------------------------------
+
+def record_guard_report(report) -> None:
+    """Tally one guarded step's verdicts (host-side, report already
+    materialized by the update call)."""
+    from mfm_tpu.serve import guard
+
+    q = np.asarray(report.quarantined).astype(bool)
+    reasons = np.asarray(report.reasons)
+    staleness = np.asarray(report.staleness)
+    n_q = int(q.sum())
+    if n_q:
+        QUARANTINED_DATES_TOTAL.inc(n_q)
+    SERVED_DATES_TOTAL.inc(int(q.shape[0]))
+    QUARANTINE_COUNT.set_value(n_q)
+    if staleness.size:
+        SERVED_COV_STALENESS.set_value(int(staleness[-1]))
+    for bit, name in guard._REASON_NAMES:
+        n = int(((reasons & bit) != 0).sum())
+        if n:
+            GUARD_REASON_TOTAL.inc(n, reason=name)
+
+
+def record_update_latency(seconds: float) -> None:
+    UPDATE_LATENCY.observe(float(seconds))
+
+
+def record_stage_seconds(stage: str, seconds: float) -> None:
+    STAGE_SECONDS.set_value(float(seconds), stage=stage)
+
+
+def record_compiled_memory(stage: str, mem: dict) -> None:
+    """Export a ``utils.obs.compiled_memory`` analysis as labeled gauges."""
+    for kind, v in mem.items():
+        if isinstance(v, (int, float)):
+            COMPILED_BYTES.set_value(float(v), stage=stage, kind=kind)
+
+
+@contextlib.contextmanager
+def time_stage(stage: str):
+    """Span a host-side stage; sets ``mfm_stage_seconds{stage=...}``.
+
+    The body must force its JAX work before exiting (mfmlint R5 already
+    polices perf_counter spans in bench/tools); this span only *reads* the
+    clock, it never forces device work itself.
+    """
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_stage_seconds(stage, time.perf_counter() - t0)
+
+
+_COMPILE_WATCHER = None
+
+
+def watch_compiles() -> None:
+    """Install a process-lifetime lowering listener feeding
+    ``mfm_jit_compiles_total`` (idempotent)."""
+    global _COMPILE_WATCHER
+    if _COMPILE_WATCHER is not None:
+        return
+    from jax._src import monitoring
+
+    from mfm_tpu.utils.contracts import _COMPILE_EVENT
+
+    def _listener(event: str, duration: float, **kwargs) -> None:
+        if event == _COMPILE_EVENT:
+            JIT_COMPILES_TOTAL.inc()
+
+    monitoring.register_event_duration_secs_listener(_listener)
+    _COMPILE_WATCHER = _listener
+
+
+def unwatch_compiles() -> None:
+    """Remove the listener installed by :func:`watch_compiles` (tests)."""
+    global _COMPILE_WATCHER
+    if _COMPILE_WATCHER is None:
+        return
+    from jax._src import monitoring
+
+    unregister = getattr(
+        monitoring, "_unregister_event_duration_listener_by_callback", None)
+    if unregister is not None:
+        unregister(_COMPILE_WATCHER)
+    _COMPILE_WATCHER = None
+
+
+def guard_summary_from_registry() -> dict:
+    """The manifest's guard verdict summary, off the live counters."""
+    served = SERVED_DATES_TOTAL.value()
+    quarantined = QUARANTINED_DATES_TOTAL.value()
+    reasons = {}
+    for key, n in GUARD_REASON_TOTAL.series().items():
+        reasons[key[0]] = int(n)
+    return {
+        "served_dates": int(served),
+        "quarantined_dates": int(quarantined),
+        "quarantine_rate": (round(quarantined / served, 6) if served else 0.0),
+        "reasons": reasons,
+        "last_staleness": int(SERVED_COV_STALENESS.value()),
+    }

@@ -14,6 +14,7 @@ The TPU-native equivalents of the reference's two drivers:
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Dict, Mapping
 
 import numpy as np
@@ -22,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from mfm_tpu.config import PipelineConfig
+from mfm_tpu.obs import instrument as _telemetry
 from mfm_tpu.data.barra import BarraArrays, barra_frame_to_arrays
 from mfm_tpu.factors.engine import FactorEngine, rowspace_index, gather_rows, scatter_rows
 from mfm_tpu.models.risk_model import RiskModel, RiskModelOutputs, RiskModelState
@@ -461,12 +463,19 @@ def append_risk_pipeline(
         # disordered date is quarantined, not folded into the carries
         pre = host_date_reasons(
             [date_stamp(d) for d in slab.dates], last_date=last)
+        t0 = time.perf_counter()
         outputs, report, new_state = rm.update_guarded(
             state, last_date=date_stamp(slab.dates[-1]), pre_reasons=pre)
+        # host-side telemetry off the materialized report (mfmlint R7:
+        # recording happens around the fused jit step, never inside it)
+        _telemetry.record_guard_report(report)
+        _telemetry.record_update_latency(time.perf_counter() - t0)
         return RiskPipelineResult(outputs=outputs, arrays=slab, model=rm,
                                   state=new_state, report=report)
+    t0 = time.perf_counter()
     outputs, new_state = rm.update(state,
                                    last_date=date_stamp(slab.dates[-1]))
+    _telemetry.record_update_latency(time.perf_counter() - t0)
     return RiskPipelineResult(outputs=outputs, arrays=slab, model=rm,
                               state=new_state)
 

@@ -43,6 +43,8 @@ KILL_MATCH_ENV = "MFM_CHAOS_KILL_MATCH"
 CRASH_POINTS = (
     "save_artifact.after_tmp",     # tmp durable, final file still the old one
     "save_artifact.after_rename",  # new file live, pointer not yet swapped
+    "run_manifest.after_tmp",      # checkpoint live, manifest tmp not yet
+                                   # renamed (obs/manifest.py)
 )
 
 
@@ -190,8 +192,8 @@ class FaultPlan:
     failing plan replays exactly."""
 
     name: str
-    kind: str        # truncate | corrupt | kill | nan_slab | outlier_slab |
-                     # universe_slab | flaky_store
+    kind: str        # truncate | corrupt | kill | kill_manifest | nan_slab |
+                     # outlier_slab | universe_slab | flaky_store
     seed: int = 0
     params: tuple = ()   # ((key, value), ...) — hashable, printable
 
@@ -222,4 +224,6 @@ def plan_suite(seed: int = 0) -> tuple:
                   (("keep_frac", 0.2),)),
         FaultPlan("flaky-store", "flaky_store", s + 9,
                   (("n_failures", 2),)),
+        FaultPlan("kill-at-manifest", "kill_manifest", s + 10,
+                  (("point", "run_manifest.after_tmp"),)),
     )

@@ -18,8 +18,6 @@ thread).  Here:
 from __future__ import annotations
 
 import contextlib
-import json
-import sys
 import time
 from typing import Callable
 
@@ -28,32 +26,40 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-_LEVELS = {"debug": 10, "info": 20, "warn": 30, "error": 40}
-_state = {"level": 20}
-
-
 def set_log_level(level: str) -> None:
-    _state["level"] = _LEVELS[level]
+    from mfm_tpu.obs.exporters import default_event_log
+
+    default_event_log().set_level(level)
 
 
 def log(level: str, event: str, **fields) -> None:
-    if _LEVELS[level] < _state["level"]:
-        return
-    rec = {"ts": round(time.time(), 3), "level": level, "event": event, **fields}
-    print(json.dumps(rec), file=sys.stderr, flush=True)
+    """Structured JSONL event — now a thin shim over the
+    :mod:`mfm_tpu.obs.exporters` event stream (stderr by default; a CLI run
+    with ``--metrics-dir`` routes the same stream to ``events.jsonl``)."""
+    from mfm_tpu.obs.exporters import emit_event
+
+    emit_event(level, event, **fields)
 
 
 def force(tree):
     """Force execution + tiny host transfer of a pytree of arrays.
 
-    Returns the summed checksum (useful for timing and smoke assertions).
+    Returns the summed checksum over floating leaves (useful for timing and
+    smoke assertions).  ALL array leaves are forced — int/bool arrays don't
+    join the checksum, but on async-dispatch backends they must still be
+    blocked on individually, or a pytree of only int leaves could return
+    before execution completes.
     """
     leaves = [x for x in jax.tree_util.tree_leaves(tree)
-              if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)]
-    if not leaves:
-        jax.block_until_ready(tree)
+              if hasattr(x, "dtype")]
+    float_leaves = [x for x in leaves
+                    if jnp.issubdtype(x.dtype, jnp.floating)]
+    for x in leaves:
+        jax.block_until_ready(x)
+    if not float_leaves:
         return 0.0
-    total = sum(jnp.sum(jnp.where(jnp.isfinite(x), x, 0.0)) for x in leaves)
+    total = sum(jnp.sum(jnp.where(jnp.isfinite(x), x, 0.0))
+                for x in float_leaves)
     return float(np.asarray(total))
 
 

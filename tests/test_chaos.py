@@ -186,7 +186,8 @@ def test_plan_suite_is_deterministic():
     assert a == b
     names = [p.name for p in a]
     assert len(set(names)) == len(names)
-    assert {p.kind for p in a} == {"truncate", "corrupt", "kill", "nan_slab",
+    assert {p.kind for p in a} == {"truncate", "corrupt", "kill",
+                                   "kill_manifest", "nan_slab",
                                    "outlier_slab", "universe_slab",
                                    "flaky_store"}
     assert len({p.seed for p in a}) == len(a)

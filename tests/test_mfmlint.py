@@ -236,6 +236,68 @@ def test_r6_partition_spec_axes(tmp_path):
     assert "row" in res.new[0].message  # the legal axes are named
 
 
+def test_r7_telemetry_in_traced_code(tmp_path):
+    """Telemetry (mfm_tpu.obs / utils.obs) must stay host-side: direct or
+    transitively-reachable calls from traced code are R7; the same calls on
+    the host path around the jit boundary are clean."""
+    res = _lint(tmp_path, {
+        "mfm_tpu/utils/obs.py": """
+            def log(level, event, **fields):
+                pass
+        """,
+        "mfm_tpu/obs/instrument.py": """
+            def record_update_latency(seconds):
+                pass
+        """,
+        "mfm_tpu/model.py": """
+            import jax
+            import jax.numpy as jnp
+            from mfm_tpu.obs import instrument
+            from mfm_tpu.utils.obs import log
+
+            def helper(x):
+                log("info", "inner")                    # traced-reachable: R7
+                return x * 2
+
+            @jax.jit
+            def bad(x):
+                log("info", "step")                     # R7: utils.obs
+                instrument.record_update_latency(0.1)   # R7: obs package
+                return jnp.sum(helper(x))
+
+            def host(x):
+                y = bad(x)
+                log("info", "done")                     # host side: fine
+                instrument.record_update_latency(0.1)
+                return y
+        """})
+    got = sorted((v.rule, v.qualname) for v in res.new)
+    assert got == [("R7", "bad"), ("R7", "bad"), ("R7", "helper")]
+
+
+def test_r7_bare_method_over_approximation(tmp_path):
+    """A bare ``.inc(...)`` in traced code resolves (over-approximately)
+    against every known def — including obs metric methods — so it flags.
+    That is why the metric API avoids names traced code legitimately uses
+    (``set_value`` not ``set``, ``quantile_est`` not ``quantile``)."""
+    res = _lint(tmp_path, {
+        "mfm_tpu/obs/metrics.py": """
+            class Counter:
+                def inc(self, amount=1.0):
+                    pass
+        """,
+        "mfm_tpu/model.py": """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def traced(x, m):
+                m.inc(1.0)          # R7: bare name matches Counter.inc
+                return jnp.sum(x)
+        """})
+    assert [(v.rule, v.qualname) for v in res.new] == [("R7", "traced")]
+
+
 def test_baseline_roundtrip_and_stale_reporting(tmp_path):
     src = {"mod.py": """
         import jax

@@ -1,0 +1,341 @@
+"""The telemetry subsystem (mfm_tpu/obs/): metrics registry semantics,
+Prometheus/JSONL exporters, run manifests, and model-health monitors.
+
+The exporter tests pin the two wire formats the outside world consumes:
+the Prometheus textfile round-trips through our own strict parser (names,
+labels, types, histogram bucket folding), and the JSONL event stream keeps
+its required-key schema stable.  The manifest tests include the crash
+drill: SIGKILL between the tmp write and the rename must never leave a
+torn ``run_manifest.json`` (same ``MFM_CHAOS_KILL`` mechanism as
+tests/test_chaos.py — the subprocess drill carries ``chaos``/``slow``; the
+torn-file *detection* paths run in tier-1).
+"""
+
+import json
+import math
+import os
+import signal
+import subprocess
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from mfm_tpu.obs.exporters import (
+    EVENT_REQUIRED_KEYS,
+    EventLog,
+    parse_prometheus,
+    render_prometheus,
+    write_prometheus_textfile,
+)
+from mfm_tpu.obs.health import HealthThresholds, evaluate_health
+from mfm_tpu.obs.manifest import (
+    ManifestError,
+    build_run_manifest,
+    manifest_path_for,
+    read_run_manifest,
+    write_run_manifest,
+)
+from mfm_tpu.obs.metrics import MetricsRegistry, snapshot_json
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _registry_with_traffic():
+    reg = MetricsRegistry()
+    c = reg.counter("mfm_test_total", "a counter", labelnames=("kind",))
+    c.inc(3, kind="good")
+    c.inc(kind="bad")
+    g = reg.gauge("mfm_test_gauge", "a gauge")
+    g.set_value(2.5)
+    h = reg.histogram("mfm_test_seconds", "a histogram",
+                      buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    return reg
+
+
+# -- registry semantics -------------------------------------------------------
+
+def test_counter_is_monotonic_and_rejects_negative():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", "c")
+    c.inc()
+    c.inc(2.0)
+    assert c.value() == 3.0
+    with pytest.raises(ValueError):
+        c.inc(-1.0)
+
+
+def test_declare_once_conflicting_redeclaration_raises():
+    reg = MetricsRegistry()
+    reg.counter("x_total", "x", labelnames=("a",))
+    # same declaration -> same object (idempotent)
+    assert reg.counter("x_total", "x", labelnames=("a",)) is \
+        reg.counter("x_total", "x", labelnames=("a",))
+    with pytest.raises(ValueError):
+        reg.counter("x_total", "x", labelnames=("b",))   # labels differ
+    with pytest.raises(ValueError):
+        reg.gauge("x_total", "x")                        # type differs
+
+
+def test_histogram_cumulative_buckets_are_monotone_and_quantiles_bracket():
+    reg = MetricsRegistry()
+    h = reg.histogram("h_seconds", "h", buckets=(0.01, 0.1, 1.0, 10.0))
+    rng = np.random.default_rng(0)
+    vals = rng.uniform(0.02, 5.0, size=500)
+    for v in vals:
+        h.observe(float(v))
+    cum = h.cumulative()
+    counts = [c for _, c in cum]
+    assert counts == sorted(counts), "cumulative bucket counts must be " \
+                                     "monotone non-decreasing"
+    assert cum[-1][0] == math.inf and cum[-1][1] == len(vals)
+    # bucket-interpolated quantiles can only promise bucket-level accuracy:
+    # the estimate must land within the bucket containing the true quantile
+    for q in (0.1, 0.5, 0.9):
+        est = h.quantile_est(q)
+        true = float(np.quantile(vals, q))
+        bounds = (0.0, 0.01, 0.1, 1.0, 10.0)
+        lo = max(b for b in bounds if b <= true)
+        hi = min(b for b in bounds if b > true)
+        assert lo <= est <= hi, (q, est, true)
+    assert math.isnan(reg.histogram("empty_seconds", "e").quantile_est(0.5))
+
+
+# -- Prometheus exporter ------------------------------------------------------
+
+def test_prometheus_render_parse_round_trip():
+    reg = _registry_with_traffic()
+    families = parse_prometheus(render_prometheus(reg))
+    assert families["mfm_test_total"]["type"] == "counter"
+    assert families["mfm_test_gauge"]["type"] == "gauge"
+    assert families["mfm_test_seconds"]["type"] == "histogram"
+    by_labels = {tuple(sorted(lbl.items())): v for _, lbl, v
+                 in families["mfm_test_total"]["samples"]}
+    assert by_labels[(("kind", "good"),)] == 3.0
+    assert by_labels[(("kind", "bad"),)] == 1.0
+    gauge = families["mfm_test_gauge"]["samples"]
+    assert len(gauge) == 1 and gauge[0][2] == 2.5
+    hist = families["mfm_test_seconds"]["samples"]
+    buckets = {lbl["le"]: v for name, lbl, v in hist
+               if name.endswith("_bucket")}
+    assert buckets["0.1"] == 1.0 and buckets["1.0"] == 3.0
+    assert buckets["+Inf"] == 4.0
+    count = [v for name, _, v in hist if name.endswith("_count")]
+    total = [v for name, _, v in hist if name.endswith("_sum")]
+    assert count == [4.0] and abs(total[0] - 6.05) < 1e-9
+
+
+def test_prometheus_textfile_is_parse_validated_and_atomic(tmp_path):
+    reg = _registry_with_traffic()
+    path = str(tmp_path / "metrics.prom")
+    text = write_prometheus_textfile(path, reg)
+    assert open(path).read() == text
+    assert "mfm_test_total" in parse_prometheus(open(path).read())
+    assert not [f for f in os.listdir(tmp_path) if f != "metrics.prom"], \
+        "no tmp litter after the atomic rename"
+
+
+def test_prometheus_parser_rejects_malformed():
+    with pytest.raises(ValueError):
+        parse_prometheus("# TYPE x sometype\nx 1\n")
+    with pytest.raises(ValueError):
+        parse_prometheus("# TYPE x counter\nx not-a-number\n")
+
+
+# -- JSONL event stream -------------------------------------------------------
+
+def test_event_log_schema_and_level_gate(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(path, min_level="info")
+    log.emit("debug", "ignored_event")
+    log.emit("info", "guarded_update", dates=4, quarantined=1)
+    log.emit("error", "checkpoint_corrupt", path="x.npz")
+    lines = [json.loads(ln) for ln in open(path)]
+    assert [e["event"] for e in lines] == ["guarded_update",
+                                           "checkpoint_corrupt"]
+    for e in lines:
+        for k in EVENT_REQUIRED_KEYS:
+            assert k in e, f"event lost required key {k!r}"
+    assert lines[0]["dates"] == 4 and lines[0]["quarantined"] == 1
+    log.set_level("error")
+    log.emit("info", "now_ignored")
+    assert len(open(path).read().splitlines()) == 2
+
+
+def test_snapshot_json_is_schema_versioned_and_stable():
+    reg = _registry_with_traffic()
+    snap = json.loads(snapshot_json(reg))
+    assert snap["schema"] == 1
+    m = snap["metrics"]["mfm_test_seconds"]
+    assert m["type"] == "histogram"
+    # re-serializing must be byte-identical modulo the timestamp
+    a, b = (json.loads(snapshot_json(reg)) for _ in range(2))
+    a.pop("taken_at_unix"), b.pop("taken_at_unix")
+    assert a == b
+
+
+# -- run manifest -------------------------------------------------------------
+
+def _write_valid_manifest(dirpath, health=None):
+    man = build_run_manifest(stamp_json={"__tuple__": ["x", 1]},
+                             checkpoint=os.path.join(dirpath, "state.npz"),
+                             backend="cpu", health=health)
+    return write_run_manifest(dirpath, man)
+
+
+def test_manifest_round_trip_and_path_convention(tmp_path):
+    d = str(tmp_path)
+    _write_valid_manifest(d)
+    p = manifest_path_for(os.path.join(d, "state.npz"))
+    assert os.path.basename(p) == "run_manifest.json"
+    man = read_run_manifest(p)
+    assert man["schema_version"] == 1
+    assert man["checkpoint"] == "state.npz"
+    assert man["health"]["status"] == "unknown"
+
+
+def test_manifest_reader_rejects_torn_and_invalid(tmp_path):
+    p = str(tmp_path / "run_manifest.json")
+    open(p, "w").write('{"schema_version": 1, "health": {"status"')  # torn
+    with pytest.raises(ManifestError):
+        read_run_manifest(p)
+    open(p, "w").write(json.dumps({"schema_version": 999,
+                                   "health": {"status": "ok"}}))
+    with pytest.raises(ManifestError):
+        read_run_manifest(p)
+    open(p, "w").write(json.dumps({"schema_version": 1}))  # no health
+    with pytest.raises(ManifestError):
+        read_run_manifest(p)
+
+
+_MANIFEST_SCRIPT = """\
+import sys
+sys.path.insert(0, {repo!r})
+from mfm_tpu.obs.manifest import build_run_manifest, write_run_manifest
+write_run_manifest({dir!r}, build_run_manifest(
+    checkpoint="state.npz", backend="cpu",
+    extra={{"stamp": {stamp}}}))
+"""
+
+
+def _manifest_in_subprocess(dirpath, stamp, kill=False):
+    env = dict(os.environ)
+    env.pop("MFM_CHAOS_KILL", None)
+    if kill:
+        env["MFM_CHAOS_KILL"] = "run_manifest.after_tmp"
+    return subprocess.run(
+        [sys.executable, "-c",
+         _MANIFEST_SCRIPT.format(repo=REPO, dir=dirpath, stamp=stamp)],
+        env=env, capture_output=True, text=True, timeout=120)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_sigkill_at_manifest_write_leaves_no_torn_manifest(tmp_path):
+    d = str(tmp_path)
+    assert _manifest_in_subprocess(d, 1).returncode == 0
+    before = read_run_manifest(os.path.join(d, "run_manifest.json"))
+    assert before["stamp"] == 1
+
+    proc = _manifest_in_subprocess(d, 2, kill=True)
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    # the crash fell between tmp write and rename: the OLD manifest is
+    # still the live one, bitwise valid
+    after = read_run_manifest(os.path.join(d, "run_manifest.json"))
+    assert after == before
+    # and the retried write wins cleanly
+    assert _manifest_in_subprocess(d, 2).returncode == 0
+    assert read_run_manifest(
+        os.path.join(d, "run_manifest.json"))["stamp"] == 2
+
+
+# -- model-health monitors ----------------------------------------------------
+
+def _healthy_outputs(T=240, K=4, seed=0):
+    rng = np.random.default_rng(seed)
+    fr = 0.01 * rng.standard_normal((T, K))
+    cov = np.einsum("ti,tj->tij", fr, fr) + np.eye(K) * 1e-4
+    return types.SimpleNamespace(
+        factor_ret=fr, r2=0.3 + 0.05 * rng.random(T),
+        eigen_cov=cov, eigen_valid=np.ones(T, bool))
+
+
+def test_health_short_history_is_unknown_not_degraded():
+    out = _healthy_outputs(T=10)
+    out.factor_ret[:] = np.nan    # nothing measurable anywhere
+    reg = MetricsRegistry()
+    verdict = evaluate_health(out, registry=reg)
+    assert verdict["status"] == "unknown"
+    assert all(rec["value"] is None and rec["ok"]
+               for rec in verdict["checks"].values())
+    assert reg.gauge("mfm_model_health", "").value() == -1.0
+
+
+def test_health_r2_collapse_and_outliers_degrade():
+    out = _healthy_outputs()
+    out.r2[-60:] = 0.05                       # explanatory power collapsed
+    out.factor_ret[-5:, 0] = 0.8              # absurd factor returns
+    reg = MetricsRegistry()
+    verdict = evaluate_health(out, registry=reg)
+    assert verdict["status"] == "degraded"
+    assert not verdict["checks"]["r2_drop"]["ok"]
+    assert not verdict["checks"]["factor_ret_outlier_frac"]["ok"]
+    assert reg.gauge("mfm_model_health", "").value() == 0.0
+
+
+def test_health_quarantine_rate_check_uses_guard_summary():
+    out = _healthy_outputs(T=10)              # monitors all skip...
+    verdict = evaluate_health(out, registry=MetricsRegistry(),
+                              guard_summary={"served_dates": 50,
+                                             "quarantined_dates": 10,
+                                             "quarantine_rate": 0.2})
+    # ...but the quarantine rate alone is measured, and damning
+    assert verdict["status"] == "degraded"
+    assert not verdict["checks"]["quarantine_rate"]["ok"]
+    ok = evaluate_health(out, registry=MetricsRegistry(),
+                         guard_summary={"served_dates": 50,
+                                        "quarantined_dates": 0,
+                                        "quarantine_rate": 0.0})
+    assert ok["status"] == "ok"
+
+
+def test_health_thresholds_are_tunable():
+    out = _healthy_outputs()
+    out.r2[-60:] = 0.05
+    lax = HealthThresholds(r2_max_drop=1.0, factor_ret_outlier_z=1e9)
+    verdict = evaluate_health(out, thresholds=lax,
+                              registry=MetricsRegistry())
+    assert verdict["checks"]["r2_drop"]["ok"]
+
+
+# -- metrics CLI --------------------------------------------------------------
+
+def test_metrics_cli_dump_snapshot_diff(tmp_path, capsys):
+    from mfm_tpu.cli import main as cli_main
+
+    reg_a, reg_b = _registry_with_traffic(), _registry_with_traffic()
+    reg_b.counter("mfm_test_total", "a counter",
+                  labelnames=("kind",)).inc(5, kind="good")
+    a, b = tmp_path / "a", tmp_path / "b"
+    for d, reg in ((a, reg_a), (b, reg_b)):
+        d.mkdir()
+        write_prometheus_textfile(str(d / "metrics.prom"), reg)
+        (d / "metrics.json").write_text(snapshot_json(reg))
+
+    cli_main(["metrics", "dump", str(a)])
+    assert "mfm_test_total" in capsys.readouterr().out
+
+    cli_main(["metrics", "snapshot", str(a)])
+    assert json.loads(capsys.readouterr().out)["schema"] == 1
+
+    cli_main(["metrics", "diff", str(a), str(b)])
+    diff = json.loads(capsys.readouterr().out)
+    key = "mfm_test_total{kind=good}"
+    assert diff["series"][key]["delta"] == 5.0
+    assert all(rec["delta"] != 0 for rec in diff["series"].values())
+
+    with pytest.raises(SystemExit):
+        cli_main(["metrics", "dump", str(tmp_path / "missing")])

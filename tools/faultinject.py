@@ -23,6 +23,12 @@ recovery contracts the production loop promises (docs/SERVING.md):
 - **Flaky transport** (flaky-store): ``with_retry`` over a
   :class:`FlakyStore` recovers from transient errors on the documented
   backoff schedule and re-raises non-retryable errors immediately.
+- **Crash at the manifest write** (kill-at-manifest): SIGKILL between the
+  run manifest's tmp write and its rename (``run_manifest.after_tmp``).
+  Telemetry must never endanger the data: the already-fenced checkpoint
+  loads clean and replays bitwise, no torn ``run_manifest.json`` is left
+  behind, and the next healthy run writes a manifest ``mfm-tpu doctor``
+  accepts.
 - **Steady state**: after warmup, the per-date guarded serving loop stays
   within ONE jit compile (``assert_max_compiles``).
 
@@ -282,6 +288,69 @@ def run_kill(plan, base: Baseline, root: str) -> dict:
     return {"killed_at": point, "pointer": ptr, "pointer_healed": healed}
 
 
+def run_kill_manifest(plan, base: Baseline, root: str) -> dict:
+    """kill-at-manifest: SIGKILL between the manifest's tmp write and its
+    rename.  The checkpoint (written and fenced BEFORE the manifest) must be
+    untouched, no torn manifest may exist, and the next run's manifest must
+    pass the doctor audit."""
+    from mfm_tpu.data.artifacts import load_risk_state
+    from mfm_tpu.obs.manifest import read_run_manifest
+
+    point = plan.param("point")
+    d = _fresh_workdir(root, plan.name, base.snaps[0])
+    path = os.path.join(d, "state.npz")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo_root}
+
+    def _update_cmd(slab_csv, table):
+        table.to_csv(slab_csv, index=False)
+        return [sys.executable, "-m", "mfm_tpu.cli", "risk",
+                "--barra", slab_csv, "--update", path, "--quarantine",
+                "--eigen-sims", str(EIGEN_SIMS),
+                "--eigen-sim-length", str(T_TOTAL),
+                "--out", os.path.join(d, "tables")]
+
+    cmd = _update_cmd(os.path.join(d, "slab0.csv"), base.slabs[0])
+    proc = subprocess.run(cmd, env={**env, "MFM_CHAOS_KILL": point},
+                          capture_output=True, text=True, timeout=600)
+    if proc.returncode != -signal.SIGKILL:
+        raise AssertionError(
+            f"{plan.name}: expected the subprocess to die by SIGKILL at "
+            f"{point}, got rc={proc.returncode}\n{proc.stderr[-2000:]}")
+    man_path = os.path.join(d, "run_manifest.json")
+    if os.path.exists(man_path):
+        raise AssertionError(f"{plan.name}: a manifest exists despite the "
+                             "kill before its rename — the write is not "
+                             "tmp-then-rename atomic")
+    # the checkpoint was fenced and swapped BEFORE the manifest write: it
+    # must carry the appended slab and be interchangeable with the
+    # in-process run (carries bitwise, next slab bitwise)
+    state, meta = load_risk_state(path)
+    if meta["last_date"] != base.slab_dates[0][-1]:
+        raise AssertionError(f"{plan.name}: checkpoint does not carry the "
+                             "appended dates — manifest kill corrupted it")
+    _assert_carries_equal(_carries(state), base.carries[0],
+                          f"{plan.name} (subprocess checkpoint)")
+    res = _append(path, base.slabs[1], base.cfg)
+    _assert_outputs_equal(_outputs_by_date(res), base.outputs[1],
+                          base.slab_dates[1], plan.name)
+    # the next CLI run must leave a valid, doctor-clean manifest behind
+    cmd2 = _update_cmd(os.path.join(d, "slab2.csv"), base.slabs[2])
+    proc2 = subprocess.run(cmd2, env=env, capture_output=True, text=True,
+                           timeout=600)
+    if proc2.returncode != 0:
+        raise AssertionError(f"{plan.name}: post-crash update failed "
+                             f"rc={proc2.returncode}\n{proc2.stderr[-2000:]}")
+    man = read_run_manifest(man_path)   # raises ManifestError if torn
+    doc = subprocess.run([sys.executable, "-m", "mfm_tpu.cli", "doctor", d],
+                         env=env, capture_output=True, text=True, timeout=600)
+    if doc.returncode != 0:
+        raise AssertionError(f"{plan.name}: doctor rejects the post-crash "
+                             f"manifest\n{doc.stdout[-2000:]}")
+    return {"killed_at": point, "manifest_after_crash": "absent",
+            "recovered_manifest_health": man["health"]["status"]}
+
+
 _POISON_OK_REASONS = {
     # NaN returns are dropped by the frame->arrays conversion, so a
     # NaN-poisoned CSV date manifests as universe collapse downstream of
@@ -428,9 +497,9 @@ def run_steady_state(base: Baseline, root: str) -> dict:
 
 
 RUNNERS = {"truncate": run_byte_fault, "corrupt": run_byte_fault,
-           "kill": run_kill, "nan_slab": run_poison,
-           "outlier_slab": run_poison, "universe_slab": run_poison,
-           "flaky_store": run_flaky_store}
+           "kill": run_kill, "kill_manifest": run_kill_manifest,
+           "nan_slab": run_poison, "outlier_slab": run_poison,
+           "universe_slab": run_poison, "flaky_store": run_flaky_store}
 
 
 def main(argv=None) -> int:
