@@ -10,6 +10,7 @@ eigenfactor adjustment + vol-regime adjustment) on a CSI300-shaped panel
   python bench.py --config factors# config 3: full style-factor calc + post
   python bench.py --config alla   # config 4: all-A full pipeline + risk stack
   python bench.py --config alpha  # config 5: 1000 alpha expressions, CSI300 panel
+  python bench.py --config query  # config 6: batched portfolio-query service
 
 The reference publishes no numbers (BASELINE.md), so the config-1 baseline is
 measured here: the golden NumPy implementation of the identical math (same
@@ -705,6 +706,68 @@ def bench_alpha_alla():
             "compile_s": round(compile_s, 2)}
 
 
+def bench_query():
+    """Config 6: the batched portfolio-query service (serve/query.py).
+
+    Two numbers: raw engine throughput — the ONE vmapped, donated jit —
+    at request-storm scales B = 1e3 / 1e5 / 1e6 over a CSI300-shaped
+    factor space, each bucket holding the <=1-compile steady-state
+    contract; and the serving loop's operational summary (latency
+    percentiles, shed rate, breaker counters) from a real
+    :class:`QueryServer` overload storm with telemetry recording on."""
+    import io
+
+    import jax.numpy as jnp
+    from mfm_tpu.serve import QueryEngine, QueryServer, ServePolicy, \
+        bucket_for
+    from mfm_tpu.utils.contracts import assert_max_compiles
+
+    K = 1 + 31 + 10          # country + industries + styles (config-1 shape)
+    rng = np.random.default_rng(0)
+    A = (rng.standard_normal((K, K)) / np.sqrt(K)).astype(np.float32)
+    cov = (A @ A.T + 1e-3 * np.eye(K, dtype=np.float32)) * 1e-4
+    engine = QueryEngine(
+        cov, benchmarks={"idx": 0.1 * rng.standard_normal(K)})
+
+    throughput = {}
+    for b in (1_000, 100_000, 1_000_000):
+        W = (0.2 * rng.standard_normal((b, K))).astype(np.float32)
+        bucket = bucket_for(b)
+
+        def step(W=W, bucket=bucket):
+            res = engine.query(W, bucket=bucket, trim=False)
+            return jnp.sum(res.total_vol)
+
+        _force(step())  # compile + warmup: the bucket's one allowed compile
+        times = []
+        with assert_max_compiles(1, f"steady-state query bucket {bucket}"):
+            for _ in range(3):
+                t0 = time.perf_counter()
+                _force(step())
+                times.append(time.perf_counter() - t0)
+        wall = min(times)
+        throughput[str(b)] = {"bucket": bucket, "wall_s": round(wall, 4),
+                              "portfolios_per_sec": round(b / wall)}
+
+    # the serving loop under a deterministic overload storm (gulp mode):
+    # 2048 requests against a 512-deep queue -> shed_rate 0.75 by
+    # construction, latency percentiles from the registry histograms
+    policy = ServePolicy(queue_max=512, batch_max=256,
+                         default_deadline_s=30.0)
+    server = QueryServer(engine, policy, health="ok")
+    lines = (json.dumps({"id": f"q{i}",
+                         "weights": np.round(0.2 * rng.standard_normal(K),
+                                             6).tolist()})
+             for i in range(2048))
+    summary = server.run(lines, io.StringIO(), gulp=True)
+    return {"metric": "portfolio_query_throughput",
+            "value": throughput["1000000"]["portfolios_per_sec"],
+            "unit": "portfolios/s", "vs_baseline": None,
+            "k_factors": K,
+            "throughput": throughput,
+            "serving": summary}
+
+
 CONFIGS = {
     "riskmodel": bench_riskmodel,
     "chunk_sweep": bench_chunk_sweep,
@@ -713,6 +776,7 @@ CONFIGS = {
     "alla": bench_alla,
     "alpha": bench_alpha,
     "alpha_alla": bench_alpha_alla,
+    "query": bench_query,
 }
 
 

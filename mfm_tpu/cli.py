@@ -1332,10 +1332,215 @@ def _doctor(args):
                     "(see manifest health.checks)")
             if rec["problems"]:
                 rec["status"] = "unhealthy"
+
+    # --serve: audit the newest serve manifest's breaker/shed counters —
+    # a breaker left open at shutdown means the query service exited
+    # while rejecting traffic, which is a failed serve run even if every
+    # request got a well-formed response
+    if getattr(args, "serve", False):
+        from mfm_tpu.obs.manifest import ManifestError, read_run_manifest
+
+        spath = os.path.join(man_dir, SERVE_MANIFEST_NAME)
+        rec = {"file": spath, "kind": "serve_manifest", "status": "ok",
+               "problems": [], "warnings": []}
+        records.append(rec)
+        if not os.path.exists(spath):
+            rec["status"] = "missing"
+            rec["problems"].append(
+                "no serve_manifest.json beside the artifacts — has "
+                "`mfm-tpu serve` run against this checkpoint dir?")
+        else:
+            try:
+                man = read_run_manifest(spath)
+            except ManifestError as err:
+                rec["status"] = "corrupt"
+                rec["problems"].append(str(err))
+            else:
+                serve = man.get("serve")
+                if not isinstance(serve, dict):
+                    rec["problems"].append(
+                        "serve manifest has no 'serve' summary block")
+                else:
+                    for k in ("breaker_state", "breaker_open_total",
+                              "shed_total", "shed_rate", "requests_total"):
+                        rec[k] = serve.get(k)
+                    if serve.get("breaker_state") == "open":
+                        rec["problems"].append(
+                            "circuit breaker was OPEN at shutdown — the "
+                            "service exited rejecting traffic (see "
+                            "serve.requests outcomes in the manifest)")
+                    if serve.get("shed_rate") or serve.get("shed_total"):
+                        rec["warnings"].append(
+                            f"load shedding occurred (shed_total="
+                            f"{serve.get('shed_total')}, shed_rate="
+                            f"{serve.get('shed_rate')})")
+                    ckpt = man.get("checkpoint")
+                    if ckpt and ckpt not in metas:
+                        rec["warnings"].append(
+                            f"serve manifest names checkpoint {ckpt!r}, "
+                            "which is not among the audited artifacts")
+                if man.get("health", {}).get("status") == "degraded":
+                    rec["warnings"].append(
+                        "query service ran with degraded model health "
+                        "(responses were stamped degraded)")
+                if rec["problems"]:
+                    rec["status"] = "unhealthy"
     unhealthy = sum(r["status"] != "ok" for r in records)
     print(json.dumps({"audited": len(records), "unhealthy": unhealthy,
                       "records": records}, indent=1))
     raise SystemExit(1 if unhealthy else 0)
+
+
+SERVE_MANIFEST_NAME = "serve_manifest.json"
+
+
+def _serve(args):
+    """Batched portfolio-query service over a guarded risk-state checkpoint:
+    JSONL requests in (stdin or --input), JSONL responses out, with request
+    guards + dead-letter quarantine, bounded-queue admission control with
+    oldest-first load shedding, per-request deadlines, degraded-serving
+    stamps (staleness + health verdict), and a circuit breaker
+    (docs/SERVING.md §"Query service").  At shutdown the serve summary
+    (QPS/latency/shed/breaker counters) is written to a
+    ``serve_manifest.json`` beside the checkpoint, which
+    ``mfm-tpu doctor --serve`` audits."""
+    import sys
+
+    from mfm_tpu.data.artifacts import (
+        ArtifactCorruptError, ArtifactStaleError, load_risk_state,
+        read_pointer,
+    )
+    from mfm_tpu.data.etl import with_retry
+    from mfm_tpu.obs.instrument import guard_summary_from_registry
+    from mfm_tpu.obs.manifest import (
+        ManifestError, build_run_manifest, manifest_path_for,
+        read_run_manifest, write_run_manifest,
+    )
+    from mfm_tpu.obs.metrics import REGISTRY
+    from mfm_tpu.serve.query import QueryEngine
+    from mfm_tpu.serve.server import QueryServer, ServePolicy
+
+    _metrics_init(args)
+    state_path = args.state
+
+    def _dead_letter_startup(rec: dict) -> None:
+        if not args.dead_letter:
+            return
+        rec = dict(rec)
+        rec.setdefault("kind", "startup_failure")
+        with open(args.dead_letter, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(rec, sort_keys=True) + "\n")
+
+    try:
+        state, meta = with_retry(lambda: load_risk_state(state_path),
+                                 attempts=args.load_attempts,
+                                 backoff_s=args.load_backoff_s,
+                                 retryable=(OSError,))
+    except (ArtifactCorruptError, ArtifactStaleError) as e:
+        # fence audit failed before the loop even started: nothing to
+        # serve degraded FROM, so refuse outright (post-crash triage is
+        # `mfm-tpu doctor`)
+        raise SystemExit(f"serve: checkpoint failed its fence audit: {e}")
+    except OSError as e:
+        # the retry history rides into the dead letter so the operator can
+        # tell "failed instantly" from "fought the outage"
+        _dead_letter_startup({
+            "path": state_path, "error": str(e),
+            "attempts": getattr(e, "attempts", 1),
+            "total_backoff_s": round(getattr(e, "total_backoff_s", 0.0), 3)})
+        raise SystemExit(f"serve: cannot load {state_path}: {e}")
+
+    benchmarks = None
+    if args.benchmarks:
+        with open(args.benchmarks, encoding="utf-8") as fh:
+            benchmarks = {str(k): v for k, v in json.load(fh).items()}
+
+    def _health_beside() -> str:
+        mpath = manifest_path_for(state_path)
+        if not os.path.exists(mpath):
+            return "unknown"
+        try:
+            return read_run_manifest(mpath)["health"].get("status",
+                                                          "unknown")
+        except ManifestError:
+            return "unknown"
+
+    try:
+        engine = QueryEngine.from_risk_state(state, meta,
+                                             benchmarks=benchmarks)
+    except ValueError as e:
+        raise SystemExit(f"serve: {e}")
+
+    policy = ServePolicy(
+        queue_max=args.queue_max, batch_max=args.batch_max,
+        default_deadline_s=args.deadline_s,
+        breaker_failures=args.breaker_failures,
+        breaker_cooldown_s=args.breaker_cooldown_s,
+        weight_mad_k=args.weight_mad_k)
+
+    reload_fn = None
+    if args.watch:
+        seen = {"gen": (read_pointer(state_path) or {}).get("generation")}
+
+        def reload_fn():
+            gen = (read_pointer(state_path) or {}).get("generation")
+            if gen == seen["gen"]:
+                return None
+            # fence-audit failures propagate (the server force-opens the
+            # breaker); transient IO keeps the old engine serving
+            try:
+                st, mt = with_retry(lambda: load_risk_state(state_path),
+                                    attempts=2, backoff_s=0.05,
+                                    retryable=(OSError,))
+            except OSError as e:
+                print(f"serve: reload failed after "
+                      f"{getattr(e, 'attempts', 1)} attempts "
+                      f"({getattr(e, 'total_backoff_s', 0.0):.3f}s backoff)"
+                      f": {e} — still serving the previous engine",
+                      file=sys.stderr)
+                return None
+            seen["gen"] = gen
+            return {"engine": QueryEngine.from_risk_state(
+                        st, mt, benchmarks=benchmarks),
+                    "health": _health_beside()}
+
+    server = QueryServer(engine, policy, health=_health_beside(),
+                         dead_letter_path=args.dead_letter,
+                         reload_fn=reload_fn)
+
+    in_fp = (sys.stdin if args.input in (None, "-")
+             else open(args.input, encoding="utf-8"))
+    out_fp = (sys.stdout if args.output in (None, "-")
+              else open(args.output, "w", encoding="utf-8"))
+    try:
+        summary = server.run(in_fp, out_fp, gulp=args.gulp)
+    finally:
+        if in_fp is not sys.stdin:
+            in_fp.close()
+        if out_fp is not sys.stdout:
+            out_fp.close()
+
+    manifest = build_run_manifest(
+        stamp_json=meta.get("stamp"),
+        checkpoint=state_path,
+        backend=jax_backend_name(),
+        metrics_snapshot=REGISTRY.snapshot(),
+        guard_summary=guard_summary_from_registry(),
+        health={"status": server.health, "checks": {}},
+        extra={"serve": summary},
+    )
+    spath = os.path.join(os.path.dirname(state_path) or ".",
+                         SERVE_MANIFEST_NAME)
+    write_run_manifest(spath, manifest)
+    _metrics_flush(args)
+    print(json.dumps({"serve": summary, "manifest": spath},
+                     indent=1), file=sys.stderr)
+
+
+def jax_backend_name() -> str:
+    import jax
+
+    return jax.devices()[0].platform
 
 
 def _metrics_paths(path: str, filename: str) -> str:
@@ -1860,7 +2065,63 @@ def main(argv=None):
     dr.add_argument("--force", action="store_true",
                     help="audit past a stale-generation refusal (reported "
                          "as a warning instead of a failure)")
+    dr.add_argument("--serve", action="store_true",
+                    help="also audit the serve_manifest.json beside the "
+                         "artifacts: exit non-zero if the query service's "
+                         "circuit breaker was open at shutdown; warn on "
+                         "load shedding / degraded health")
     dr.set_defaults(fn=_doctor)
+
+    sv = sub.add_parser(
+        "serve",
+        help="batched portfolio-query service over a guarded risk-state "
+             "checkpoint: JSONL requests in, JSONL responses out, with "
+             "request guards + dead-letter quarantine, bounded-queue "
+             "admission control, deadlines, load shedding, and a circuit "
+             "breaker (docs/SERVING.md §Query service)")
+    sv.add_argument("state", help="risk-state .npz saved with quarantine "
+                                  "enabled (serves its last_good_cov)")
+    sv.add_argument("--input", default="-",
+                    help="JSONL request file ('-' = stdin)")
+    sv.add_argument("--output", default="-",
+                    help="JSONL response file ('-' = stdout)")
+    sv.add_argument("--dead-letter", default=None,
+                    help="JSONL file collecting guarded-out requests "
+                         "(default: discard)")
+    sv.add_argument("--benchmarks", default=None,
+                    help="JSON file {name: [factor exposures]} of served "
+                         "benchmarks for active-risk/beta queries")
+    sv.add_argument("--queue-max", type=int, default=4096,
+                    help="admission bound; overflow sheds the OLDEST "
+                         "queued request (default 4096)")
+    sv.add_argument("--batch-max", type=int, default=1024,
+                    help="max requests per device batch (default 1024)")
+    sv.add_argument("--deadline-s", type=float, default=1.0,
+                    help="default per-request deadline budget (default 1.0)")
+    sv.add_argument("--breaker-failures", type=int, default=3,
+                    help="consecutive batch failures that open the "
+                         "circuit breaker (default 3)")
+    sv.add_argument("--breaker-cooldown-s", type=float, default=5.0,
+                    help="breaker open->half-open cooldown, also the "
+                         "retry_after_s on rejections (default 5.0)")
+    sv.add_argument("--weight-mad-k", type=float, default=0.0,
+                    help="reject requests with a weight beyond K MADs of "
+                         "the request's own median (0 = off)")
+    sv.add_argument("--gulp", action="store_true",
+                    help="read ALL input before the first drain — "
+                         "deterministic overload mode (shedding depends "
+                         "only on the input, not drain timing)")
+    sv.add_argument("--watch", action="store_true",
+                    help="poll latest.json between batches and hot-swap "
+                         "the engine when the checkpoint generation moves; "
+                         "a failed fence audit opens the breaker")
+    sv.add_argument("--load-attempts", type=int, default=3,
+                    help="startup checkpoint-load retries (default 3)")
+    sv.add_argument("--load-backoff-s", type=float, default=0.1,
+                    help="backoff between startup load retries "
+                         "(default 0.1)")
+    sv.add_argument("--metrics-dir", default=None, help=_metrics_dir_help)
+    sv.set_defaults(fn=_serve)
 
     args = ap.parse_args(argv)
     if getattr(args, "select_out", None) and args.select is None:
@@ -1875,7 +2136,7 @@ def main(argv=None):
     # subcommands that actually jit: the data-only paths (etl-*, report,
     # crosscheck) must not pay the jax import or touch the cache dir.
     if args.cmd in ("risk", "factors", "demo", "prepare", "pipeline",
-                    "alpha"):
+                    "alpha", "serve"):
         from mfm_tpu.utils.cache import enable_persistent_compilation_cache
 
         enable_persistent_compilation_cache()

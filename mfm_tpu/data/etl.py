@@ -75,6 +75,12 @@ def with_retry(fn: Callable, attempts: int = 3, backoff_s: float = 5.0,
     - ``retryable``: exception classes worth retrying; anything else
       (a programming error, an auth failure) re-raises IMMEDIATELY — two
       more identical attempts cannot fix a TypeError.
+
+    On exhaustion the raised exception carries its retry history:
+    ``e.attempts`` (calls made) and ``e.total_backoff_s`` (seconds slept
+    between them) — dead-letter records and reload failure logs in the
+    query loop stamp these so an operator can tell "failed instantly"
+    from "fought the outage for a minute".
     """
     import random
 
@@ -82,6 +88,7 @@ def with_retry(fn: Callable, attempts: int = 3, backoff_s: float = 5.0,
 
     rng = random.Random(seed)
     last = None
+    total_backoff = 0.0
     for i in range(attempts):
         try:
             result = fn()
@@ -96,8 +103,11 @@ def with_retry(fn: Callable, attempts: int = 3, backoff_s: float = 5.0,
                 if jitter:
                     delay *= 1.0 + jitter * (2.0 * rng.random() - 1.0)
                 _telemetry.RETRY_BACKOFF_SECONDS.observe(delay)
+                total_backoff += delay
                 sleep(delay)
     _telemetry.RETRY_ATTEMPTS_TOTAL.inc(outcome="exhausted")
+    last.attempts = attempts
+    last.total_backoff_s = total_backoff
     raise last
 
 

@@ -88,9 +88,21 @@ _R3_ALLOWED_PREFIXES = ("tools.",)
 # telemetry modules: host-side only, never reachable from traced code (R7)
 _R7_OBS_MODULES = ("mfm_tpu.utils.obs", "mfm_tpu.obs")
 
+# serving-loop modules that are host-side BY DESIGN (breaker, admission
+# queue, JSON decode, dead-letter IO): the traced-closure propagation
+# treats them as barriers — it neither enters nor crosses them, so the
+# conservative bare-name resolution can't drag the request loop (and,
+# through it, the telemetry registry) into the traced set off a name
+# collision like `run`/`query`/`identity`
+_R7_HOST_ONLY_MODULES = ("mfm_tpu.serve.server", "mfm_tpu.cli")
+
 
 def _is_obs_module(module: str) -> bool:
     return module in _R7_OBS_MODULES or module.startswith("mfm_tpu.obs.")
+
+
+def _is_host_only_module(module: str) -> bool:
+    return module in _R7_HOST_ONLY_MODULES
 
 _TRACER_JIT = {"jit", "pjit", "vmap", "pmap", "checkpoint", "remat", "grad",
                "value_and_grad"}
@@ -548,12 +560,19 @@ class Linter:
                         self.jax_touch.add(qual)
                         break
 
-        # traced: forward closure from roots over call edges
+        # traced: forward closure from roots over call edges.  Host-only
+        # serving modules (breaker/admission-queue/IO — _R7_HOST_ONLY_MODULES)
+        # are barriers: the conservative bare-name resolution would otherwise
+        # drag e.g. QueryServer.run into the closure off any traced call to a
+        # method NAMED run, and from there mark the whole telemetry registry
+        # traced.  Their functions can never really be traced (they json/IO/
+        # sync by design), so propagation neither enters nor crosses them.
         def propagate(seed):
             stack = list(seed)
             while stack:
                 q = stack.pop()
-                if q in self.traced:
+                if q in self.traced or \
+                        _is_host_only_module(q.split(":", 1)[0]):
                     continue
                 self.traced.add(q)
                 stack.extend(self.edges.get(q, ()))

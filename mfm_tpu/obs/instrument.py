@@ -79,6 +79,37 @@ JIT_COMPILES_TOTAL = REGISTRY.counter(
     "mfm_jit_compiles_total",
     "jit lowerings observed since watch_compiles() (steady state: flat)")
 
+# -- query service (serve/server.py request loop) -----------------------------
+
+QUERY_REQUESTS_TOTAL = REGISTRY.counter(
+    "mfm_query_requests_total", "portfolio-query requests by final outcome",
+    labelnames=("outcome",))   # ok | dead_letter | shed | rejected |
+#                                deadline | error
+QUERY_PORTFOLIOS_TOTAL = REGISTRY.counter(
+    "mfm_query_portfolios_total", "portfolios answered (ok outcomes)")
+QUERY_BATCH_SECONDS = REGISTRY.histogram(
+    "mfm_query_batch_seconds", "device step wall time per drained batch")
+QUERY_BATCH_SIZE = REGISTRY.histogram(
+    "mfm_query_batch_size", "true (unpadded) portfolios per drained batch",
+    buckets=(1, 2, 8, 32, 128, 512, 2048, 8192, 32768, 131072, 524288))
+QUERY_LATENCY_SECONDS = REGISTRY.histogram(
+    "mfm_query_latency_seconds",
+    "enqueue-to-response wall time per answered request")
+QUERY_QUEUE_DEPTH = REGISTRY.gauge(
+    "mfm_query_queue_depth", "admission queue depth after the last event")
+QUERY_SHED_TOTAL = REGISTRY.counter(
+    "mfm_query_shed_total",
+    "requests dropped (oldest-first) by queue-overflow load shedding")
+BREAKER_OPEN_TOTAL = REGISTRY.counter(
+    "mfm_breaker_open_total",
+    "circuit-breaker transitions into the open state")
+BREAKER_STATE = REGISTRY.gauge(
+    "mfm_breaker_state", "circuit breaker state (0 closed, 1 half_open, "
+    "2 open)")
+
+_BREAKER_STATE_CODE = {"closed": 0, "half_open": 1, "open": 2}
+_BREAKER_CODE_STATE = {v: k for k, v in _BREAKER_STATE_CODE.items()}
+
 
 # -- recording helpers --------------------------------------------------------
 
@@ -166,6 +197,63 @@ def unwatch_compiles() -> None:
     if unregister is not None:
         unregister(_COMPILE_WATCHER)
     _COMPILE_WATCHER = None
+
+
+def record_query_outcome(outcome: str, n: int = 1) -> None:
+    QUERY_REQUESTS_TOTAL.inc(n, outcome=outcome)
+
+
+def record_query_batch(n_true: int, seconds: float) -> None:
+    """Tally one drained batch: true (unpadded) size + device wall."""
+    QUERY_BATCH_SIZE.observe(int(n_true))
+    QUERY_BATCH_SECONDS.observe(float(seconds))
+    QUERY_PORTFOLIOS_TOTAL.inc(int(n_true))
+
+
+def record_query_latency(seconds: float) -> None:
+    QUERY_LATENCY_SECONDS.observe(float(seconds))
+
+
+def record_queue_depth(depth: int) -> None:
+    QUERY_QUEUE_DEPTH.set_value(int(depth))
+
+
+def record_shed(n: int = 1) -> None:
+    QUERY_SHED_TOTAL.inc(int(n))
+
+
+def record_breaker_state(state: str) -> None:
+    """Mirror a breaker transition onto the gauge; entering ``open`` also
+    tallies ``mfm_breaker_open_total``."""
+    BREAKER_STATE.set_value(_BREAKER_STATE_CODE[state])
+    if state == "open":
+        BREAKER_OPEN_TOTAL.inc()
+
+
+def serve_summary_from_registry() -> dict:
+    """The manifest's query-service block, off the live counters.
+
+    This is what ``mfm-tpu doctor --serve`` audits: a breaker left in the
+    open state at shutdown (``breaker_state`` = "open") is a failed serve
+    run even if every individual request got a well-formed response.
+    """
+    outcomes = {k[0]: int(v) for k, v in QUERY_REQUESTS_TOTAL.series().items()}
+    total = sum(outcomes.values())
+    shed = int(QUERY_SHED_TOTAL.value())
+    state_code = int(BREAKER_STATE.value())
+    p50 = QUERY_LATENCY_SECONDS.quantile_est(0.5)
+    p99 = QUERY_LATENCY_SECONDS.quantile_est(0.99)
+    return {
+        "requests": outcomes,
+        "requests_total": total,
+        "portfolios_total": int(QUERY_PORTFOLIOS_TOTAL.value()),
+        "shed_total": shed,
+        "shed_rate": (round(shed / total, 6) if total else 0.0),
+        "breaker_open_total": int(BREAKER_OPEN_TOTAL.value()),
+        "breaker_state": _BREAKER_CODE_STATE.get(state_code, "closed"),
+        "query_p50_latency_s": (None if p50 != p50 else round(p50, 6)),
+        "query_p99_latency_s": (None if p99 != p99 else round(p99, 6)),
+    }
 
 
 def guard_summary_from_registry() -> dict:

@@ -326,6 +326,53 @@ class RiskPipelineResult:
                                                   index=a.factor_names()),
         }
 
+    def query_engine(self, t: int = -1, benchmarks=None,
+                     half_life: float = 42.0, ngroup: int = 10,
+                     q: float = 1.0, min_periods: int = 10):
+        """Build the batched :class:`mfm_tpu.serve.query.QueryEngine` for
+        date ``t`` — the serving-side counterpart of
+        :meth:`portfolio_risk`: same X_t basis, same covariance, same
+        shrunk specific vols, but answering B portfolios per call in one
+        vmapped jit instead of one python dict each.
+
+        When the run was guarded (quarantine enabled), the engine serves
+        the guard report's ``served_cov[t]`` — the degraded-serving
+        contract — and carries its staleness stamp; otherwise the raw
+        adjusted covariance with staleness 0.  Out-of-universe stocks get
+        zeroed exposure/specific-var rows: the REQUEST guard layer, not
+        the engine math, is where invalid weight gets rejected.
+
+        ``benchmarks``: optional ``{name: (N,) stock weights}`` served for
+        active-risk/beta queries.
+        """
+        from mfm_tpu.ops.xreg import regression_design
+        from mfm_tpu.serve.query import QueryEngine
+
+        a = self.arrays
+        T = a.ret.shape[0]
+        t = int(t)
+        if not -T <= t < T:
+            raise IndexError(f"date index {t} out of range for T={T}")
+        t %= T
+        X, valid, _ = regression_design(
+            jnp.asarray(a.ret[t]), jnp.asarray(a.cap[t]),
+            jnp.asarray(a.styles[t]), jnp.asarray(a.industry[t]),
+            jnp.asarray(a.valid[t]), n_industries=a.n_industries)
+        X, valid = np.asarray(X), np.asarray(valid)
+        X = np.where(valid[:, None], X, 0.0)
+        if self.report is not None:
+            F = np.asarray(self.report.served_cov[t])
+            staleness = int(np.asarray(self.report.staleness[t]))
+        else:
+            F = np.asarray(self.outputs.vr_cov[t])
+            staleness = 0
+        sv = self._specific_panels(half_life, ngroup, q, min_periods)[1][t]
+        svar = np.where(valid & np.isfinite(sv), sv, 0.0) ** 2
+        return QueryEngine(
+            F, factor_names=a.factor_names(), exposures=X,
+            specific_var=svar, stocks=list(map(str, a.stocks)),
+            benchmarks=benchmarks, staleness=staleness)
+
 
 def run_risk_pipeline(
     barra_df=None,

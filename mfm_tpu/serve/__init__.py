@@ -1,9 +1,19 @@
-"""Serving-hardening layer: input guards + degraded-mode quarantine.
+"""Serving layer: input guards, degraded-mode quarantine, and the
+consumer-facing batched portfolio-query service.
 
-The daily-update path (``RiskModel.update``) trusts its inputs; a live feed
-does not deserve that trust.  This package holds the jit-traceable per-date
-health checks (:mod:`mfm_tpu.serve.guard`) the guarded update step runs on
-every appended slab before the date is allowed into the EWMA carries.
+Two guard surfaces protect the two directions of the serving stack:
+
+- the MODEL side — :mod:`mfm_tpu.serve.guard`'s jit-traceable per-date
+  health checks run on every appended slab before a date may enter the
+  EWMA carries (quarantine + staleness-stamped degraded covariance);
+- the REQUEST side — :mod:`mfm_tpu.serve.server`'s host-side request
+  guards, admission control, deadlines, load shedding, and circuit
+  breaker around :mod:`mfm_tpu.serve.query`'s one-vmapped-jit batch
+  engine.
+
+:mod:`mfm_tpu.serve._checks` holds the formula primitives both guard
+layers share (MAD outliers, reason-bitmask plumbing) so they cannot
+drift.
 """
 
 from mfm_tpu.serve.guard import (  # noqa: F401
@@ -17,4 +27,16 @@ from mfm_tpu.serve.guard import (  # noqa: F401
     guard_slab,
     host_date_reasons,
     reason_names,
+)
+from mfm_tpu.serve.query import (  # noqa: F401
+    QueryEngine,
+    QueryOutputs,
+    bucket_for,
+)
+from mfm_tpu.serve.server import (  # noqa: F401
+    CircuitBreaker,
+    QueryServer,
+    ServePolicy,
+    parse_request,
+    req_reason_names,
 )

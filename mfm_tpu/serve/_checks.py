@@ -1,0 +1,56 @@
+"""Shared guard primitives: MAD outlier math + reason-bitmask plumbing.
+
+Two guard layers watch the serving stack and both need the same two
+primitives: the slab guards (:mod:`mfm_tpu.serve.guard`, traced inside the
+fused update jit) and the request guards (:mod:`mfm_tpu.serve.server`,
+host-side numpy over decoded JSONL).  Before this module each layer had its
+own copy of the MAD threshold and bit-OR folding — one tuned constant or
+NaN-handling fix applied to one layer silently forks the other.  Every
+helper here takes the array namespace (``jnp`` from traced code, ``np``
+from host code) as an explicit ``xp`` argument, so there is exactly ONE
+formula per check and the backends cannot drift.
+
+Nothing here imports jax: the traced caller passes its own ``jnp``, which
+keeps this module importable from host-only tooling (mfmlint, faultinject)
+without touching a backend.
+"""
+
+from __future__ import annotations
+
+
+def mad_outlier_cells(x_use, mad_k, xp):
+    """Boolean mask of cross-sectional MAD outliers in ``x_use``.
+
+    ``x_use`` holds the values under test with every excluded cell already
+    NaN (NaN never flags: comparisons with NaN are False).  A degenerate
+    MAD of 0 — a constant cross-section — disables the check (threshold
+    +inf) rather than flagging every cell.  Works identically under numpy
+    and jax.numpy; the traced slab guard and the host-side request guard
+    call this exact function.
+    """
+    med = xp.nanmedian(x_use)
+    mad = xp.nanmedian(xp.abs(x_use - med))
+    thresh = xp.where(mad > 0, mad_k * mad, xp.inf)
+    return xp.abs(x_use - med) > thresh
+
+
+def combine_reason_bits(flag_bit_pairs, xp):
+    """OR ``bit`` into a uint32 mask for every true ``flag``.
+
+    ``flag_bit_pairs`` is an iterable of ``(flag, bit)`` where ``flag`` is
+    a boolean scalar (traced or host) and ``bit`` an int reason constant.
+    Returns the uint32 bitmask; the zero-case dtype stays uint32 under both
+    backends (the slab guard stores these in a (T,) uint32 accumulator).
+    """
+    mask = xp.uint32(0)
+    for flag, bit in flag_bit_pairs:
+        mask = mask | xp.where(flag, xp.uint32(bit), xp.uint32(0))
+    return mask
+
+
+def names_of_mask(mask: int, table) -> list:
+    """Human-readable names of the bits set in ``mask``.
+
+    ``table`` is the layer's ``((bit, name), ...)`` registry — each guard
+    layer owns its bit namespace, this owns the decoding."""
+    return [name for bit, name in table if int(mask) & bit]

@@ -29,6 +29,12 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from mfm_tpu.serve._checks import (
+    combine_reason_bits,
+    mad_outlier_cells,
+    names_of_mask,
+)
+
 # reason bitmask: a date may trip several checks at once; the report keeps
 # all of them (uint32 leaves room to grow)
 REASON_NAN_DENSITY = 1        # non-finite ret fraction inside the universe
@@ -48,7 +54,7 @@ _REASON_NAMES = (
 
 def reason_names(mask: int) -> list[str]:
     """Human-readable names of the bits set in a reason mask."""
-    return [name for bit, name in _REASON_NAMES if int(mask) & bit]
+    return names_of_mask(mask, _REASON_NAMES)
 
 
 class GuardReport(NamedTuple):
@@ -113,14 +119,11 @@ def guard_slab(ret, cap, valid, ring, ring_pos, policy, pre_reasons=None):
         ref = jnp.nanmedian(ring)
         r_uni = jnp.isfinite(ref) & (n_valid < policy.min_universe_frac * ref)
 
-        # 3. cross-sectional return outliers: |r - med| > mad_k * MAD.
-        # A degenerate MAD of 0 (constant cross-section) disables the check
-        # rather than flagging every cell.
+        # 3. cross-sectional return outliers: |r - med| > mad_k * MAD
+        # (serve/_checks.py owns the formula, shared with the request
+        # guards; a degenerate MAD disables the check, NaN never flags)
         r_use = jnp.where(vt & jnp.isfinite(rett), rett, jnp.nan)
-        med = jnp.nanmedian(r_use)
-        mad = jnp.nanmedian(jnp.abs(r_use - med))
-        thresh = jnp.where(mad > 0, policy.mad_k * mad, jnp.inf)
-        out_cells = jnp.abs(r_use - med) > thresh   # NaN compares False
+        out_cells = mad_outlier_cells(r_use, policy.mad_k, jnp)
         out_frac = jnp.sum(out_cells.astype(dtype)) / denom
         r_out = out_frac > policy.max_outlier_frac
 
@@ -128,14 +131,12 @@ def guard_slab(ret, cap, valid, ring, ring_pos, policy, pre_reasons=None):
         # non-positive or non-finite cap inside the universe is corrupt
         r_cap = jnp.any(vt & (~jnp.isfinite(capt) | (capt <= 0)))
 
-        reasons = (
-            pre
-            | jnp.where(r_nan, jnp.uint32(REASON_NAN_DENSITY), jnp.uint32(0))
-            | jnp.where(r_uni, jnp.uint32(REASON_UNIVERSE_COLLAPSE),
-                        jnp.uint32(0))
-            | jnp.where(r_out, jnp.uint32(REASON_RET_OUTLIER), jnp.uint32(0))
-            | jnp.where(r_cap, jnp.uint32(REASON_CAP_NONPOS), jnp.uint32(0))
-        )
+        reasons = pre | combine_reason_bits((
+            (r_nan, REASON_NAN_DENSITY),
+            (r_uni, REASON_UNIVERSE_COLLAPSE),
+            (r_out, REASON_RET_OUTLIER),
+            (r_cap, REASON_CAP_NONPOS),
+        ), jnp)
         q_t = reasons != 0
 
         # only healthy dates feed the trailing-universe reference

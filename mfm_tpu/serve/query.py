@@ -1,0 +1,309 @@
+"""Batched portfolio-query engine over the served covariance.
+
+The consumer-facing math of a USE4-style risk model (PAPER.md): given the
+served factor covariance F — possibly stale, possibly the quarantine
+layer's last-healthy matrix — answer, for B portfolios at once,
+
+- predicted volatility  sigma_p = sqrt(x'Fx + sum_i w_i^2 s_i^2),
+- marginal factor risk  dsigma^2/dx = Fx  and the Euler contributions
+  x_i (Fx)_i (summing exactly to x'Fx),
+- active risk vs a named benchmark  sqrt((x-xb)'F(x-xb) + ...),
+- portfolio beta vs that benchmark  cov(p, b) / var(b),
+
+in ONE vmapped, donated jit.  B portfolios x K factors is tiny per row —
+"millions of users" is a pure batching problem (ROADMAP), so the engine's
+whole job is to keep the batch on-device, padded, and compiled once.
+
+**Batch-size buckets.**  A jit specializes on shapes: serving raw request
+counts would recompile on every distinct B.  Batches are padded with zero
+rows up to a geometric bucket (:func:`bucket_for`), so the steady-state
+loop compiles once per bucket and never again —
+``utils.contracts.assert_max_compiles(1)`` per bucket is the enforced
+contract (tools/faultinject.py drives it).
+
+**Spaces.**  Requests either carry factor exposures directly (K values —
+the wire format of ``mfm-tpu serve``, where the checkpoint holds only the
+covariance) or stock weights (N values — available when the engine is
+built from a full pipeline result via
+:meth:`mfm_tpu.pipeline.RiskPipelineResult.query_engine`, which supplies
+the date's exposure matrix X and specific variances).
+
+**Donation.**  The per-call batch (weights + benchmark indices) is donated
+— it is freshly built for every call, so the jit may retire its buffer
+into the outputs.  The engine-lifetime constants (F, X, specific var,
+benchmark tables) are NOT donated: they are reused by every batch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+#: bucket ladder: base * growth**k (k = 0, 1, ...).  Geometric, so padding
+#: waste is bounded by ``growth``x and a 1e6-portfolio batch still only
+#: ever meets ~10 distinct shapes.
+BUCKET_BASE = 8
+BUCKET_GROWTH = 4
+
+
+def bucket_for(n: int, base: int = BUCKET_BASE,
+               growth: int = BUCKET_GROWTH) -> int:
+    """Smallest ladder bucket >= n (the padded batch shape)."""
+    if n < 1:
+        raise ValueError(f"batch size must be >= 1, got {n}")
+    b = int(base)
+    while b < n:
+        b *= int(growth)
+    return b
+
+
+class QueryOutputs(NamedTuple):
+    """Per-portfolio answers of one batched query (rows past the true B
+    are padding).  ``beta``/``active_risk`` vs benchmark row 0 (the zero
+    portfolio) are reported as NaN / total risk respectively — the serving
+    layer only surfaces them when a benchmark was actually named."""
+
+    total_vol: jax.Array      # (B,)
+    factor_var: jax.Array     # (B,)
+    specific_var: jax.Array   # (B,)
+    contribution: jax.Array   # (B, K) Euler x_i (Fx)_i
+    marginal: jax.Array       # (B, K) Fx
+    active_risk: jax.Array    # (B,)
+    beta: jax.Array           # (B,)
+
+
+def _one_factor(x, bidx, cov, bx):
+    """Single-portfolio factor-space query (vmapped over the batch)."""
+    Fx = cov @ x
+    fvar = x @ Fx
+    xb = bx[bidx]
+    Fxb = cov @ xb
+    a = x - xb
+    avar = a @ (cov @ a)
+    var_b = xb @ Fxb
+    beta = jnp.where(var_b > 0, (x @ Fxb) / var_b, jnp.nan)
+    zero = jnp.zeros((), x.dtype)
+    return QueryOutputs(
+        total_vol=jnp.sqrt(fvar),
+        factor_var=fvar,
+        specific_var=zero,
+        contribution=x * Fx,
+        marginal=Fx,
+        active_risk=jnp.sqrt(avar),
+        beta=beta,
+    )
+
+
+def _one_stock(w, bidx, cov, X, svar, bx, bw):
+    """Single-portfolio stock-space query (vmapped over the batch)."""
+    x = w @ X
+    Fx = cov @ x
+    fvar = x @ Fx
+    sv_p = jnp.sum(w * w * svar)
+    xb = bx[bidx]
+    wb = bw[bidx]
+    Fxb = cov @ xb
+    a = x - xb
+    avar = a @ (cov @ a) + jnp.sum((w - wb) ** 2 * svar)
+    var_b = xb @ Fxb + jnp.sum(wb * wb * svar)
+    cov_pb = x @ Fxb + jnp.sum(w * wb * svar)
+    beta = jnp.where(var_b > 0, cov_pb / var_b, jnp.nan)
+    return QueryOutputs(
+        total_vol=jnp.sqrt(fvar + sv_p),
+        factor_var=fvar,
+        specific_var=sv_p,
+        contribution=x * Fx,
+        marginal=Fx,
+        active_risk=jnp.sqrt(avar),
+        beta=beta,
+    )
+
+
+# the two batched kernels: ONE vmapped, donated jit each.  Only the batch
+# (weights, bench indices) is donated; the trailing operands are
+# engine-lifetime constants reused across calls.
+@partial(jax.jit, donate_argnums=(0, 1))
+def _batch_factor(x, bidx, cov, bx):
+    return jax.vmap(_one_factor, in_axes=(0, 0, None, None))(
+        x, bidx, cov, bx)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _batch_stock(w, bidx, cov, X, svar, bx, bw):
+    return jax.vmap(_one_stock, in_axes=(0, 0, None, None, None, None,
+                                         None))(w, bidx, cov, X, svar, bx, bw)
+
+
+class QueryEngine:
+    """Batched portfolio queries against one served covariance.
+
+    Args:
+      cov: (K, K) served factor covariance (e.g. ``state.last_good_cov``).
+      factor_names: K names defining the exposure order (defaults to
+        ``f0..f{K-1}``).
+      exposures: optional (N, K) per-stock factor exposure matrix for the
+        served date — supplying it makes this a STOCK-space engine
+        (requests carry N stock weights); omitted, requests carry K factor
+        exposures directly.
+      specific_var: optional (N,) per-stock specific VARIANCE at the served
+        date (stock space only; non-finite entries count as 0 — the guard
+        layer, not the math, polices weight on vol-less names).
+      stocks: optional N stock ids (stock space; used by the request
+        guards to map dict-keyed weights).
+      benchmarks: ``{name: vector}`` of benchmark portfolios in the
+        engine's own space (stock weights / factor exposures).
+      staleness: dates since ``cov`` was fit (stamped on every response).
+      dtype: compute dtype (defaults to ``cov``'s).
+    """
+
+    def __init__(self, cov, *, factor_names=None, exposures=None,
+                 specific_var=None, stocks=None, benchmarks=None,
+                 staleness: int = 0, dtype=None):
+        cov = np.asarray(cov)
+        if cov.ndim != 2 or cov.shape[0] != cov.shape[1]:
+            raise ValueError(f"cov must be (K, K), got {cov.shape}")
+        if not np.isfinite(cov).all():
+            raise ValueError("served covariance contains non-finite entries "
+                             "— refuse to build a query engine on it")
+        self.dtype = np.dtype(dtype) if dtype is not None else cov.dtype
+        self.K = int(cov.shape[0])
+        self.factor_names = ([f"f{i}" for i in range(self.K)]
+                             if factor_names is None
+                             else list(map(str, factor_names)))
+        if len(self.factor_names) != self.K:
+            raise ValueError(f"{len(self.factor_names)} factor names for "
+                             f"K={self.K}")
+        self.factor_index = {n: i for i, n in enumerate(self.factor_names)}
+        self.staleness = int(staleness)
+        # jnp.array (owning copy): these are jit operands; never donated,
+        # but the engine must not alias caller-mutable numpy memory
+        self._cov = jnp.array(cov.astype(self.dtype))
+        if exposures is not None:
+            X = np.asarray(exposures, self.dtype)
+            if X.ndim != 2 or X.shape[1] != self.K:
+                raise ValueError(f"exposures must be (N, {self.K}), got "
+                                 f"{X.shape}")
+            self.N = int(X.shape[0])
+            sv = (np.zeros(self.N, self.dtype) if specific_var is None
+                  else np.asarray(specific_var, self.dtype))
+            if sv.shape != (self.N,):
+                raise ValueError(f"specific_var must be ({self.N},), got "
+                                 f"{sv.shape}")
+            self._X = jnp.array(np.where(np.isfinite(X), X, 0.0))
+            self._svar = jnp.array(np.where(np.isfinite(sv), sv, 0.0))
+            self.space = "stock"
+        else:
+            if specific_var is not None:
+                raise ValueError("specific_var needs exposures (stock space)")
+            self.N = self.K
+            self._X = self._svar = None
+            self.space = "factor"
+        self.stocks = None if stocks is None else list(map(str, stocks))
+        if self.stocks is not None and len(self.stocks) != self.N:
+            raise ValueError(f"{len(self.stocks)} stock ids for N={self.N}")
+        # benchmark tables: row 0 is the zero portfolio = "no benchmark"
+        names = list(benchmarks or {})
+        self.benchmark_index = {n: i + 1 for i, n in enumerate(names)}
+        bvecs = np.zeros((len(names) + 1, self.N), self.dtype)
+        for n, row in self.benchmark_index.items():
+            v = np.asarray(benchmarks[n], self.dtype)
+            if v.shape != (self.N,) or not np.isfinite(v).all():
+                raise ValueError(f"benchmark {n!r}: need {self.N} finite "
+                                 "values")
+            bvecs[row] = v
+        if self.space == "stock":
+            self._bw = jnp.array(bvecs)
+            self._bx = self._bw @ self._X
+        else:
+            self._bw = None
+            self._bx = jnp.array(bvecs)
+
+    # -- batch entry ---------------------------------------------------------
+    def pad_batch(self, weights, bench=None, bucket: int | None = None):
+        """Host-side batch assembly: (B, D) weights + per-portfolio
+        benchmark names/indices -> zero-padded device operands at the
+        bucket shape.  Returns ``(w, bidx, B, bucket)``; ``w``/``bidx`` are
+        freshly-owned device arrays, safe to donate."""
+        w = np.asarray(weights, self.dtype)
+        if w.ndim == 1:
+            w = w[None, :]
+        B, D = w.shape
+        if D != self.N:
+            raise ValueError(
+                f"{self.space}-space engine expects {self.N} values per "
+                f"portfolio, got {D}")
+        bucket = bucket_for(B) if bucket is None else int(bucket)
+        if bucket < B:
+            raise ValueError(f"bucket {bucket} < batch size {B}")
+        wp = np.zeros((bucket, self.N), self.dtype)
+        wp[:B] = w
+        idx = np.zeros(bucket, np.int32)
+        if bench is not None:
+            bench = list(bench) if not np.isscalar(bench) else [bench] * B
+            if len(bench) != B:
+                raise ValueError(f"{len(bench)} benchmark entries for B={B}")
+            for i, b in enumerate(bench):
+                if b is None:
+                    continue
+                idx[i] = (int(b) if not isinstance(b, str)
+                          else self.benchmark_index[b])
+                if not 0 <= idx[i] < len(self.benchmark_index) + 1:
+                    raise KeyError(f"benchmark index {idx[i]} out of range")
+        return jnp.array(wp), jnp.array(idx), B, bucket
+
+    def query(self, weights, bench=None, bucket: int | None = None,
+              trim: bool = True) -> QueryOutputs:
+        """Answer B portfolio queries in one vmapped, donated jit call.
+
+        ``weights``: (B, N|K) batch (or one (N|K,) row).  ``bench``:
+        optional per-portfolio benchmark names (None entries = none).
+        ``bucket`` pins the padded shape (tests / steady-state loops);
+        default is :func:`bucket_for` of B.  With ``trim`` the outputs are
+        sliced back to B rows (numpy); ``trim=False`` returns the raw
+        padded device arrays (bench harnesses time the device step alone).
+        """
+        w, bidx, B, _ = self.pad_batch(weights, bench, bucket)
+        # one donating call site: (w, bidx) are dead past this line in
+        # either space (the padded batch is rebuilt fresh every query)
+        kernel, consts = (
+            (_batch_stock, (self._cov, self._X, self._svar, self._bx,
+                            self._bw))
+            if self.space == "stock"
+            else (_batch_factor, (self._cov, self._bx)))
+        out = kernel(w, bidx, *consts)
+        if not trim:
+            return out
+        return QueryOutputs(*(np.asarray(o)[:B] for o in out))
+
+    # -- construction from served artifacts ---------------------------------
+    @classmethod
+    def from_risk_state(cls, state, meta=None, benchmarks=None, dtype=None):
+        """Engine over a :class:`~mfm_tpu.models.risk_model.RiskModelState`
+        checkpoint's served covariance (factor space).
+
+        Requires a GUARDED state: ``last_good_cov`` + ``staleness`` are the
+        degraded-serving contract (serve/guard.py) — an unguarded state
+        holds no covariance to serve.  ``meta`` (the checkpoint's
+        ``__meta__``) supplies the factor-name order when it carries the
+        ``save_pipeline_state`` alignment fields.
+        """
+        if not getattr(state, "guarded", False):
+            raise ValueError(
+                "state has no served covariance — the query service serves "
+                "the guarded (quarantine-enabled) checkpoint's "
+                "last_good_cov; re-run the pipeline with quarantine enabled")
+        names = None
+        if meta and "style_names" in meta and "industry_codes" in meta:
+            # mirror BarraArrays.factor_names(): country + industries + styles
+            names = (["country"] + [str(c) for c in meta["industry_codes"]]
+                     + [str(s) for s in meta["style_names"]])
+        cov = np.asarray(state.last_good_cov)
+        if names is not None and len(names) != cov.shape[0]:
+            names = None   # foreign checkpoint meta; fall back to f0..fK
+        return cls(cov, factor_names=names, benchmarks=benchmarks,
+                   staleness=int(np.asarray(state.staleness)), dtype=dtype)

@@ -1,0 +1,546 @@
+"""Production-shaped request loop around :class:`~mfm_tpu.serve.query.QueryEngine`.
+
+The model side of the stack is hardened (quarantine, fenced checkpoints,
+chaos harness); this module hardens the REQUEST side.  Everything here is
+strictly host-side — JSON decoding, deques, clocks — and mfmlint R7 treats
+this module as host-only: nothing in it may be reached from traced code.
+The only device work is the one vmapped, donated jit inside
+``QueryEngine.query``, called once per drained batch.
+
+Four layers, mirroring the per-date guards of :mod:`mfm_tpu.serve.guard`:
+
+1. **Request guards** — schema/dtype validation, NaN/short-weight
+   rejection, unknown-factor mapping, all folded into a per-request reason
+   bitmask (``REQ_REASON_*``, its own namespace decoded by the shared
+   :func:`mfm_tpu.serve._checks.names_of_mask`).  Malformed requests are
+   quarantined to a dead-letter JSONL instead of killing the batch.
+2. **Admission control + deadlines** — a bounded queue with explicit
+   backpressure: overflow sheds the OLDEST queued work with a counted
+   ``shed`` outcome (latency stays bounded; the newest requests are the
+   ones still worth answering).  Every request carries a deadline budget;
+   work that expires in the queue is answered ``deadline``, never computed.
+3. **Degraded serving** — every response is stamped with the served
+   covariance's staleness and the ``obs/health.py`` verdict; a
+   :class:`CircuitBreaker` flips the loop to reject-with-retry-after when
+   health degrades past the policy threshold, the checkpoint fails its
+   fence audit on reload, or batches keep failing.
+4. **Chaos hooks** — ``chaos_point("serve.after_batch", ...)`` fires after
+   every drained batch, so tools/faultinject.py can SIGKILL the loop
+   mid-stream and assert deterministic recovery.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import time
+from typing import Callable
+
+import numpy as np
+
+from mfm_tpu.obs import instrument as _obs
+from mfm_tpu.serve._checks import combine_reason_bits, mad_outlier_cells, \
+    names_of_mask
+from mfm_tpu.utils.chaos import chaos_point
+
+# request-guard reason bitmask — its own namespace, deliberately disjoint
+# from serve/guard.py's per-date bits (a dead-letter record and a
+# quarantined date are different animals; sharing decode machinery via
+# serve/_checks.py is what keeps the two layers from drifting)
+REQ_REASON_SCHEMA = 1            # not a JSON object / missing required keys
+REQ_REASON_DTYPE = 2             # weights not coercible to finite floats
+REQ_REASON_NAN_WEIGHT = 4        # NaN/Inf weight entries
+REQ_REASON_SHORT_WEIGHTS = 8     # wrong length / empty weight vector
+REQ_REASON_UNKNOWN_FACTOR = 16   # dict weight key not in the engine's space
+REQ_REASON_UNKNOWN_BENCHMARK = 32
+REQ_REASON_WEIGHT_OUTLIER = 64   # |w - med| > mad_k * MAD (policy-gated)
+
+_REQ_REASON_NAMES = (
+    (REQ_REASON_SCHEMA, "schema"),
+    (REQ_REASON_DTYPE, "dtype"),
+    (REQ_REASON_NAN_WEIGHT, "nan_weight"),
+    (REQ_REASON_SHORT_WEIGHTS, "short_weights"),
+    (REQ_REASON_UNKNOWN_FACTOR, "unknown_factor"),
+    (REQ_REASON_UNKNOWN_BENCHMARK, "unknown_benchmark"),
+    (REQ_REASON_WEIGHT_OUTLIER, "weight_outlier"),
+)
+
+
+def req_reason_names(mask: int) -> list[str]:
+    """Human-readable names of the bits set in a request-reason mask."""
+    return names_of_mask(mask, _REQ_REASON_NAMES)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServePolicy:
+    """Admission/deadline/breaker knobs of the query loop.
+
+    Frozen + hashable like :class:`mfm_tpu.config.QuarantinePolicy`: the
+    policy is part of a serve run's identity (manifests record it), and a
+    mutable policy mid-run would make shed/deadline outcomes unreplayable.
+
+    Attributes:
+      queue_max: admission bound; an arriving request beyond it sheds the
+        OLDEST queued request (counted ``shed`` outcome).
+      batch_max: most requests drained into one device batch (the padded
+        bucket is ``bucket_for`` of the true size).
+      default_deadline_s: per-request deadline budget when the request
+        doesn't carry its own ``deadline_s``.
+      breaker_failures: consecutive batch failures that open the breaker.
+      breaker_cooldown_s: open -> half-open cooldown; also the
+        ``retry_after_s`` stamped on rejected responses.
+      weight_mad_k: MAD multiple beyond which a weight entry is an outlier
+        (shared formula with the slab guards); 0 disables the check.
+      breaker_on_degraded: force the breaker open while the model health
+        verdict is "degraded".
+    """
+
+    queue_max: int = 4096
+    batch_max: int = 1024
+    default_deadline_s: float = 1.0
+    breaker_failures: int = 3
+    breaker_cooldown_s: float = 5.0
+    weight_mad_k: float = 0.0
+    breaker_on_degraded: bool = True
+
+    def __post_init__(self):
+        if self.queue_max < 1:
+            raise ValueError(f"queue_max must be >= 1, got {self.queue_max}")
+        if self.batch_max < 1:
+            raise ValueError(f"batch_max must be >= 1, got {self.batch_max}")
+        if self.default_deadline_s <= 0:
+            raise ValueError("default_deadline_s must be > 0, got "
+                             f"{self.default_deadline_s}")
+        if self.breaker_failures < 1:
+            raise ValueError("breaker_failures must be >= 1, got "
+                             f"{self.breaker_failures}")
+        if self.breaker_cooldown_s < 0:
+            raise ValueError("breaker_cooldown_s must be >= 0, got "
+                             f"{self.breaker_cooldown_s}")
+        if self.weight_mad_k < 0:
+            raise ValueError(f"weight_mad_k must be >= 0, got "
+                             f"{self.weight_mad_k}")
+
+    def identity(self) -> tuple:
+        return tuple(getattr(self, f.name)
+                     for f in dataclasses.fields(self))
+
+
+class CircuitBreaker:
+    """closed -> open -> half_open -> closed breaker with injectable clock.
+
+    ``closed``: all traffic admitted; ``failures`` consecutive
+    :meth:`record_failure` calls open it.  ``open``: everything rejected
+    with a retry-after until ``cooldown_s`` elapses, then the next
+    :meth:`allow` admits ONE probe (half_open).  ``half_open``: probe
+    success closes, probe failure re-opens (cooldown restarts).
+    :meth:`force_open` is the degraded-health / fence-audit path — it
+    records why, and the reason rides on rejected responses.
+    """
+
+    def __init__(self, failures: int = 3, cooldown_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self._threshold = int(failures)
+        self._cooldown = float(cooldown_s)
+        self._clock = clock
+        self._state = "closed"
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self.open_reason: str | None = None
+        _obs.record_breaker_state(self._state)
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def _to(self, state: str) -> None:
+        if state != self._state:
+            self._state = state
+            _obs.record_breaker_state(state)
+
+    def allow(self) -> bool:
+        """Admit a request?  May transition open -> half_open."""
+        if self._state == "open":
+            if self._clock() - self._opened_at >= self._cooldown:
+                self._to("half_open")
+                return True
+            return False
+        return True
+
+    def record_success(self) -> None:
+        self._consecutive = 0
+        if self._state == "half_open":
+            self.open_reason = None
+            self._to("closed")
+
+    def record_failure(self) -> None:
+        self._consecutive += 1
+        if self._state == "half_open" or \
+                self._consecutive >= self._threshold:
+            self.force_open("failures")
+
+    def force_open(self, reason: str) -> None:
+        self._consecutive = 0
+        self._opened_at = self._clock()
+        self.open_reason = reason
+        # re-arm the cooldown even if already open (repeated force_open
+        # keeps rejecting); only a transition tallies breaker_open_total
+        self._to("open")
+
+    def retry_after(self) -> float:
+        if self._state != "open":
+            return 0.0
+        return max(0.0, self._cooldown - (self._clock() - self._opened_at))
+
+
+class _Request:
+    __slots__ = ("rid", "weights", "bidx", "enq_t", "deadline_t")
+
+    def __init__(self, rid, weights, bidx, enq_t, deadline_t):
+        self.rid = rid
+        self.weights = weights
+        self.bidx = bidx
+        self.enq_t = enq_t
+        self.deadline_t = deadline_t
+
+
+def parse_request(line: str, engine, policy: ServePolicy):
+    """Decode + guard one JSONL request.
+
+    Returns ``(fields_or_None, reason_mask, detail)``: a zero mask means
+    the request is admissible and ``fields`` is ``(rid, weights (D,)
+    float, bidx int, deadline_s float)``; a nonzero mask means dead-letter
+    (``detail`` says what tripped, ``rid`` may still be recoverable and is
+    returned inside ``detail``-bearing fields as None).
+    """
+    mask = 0
+    rid = None
+    try:
+        obj = json.loads(line)
+    except (ValueError, TypeError) as e:
+        return None, REQ_REASON_SCHEMA, f"bad json: {e}"
+    if not isinstance(obj, dict):
+        return None, REQ_REASON_SCHEMA, "request must be a JSON object"
+    rid = obj.get("id")
+    raw_w = obj.get("weights")
+    if raw_w is None:
+        return (rid, None, 0, 0.0), REQ_REASON_SCHEMA, "missing 'weights'"
+
+    detail = ""
+    if isinstance(raw_w, dict):
+        # name-keyed weights: map onto the engine's own axis order.  In
+        # factor space the keys are factor names; in stock space stock ids.
+        names = (engine.stocks if engine.space == "stock" and engine.stocks
+                 else engine.factor_names if engine.space == "factor"
+                 else None)
+        if names is None:
+            return (rid, None, 0, 0.0), REQ_REASON_SCHEMA, \
+                "dict weights need a named axis (engine has no stock ids)"
+        index = (engine.factor_index if engine.space == "factor"
+                 else {n: i for i, n in enumerate(names)})
+        w = np.zeros(engine.N, np.float64)
+        unknown = [k for k in raw_w if k not in index]
+        if unknown:
+            mask |= REQ_REASON_UNKNOWN_FACTOR
+            detail = f"unknown names: {sorted(unknown)[:5]}"
+        else:
+            try:
+                for k, v in raw_w.items():
+                    w[index[k]] = float(v)
+            except (TypeError, ValueError) as e:
+                mask |= REQ_REASON_DTYPE
+                detail = f"non-numeric weight: {e}"
+    else:
+        try:
+            w = np.asarray(raw_w, np.float64)
+        except (TypeError, ValueError) as e:
+            w = None
+            mask |= REQ_REASON_DTYPE
+            detail = f"weights not coercible: {e}"
+        if w is not None and (w.ndim != 1 or
+                              not np.issubdtype(w.dtype, np.number)):
+            mask |= REQ_REASON_DTYPE if w.ndim == 1 else \
+                REQ_REASON_SHORT_WEIGHTS
+            detail = detail or f"weights must be a flat numeric list, got " \
+                f"ndim={w.ndim} dtype={w.dtype}"
+            w = None
+
+    if w is not None and not (mask & (REQ_REASON_DTYPE |
+                                      REQ_REASON_UNKNOWN_FACTOR)):
+        flags = []
+        if w.shape != (engine.N,):
+            flags.append((True, REQ_REASON_SHORT_WEIGHTS))
+            detail = f"expected {engine.N} weights, got {w.shape[0]}"
+        elif not np.isfinite(w).all():
+            flags.append((True, REQ_REASON_NAN_WEIGHT))
+            detail = f"{int((~np.isfinite(w)).sum())} non-finite weights"
+        elif policy.weight_mad_k > 0 and w.shape[0] >= 4:
+            # same MAD formula as the traced slab guard (serve/_checks.py)
+            out = mad_outlier_cells(w.astype(np.float64),
+                                    policy.weight_mad_k, np)
+            if bool(out.any()):
+                flags.append((True, REQ_REASON_WEIGHT_OUTLIER))
+                detail = f"{int(out.sum())} weight outliers beyond " \
+                    f"{policy.weight_mad_k} MAD"
+        mask |= int(combine_reason_bits(flags, np))
+
+    bidx = 0
+    bench = obj.get("benchmark")
+    if bench is not None:
+        bidx = engine.benchmark_index.get(str(bench), -1)
+        if bidx < 0:
+            mask |= REQ_REASON_UNKNOWN_BENCHMARK
+            detail = detail or f"unknown benchmark {bench!r} (have " \
+                f"{sorted(engine.benchmark_index)})"
+            bidx = 0
+    try:
+        deadline_s = float(obj.get("deadline_s", policy.default_deadline_s))
+        if not (deadline_s > 0):
+            raise ValueError(deadline_s)
+    except (TypeError, ValueError):
+        mask |= REQ_REASON_SCHEMA
+        detail = detail or f"bad deadline_s {obj.get('deadline_s')!r}"
+        deadline_s = policy.default_deadline_s
+    return (rid, w, bidx, deadline_s), int(mask), detail
+
+
+class QueryServer:
+    """The batched request loop: admit -> queue -> drain -> respond.
+
+    Args:
+      engine: the :class:`QueryEngine` to answer with (swappable under
+        load via :meth:`swap` / ``reload_fn``).
+      policy: :class:`ServePolicy` (admission, deadlines, breaker).
+      health: the model-health verdict string stamped on every response
+        ("ok" | "degraded" | "unknown" — ``obs/health.py``'s vocabulary);
+        "degraded" force-opens the breaker when the policy says so.
+      dead_letter_path: JSONL file collecting guarded-out requests.
+      clock: monotonic clock (injectable for deterministic tests).
+      reload_fn: optional zero-arg callable polled between batches; it
+        returns None (no change) or ``{"engine": ..., "health": ...}``; a
+        fence-audit failure (ArtifactCorrupt/Stale) force-opens the
+        breaker instead of serving a checkpoint that failed its audit.
+    """
+
+    def __init__(self, engine, policy: ServePolicy | None = None, *,
+                 health: str = "unknown", dead_letter_path=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 reload_fn=None):
+        self.engine = engine
+        self.policy = policy or ServePolicy()
+        self.health = str(health)
+        self.breaker = CircuitBreaker(self.policy.breaker_failures,
+                                      self.policy.breaker_cooldown_s,
+                                      clock=clock)
+        self._clock = clock
+        self._queue: collections.deque[_Request] = collections.deque()
+        self._batch_i = 0
+        self._dead_path = dead_letter_path
+        self._dead_fp = None
+        self._reload_fn = reload_fn
+        if self.health == "degraded" and self.policy.breaker_on_degraded:
+            self.breaker.force_open("health_degraded")
+
+    # -- degraded serving ----------------------------------------------------
+    def _stamp(self, resp: dict) -> dict:
+        resp["staleness"] = int(self.engine.staleness)
+        resp["health"] = self.health
+        resp["degraded"] = bool(self.engine.staleness > 0
+                                or self.health != "ok")
+        return resp
+
+    def swap(self, engine=None, health: str | None = None) -> None:
+        """Hot-swap the served engine / health verdict (checkpoint reload
+        under load).  Degraded health force-opens the breaker; a recovery
+        to "ok" lets the normal cooldown -> half-open -> closed path run
+        (no instant flap back to closed)."""
+        if engine is not None:
+            self.engine = engine
+        if health is not None:
+            self.health = str(health)
+            if self.health == "degraded" and self.policy.breaker_on_degraded:
+                self.breaker.force_open("health_degraded")
+
+    def poll_reload(self) -> None:
+        """Between-batch checkpoint watch: apply ``reload_fn``'s swap, or
+        force the breaker open if the new checkpoint fails its fence
+        audit."""
+        if self._reload_fn is None:
+            return
+        from mfm_tpu.data.artifacts import ArtifactCorruptError, \
+            ArtifactStaleError
+        try:
+            upd = self._reload_fn()
+        except (ArtifactCorruptError, ArtifactStaleError):
+            self.breaker.force_open("fence_audit")
+            return
+        if upd:
+            self.swap(engine=upd.get("engine"), health=upd.get("health"))
+
+    # -- dead letter ---------------------------------------------------------
+    def _dead_letter(self, rid, mask: int, detail: str, line: str,
+                     extra: dict | None = None) -> None:
+        if self._dead_path is None:
+            return
+        rec = {"id": rid, "reasons": req_reason_names(mask), "mask": int(mask),
+               "detail": detail, "line": line[:2048]}
+        if extra:
+            rec.update(extra)
+        if self._dead_fp is None:
+            self._dead_fp = open(self._dead_path, "a", encoding="utf-8")
+        self._dead_fp.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._dead_fp.flush()
+
+    # -- admission -----------------------------------------------------------
+    def submit_line(self, line: str) -> list[dict]:
+        """Admit one JSONL request.  Returns the IMMEDIATE responses this
+        event produced (rejection, dead-letter ack, shed notices for
+        displaced older work); an admitted request answers later, at
+        drain."""
+        out = []
+        if not self.breaker.allow():
+            _obs.record_query_outcome("rejected")
+            return [self._stamp({
+                "id": _peek_id(line), "ok": False, "outcome": "rejected",
+                "retry_after_s": round(self.breaker.retry_after(), 3),
+                "breaker": self.breaker.open_reason or "open"})]
+        fields, mask, detail = parse_request(line, self.engine, self.policy)
+        if mask:
+            rid = fields[0] if fields else None
+            self._dead_letter(rid, mask, detail, line)
+            _obs.record_query_outcome("dead_letter")
+            return [self._stamp({"id": rid, "ok": False,
+                                 "outcome": "dead_letter",
+                                 "reasons": req_reason_names(mask),
+                                 "detail": detail})]
+        rid, w, bidx, deadline_s = fields
+        now = self._clock()
+        self._queue.append(_Request(rid, w, bidx, now, now + deadline_s))
+        # bounded queue: shedding drops the OLDEST queued work first —
+        # under overload the head of the queue is the request whose
+        # deadline is nearest death; the freshest work is the most useful
+        while len(self._queue) > self.policy.queue_max:
+            old = self._queue.popleft()
+            _obs.record_shed()
+            _obs.record_query_outcome("shed")
+            out.append(self._stamp({"id": old.rid, "ok": False,
+                                    "outcome": "shed"}))
+        _obs.record_queue_depth(len(self._queue))
+        return out
+
+    # -- drain ---------------------------------------------------------------
+    def drain(self) -> list[dict]:
+        """Answer up to ``batch_max`` queued requests in ONE device batch.
+
+        Deadline-expired requests are answered ``deadline`` without
+        touching the device.  A batch failure tallies the breaker; the
+        chaos point fires after every drained batch (crash-recovery plans
+        key on its deterministic ``batch{i}`` path)."""
+        taken = []
+        while self._queue and len(taken) < self.policy.batch_max:
+            taken.append(self._queue.popleft())
+        _obs.record_queue_depth(len(self._queue))
+        if not taken:
+            return []
+        now = self._clock()
+        live, out = [], []
+        for r in taken:
+            if now > r.deadline_t:
+                _obs.record_query_outcome("deadline")
+                out.append(self._stamp({"id": r.rid, "ok": False,
+                                        "outcome": "deadline"}))
+            else:
+                live.append(r)
+        if not live:
+            return out
+        if not self.breaker.allow():
+            # breaker opened between admission and drain (forced open by a
+            # failed reload / degraded health): reject the queued work
+            for r in live:
+                _obs.record_query_outcome("rejected")
+                out.append(self._stamp({
+                    "id": r.rid, "ok": False, "outcome": "rejected",
+                    "retry_after_s": round(self.breaker.retry_after(), 3),
+                    "breaker": self.breaker.open_reason or "open"}))
+            return out
+        W = np.stack([r.weights for r in live]).astype(self.engine.dtype)
+        bench = [r.bidx for r in live]
+        t0 = time.perf_counter()
+        try:
+            res = self.engine.query(W, bench=bench)
+        except Exception as e:   # noqa: BLE001 — any batch failure trips
+            self.breaker.record_failure()
+            for r in live:
+                _obs.record_query_outcome("error")
+                out.append(self._stamp({"id": r.rid, "ok": False,
+                                        "outcome": "error",
+                                        "detail": str(e)[:500]}))
+            return out
+        dt = time.perf_counter() - t0
+        self.breaker.record_success()
+        _obs.record_query_batch(len(live), dt)
+        done = self._clock()
+        for i, r in enumerate(live):
+            _obs.record_query_outcome("ok")
+            _obs.record_query_latency(max(0.0, done - r.enq_t))
+            resp = {"id": r.rid, "ok": True, "outcome": "ok",
+                    "total_vol": float(res.total_vol[i]),
+                    "factor_var": float(res.factor_var[i]),
+                    "specific_var": float(res.specific_var[i]),
+                    "contribution": np.asarray(
+                        res.contribution[i]).tolist(),
+                    "marginal": np.asarray(res.marginal[i]).tolist()}
+            if r.bidx > 0:
+                resp["active_risk"] = float(res.active_risk[i])
+                resp["beta"] = float(res.beta[i])
+            out.append(self._stamp(resp))
+        chaos_point("serve.after_batch", f"batch{self._batch_i}")
+        self._batch_i += 1
+        return out
+
+    # -- the loop ------------------------------------------------------------
+    def run(self, lines, out_fp, *, gulp: bool = False) -> dict:
+        """Serve a JSONL stream: one request per line in, one response per
+        event out.  ``gulp`` reads ALL input before the first drain — the
+        deterministic overload mode (queue-overflow chaos plans and tests
+        need shedding to depend only on the input, not on drain timing).
+        Returns the final serve summary (also the manifest block)."""
+
+        def emit(resps):
+            # flush per event batch: an emitted response is durable even if
+            # the process is SIGKILLed before the next drain (the chaos
+            # kill plans assert the survivor prefix replays bitwise)
+            for r in resps:
+                out_fp.write(json.dumps(r, sort_keys=True) + "\n")
+            if resps:
+                out_fp.flush()
+
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            emit(self.submit_line(line))
+            if not gulp and len(self._queue) >= self.policy.batch_max:
+                self.poll_reload()
+                emit(self.drain())
+        while self._queue:
+            self.poll_reload()
+            emit(self.drain())
+        out_fp.flush()
+        self.close()
+        return _obs.serve_summary_from_registry()
+
+    def close(self) -> None:
+        if self._dead_fp is not None:
+            self._dead_fp.close()
+            self._dead_fp = None
+
+
+def _peek_id(line: str):
+    """Best-effort request id off a line we're rejecting unparsed."""
+    try:
+        obj = json.loads(line)
+        return obj.get("id") if isinstance(obj, dict) else None
+    except (ValueError, TypeError):
+        return None

@@ -45,6 +45,11 @@ CRASH_POINTS = (
     "save_artifact.after_rename",  # new file live, pointer not yet swapped
     "run_manifest.after_tmp",      # checkpoint live, manifest tmp not yet
                                    # renamed (obs/manifest.py)
+    "serve.after_batch",           # query loop: batch i's responses emitted,
+                                   # batch i+1 not yet drained; path is
+                                   # "batch{i}" so MFM_CHAOS_KILL_MATCH pins
+                                   # the kill to an exact batch
+                                   # (serve/server.py)
 )
 
 
@@ -193,7 +198,9 @@ class FaultPlan:
 
     name: str
     kind: str        # truncate | corrupt | kill | kill_manifest | nan_slab |
-                     # outlier_slab | universe_slab | flaky_store
+                     # outlier_slab | universe_slab | flaky_store |
+                     # query_kill | query_poison | query_overflow |
+                     # query_swap | query_steady
     seed: int = 0
     params: tuple = ()   # ((key, value), ...) — hashable, printable
 
@@ -226,4 +233,16 @@ def plan_suite(seed: int = 0) -> tuple:
                   (("n_failures", 2),)),
         FaultPlan("kill-at-manifest", "kill_manifest", s + 10,
                   (("point", "run_manifest.after_tmp"),)),
+        # query-loop plans (tools/faultinject.py RUNNERS): the request-side
+        # robustness matrix of the batched portfolio-query service
+        FaultPlan("query-kill-mid-batch", "query_kill", s + 11,
+                  (("point", "serve.after_batch"), ("match", "batch1"))),
+        FaultPlan("query-poison-slab", "query_poison", s + 12,
+                  (("n_poison", 6),)),
+        FaultPlan("query-overflow-storm", "query_overflow", s + 13,
+                  (("queue_max", 8), ("storm", 24))),
+        FaultPlan("query-ckpt-swap", "query_swap", s + 14,
+                  (("corrupt_bytes", 8),)),
+        FaultPlan("query-steady-state", "query_steady", s + 15,
+                  (("rounds", 6),)),
     )

@@ -189,7 +189,9 @@ def test_plan_suite_is_deterministic():
     assert {p.kind for p in a} == {"truncate", "corrupt", "kill",
                                    "kill_manifest", "nan_slab",
                                    "outlier_slab", "universe_slab",
-                                   "flaky_store"}
+                                   "flaky_store", "query_kill",
+                                   "query_poison", "query_overflow",
+                                   "query_swap", "query_steady"}
     assert len({p.seed for p in a}) == len(a)
 
 

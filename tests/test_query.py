@@ -1,0 +1,188 @@
+"""Batched portfolio-query engine (mfm_tpu/serve/query.py): math vs
+NumPy, the bitwise batch==singles contract (including ragged batches
+padded across different buckets), padding/validation, guarded-checkpoint
+refusal, and the <=1-compile-per-bucket steady state."""
+
+import types
+
+import numpy as np
+import pytest
+
+from mfm_tpu.serve import QueryEngine, bucket_for
+from mfm_tpu.utils.contracts import assert_max_compiles
+
+K = 5
+
+
+def _cov(seed=0, k=K, dtype=np.float64):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((k, k)) / np.sqrt(k)
+    return ((a @ a.T + 1e-3 * np.eye(k)) * 1e-4).astype(dtype)
+
+
+@pytest.fixture
+def factor_engine():
+    rng = np.random.default_rng(1)
+    return QueryEngine(_cov(), benchmarks={"idx": rng.standard_normal(K)})
+
+
+@pytest.fixture
+def stock_engine():
+    rng = np.random.default_rng(2)
+    n = 11
+    X = rng.standard_normal((n, K))
+    svar = (0.02 * rng.random(n)) ** 2
+    bench = rng.dirichlet(np.ones(n))
+    return QueryEngine(_cov(), exposures=X, specific_var=svar,
+                       stocks=[f"s{i}" for i in range(n)],
+                       benchmarks={"bmk": bench})
+
+
+def test_bucket_ladder():
+    assert [bucket_for(n) for n in (1, 8, 9, 32, 33, 1000)] == \
+        [8, 8, 32, 32, 128, 2048]
+    assert bucket_for(100_000) == 131072
+    assert bucket_for(1_000_000) == 2097152
+    with pytest.raises(ValueError):
+        bucket_for(0)
+
+
+def test_factor_math_vs_numpy(factor_engine):
+    eng = factor_engine
+    rng = np.random.default_rng(3)
+    W = rng.standard_normal((7, K))
+    res = eng.query(W, bench=["idx"] * 7)
+    F = np.asarray(_cov())
+    xb = np.asarray(eng._bx)  # benchmark table; row 1 is "idx"
+    for i in range(7):
+        x = W[i]
+        Fx = F @ x
+        fvar = x @ Fx
+        np.testing.assert_allclose(res.factor_var[i], fvar, rtol=1e-12)
+        np.testing.assert_allclose(res.total_vol[i], np.sqrt(fvar),
+                                   rtol=1e-12)
+        np.testing.assert_allclose(res.marginal[i], Fx, rtol=1e-12)
+        np.testing.assert_allclose(res.contribution[i], x * Fx, rtol=1e-12)
+        # Euler: contributions sum exactly to the factor variance
+        np.testing.assert_allclose(res.contribution[i].sum(), fvar,
+                                   rtol=1e-10)
+        a = x - xb[1]
+        np.testing.assert_allclose(res.active_risk[i],
+                                   np.sqrt(a @ F @ a), rtol=1e-12)
+        np.testing.assert_allclose(res.beta[i],
+                                   (x @ F @ xb[1]) / (xb[1] @ F @ xb[1]),
+                                   rtol=1e-12)
+    assert float(res.specific_var[i]) == 0.0  # factor space: no idio term
+
+
+def test_stock_math_vs_numpy(stock_engine):
+    eng = stock_engine
+    rng = np.random.default_rng(4)
+    n = eng.N
+    W = rng.dirichlet(np.ones(n), size=3)
+    res = eng.query(W, bench=["bmk", None, "bmk"])
+    F = _cov()
+    X = np.asarray(eng._X)
+    svar = np.asarray(eng._svar)
+    wb = np.asarray(eng._bw)[1]
+    for i in range(3):
+        w = W[i]
+        x = w @ X
+        fvar = x @ F @ x
+        sv = np.sum(w * w * svar)
+        np.testing.assert_allclose(res.total_vol[i], np.sqrt(fvar + sv),
+                                   rtol=1e-12)
+        np.testing.assert_allclose(res.specific_var[i], sv, rtol=1e-12)
+    # benchmark row: active risk includes the specific leg; beta via
+    # cov(p,b)/var(b) with the idio cross term
+    w, i = W[0], 0
+    x, xbv = w @ X, wb @ X
+    a = x - xbv
+    avar = a @ F @ a + np.sum((w - wb) ** 2 * svar)
+    var_b = xbv @ F @ xbv + np.sum(wb * wb * svar)
+    cov_pb = x @ F @ xbv + np.sum(w * wb * svar)
+    np.testing.assert_allclose(res.active_risk[i], np.sqrt(avar), rtol=1e-12)
+    np.testing.assert_allclose(res.beta[i], cov_pb / var_b, rtol=1e-12)
+    # no benchmark (row 1): beta vs the zero portfolio is NaN, never 0/0
+    assert np.isnan(res.beta[1])
+
+
+@pytest.mark.parametrize("space", ["factor", "stock"])
+def test_batch_equals_singles_bitwise(space, factor_engine, stock_engine):
+    """One vmapped batch of B portfolios == B single-portfolio queries,
+    BITWISE — even though the ragged batch pads to a LARGER bucket than
+    the singles do (row-local dataflow; the compile contract depends on
+    cross-bucket determinism holding)."""
+    eng = factor_engine if space == "factor" else stock_engine
+    bname = "idx" if space == "factor" else "bmk"
+    rng = np.random.default_rng(5)
+    B = 13                      # bucket 32; singles pad to bucket 8
+    W = rng.standard_normal((B, eng.N))
+    bench = [bname if i % 3 == 0 else None for i in range(B)]
+    batch = eng.query(W, bench=bench)
+    for i in range(B):
+        one = eng.query(W[i], bench=[bench[i]])
+        for field in batch._fields:
+            got = np.asarray(getattr(batch, field))[i]
+            want = np.asarray(getattr(one, field))[0]
+            assert np.array_equal(got, want, equal_nan=True), \
+                f"{field} row {i}: batch != single (bitwise)"
+
+
+def test_pad_batch_validation(factor_engine):
+    eng = factor_engine
+    with pytest.raises(ValueError, match="expects 5 values"):
+        eng.pad_batch(np.zeros((2, 4)))
+    with pytest.raises(ValueError, match="bucket 8 < batch"):
+        eng.pad_batch(np.zeros((9, K)), bucket=8)
+    with pytest.raises(ValueError, match="3 benchmark entries"):
+        eng.pad_batch(np.zeros((2, K)), bench=["idx", None, "idx"])
+    with pytest.raises(KeyError):
+        eng.pad_batch(np.zeros((2, K)), bench=["nope", None])
+    w, bidx, B, bucket = eng.pad_batch(np.zeros((3, K)), bench=["idx"] * 3)
+    assert (B, bucket) == (3, 8)
+    assert w.shape == (8, K) and bidx.shape == (8,)
+    assert bidx.dtype == np.int32
+    assert list(np.asarray(bidx)) == [1, 1, 1, 0, 0, 0, 0, 0]
+
+
+def test_engine_input_validation():
+    with pytest.raises(ValueError, match="must be \\(K, K\\)"):
+        QueryEngine(np.zeros((2, 3)))
+    with pytest.raises(ValueError, match="non-finite"):
+        QueryEngine(np.full((2, 2), np.nan))
+    with pytest.raises(ValueError, match="needs exposures"):
+        QueryEngine(_cov(), specific_var=np.ones(K))
+    with pytest.raises(ValueError, match="finite"):
+        QueryEngine(_cov(), benchmarks={"b": [np.nan] * K})
+
+
+def test_from_risk_state_requires_guarded():
+    with pytest.raises(ValueError, match="quarantine"):
+        QueryEngine.from_risk_state(types.SimpleNamespace(guarded=False))
+
+
+def test_from_risk_state_names_and_staleness():
+    state = types.SimpleNamespace(guarded=True, last_good_cov=_cov(k=4),
+                                  staleness=np.int32(2))
+    meta = {"style_names": ["size"], "industry_codes": [10, 20]}
+    eng = QueryEngine.from_risk_state(state, meta)
+    assert eng.factor_names == ["country", "10", "20", "size"]
+    assert eng.staleness == 2 and eng.space == "factor"
+    # meta from a foreign checkpoint (wrong K): fall back to f0..fK
+    eng2 = QueryEngine.from_risk_state(state, {"style_names": ["a"],
+                                               "industry_codes": [1]})
+    assert eng2.factor_names == ["f0", "f1", "f2", "f3"]
+
+
+def test_steady_state_compile_contract(factor_engine):
+    """Same-bucket batches after warmup never recompile (the serving
+    loop's <=1-compile-per-bucket contract, telemetry or not)."""
+    eng = factor_engine
+    rng = np.random.default_rng(6)
+    eng.query(rng.standard_normal((6, K)))          # warmup bucket 8
+    eng.query(rng.standard_normal((20, K)))         # warmup bucket 32
+    with assert_max_compiles(1, "steady-state query buckets"):
+        for b in (3, 8, 17, 32, 5, 30):
+            res = eng.query(rng.standard_normal((b, K)))
+            assert res.total_vol.shape == (b,)

@@ -1,0 +1,292 @@
+"""Query-service request loop (mfm_tpu/serve/server.py): per-bit request
+guards + dead-letter records, circuit-breaker transitions on an injected
+clock, shed-oldest admission control, deadline expiry, degraded-serving
+stamps, the end-to-end JSONL loop, and the `doctor --serve` audit."""
+
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mfm_tpu.serve import (
+    CircuitBreaker,
+    QueryEngine,
+    QueryServer,
+    ServePolicy,
+    parse_request,
+    req_reason_names,
+)
+from mfm_tpu.serve.server import (
+    REQ_REASON_DTYPE,
+    REQ_REASON_NAN_WEIGHT,
+    REQ_REASON_SCHEMA,
+    REQ_REASON_SHORT_WEIGHTS,
+    REQ_REASON_UNKNOWN_BENCHMARK,
+    REQ_REASON_UNKNOWN_FACTOR,
+    REQ_REASON_WEIGHT_OUTLIER,
+)
+
+K = 4
+
+
+def _engine(staleness=0):
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((K, K)) / 2
+    cov = (a @ a.T + 1e-3 * np.eye(K)) * 1e-4
+    return QueryEngine(cov, factor_names=["country", "ind0", "size", "mom"],
+                       benchmarks={"idx": rng.standard_normal(K)},
+                       staleness=staleness)
+
+
+class Clock:
+    """Injectable monotonic clock the breaker/deadline tests advance."""
+
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _req(rid, w=None, **kw):
+    return json.dumps({"id": rid,
+                       "weights": [0.1] * K if w is None else w, **kw})
+
+
+# -- request guards ----------------------------------------------------------
+
+@pytest.mark.parametrize("line,bit", [
+    ('{"id": "x", "weights": [0.1,', REQ_REASON_SCHEMA),       # torn json
+    ('"not an object"', REQ_REASON_SCHEMA),
+    (json.dumps({"id": "x"}), REQ_REASON_SCHEMA),              # no weights
+    (_req("x", deadline_s=-1), REQ_REASON_SCHEMA),
+    (_req("x", w=["a"] * K), REQ_REASON_DTYPE),
+    (_req("x", w={"country": "NaNope"}), REQ_REASON_DTYPE),
+    (_req("x", w=[0.1, float("nan"), 0.1, 0.1]), REQ_REASON_NAN_WEIGHT),
+    (_req("x", w=[0.1]), REQ_REASON_SHORT_WEIGHTS),
+    (_req("x", w=[[0.1] * K]), REQ_REASON_SHORT_WEIGHTS),      # 2-D
+    (_req("x", w={"country": 1.0, "bogus": 2.0}), REQ_REASON_UNKNOWN_FACTOR),
+    (_req("x", benchmark="nope"), REQ_REASON_UNKNOWN_BENCHMARK),
+])
+def test_parse_request_reason_bits(line, bit):
+    fields, mask, detail = parse_request(line, _engine(), ServePolicy())
+    assert mask & bit, f"expected bit {req_reason_names(bit)} in " \
+        f"{req_reason_names(mask)} ({detail!r})"
+
+
+def test_parse_request_weight_outlier_gated():
+    # nonzero MAD needed: a constant cross-section disables the check
+    line = _req("x", w=[0.1, 0.12, 0.09, 99.0])
+    _, mask, _ = parse_request(line, _engine(), ServePolicy())
+    assert mask == 0                      # mad_k=0: check disabled
+    _, mask, _ = parse_request(line, _engine(),
+                               ServePolicy(weight_mad_k=5.0))
+    assert mask == REQ_REASON_WEIGHT_OUTLIER
+
+
+def test_parse_request_dict_weights_and_benchmark():
+    line = _req("x", w={"size": 0.7, "mom": 0.3}, benchmark="idx",
+                deadline_s=2.5)
+    fields, mask, _ = parse_request(line, _engine(), ServePolicy())
+    assert mask == 0
+    rid, w, bidx, deadline_s = fields
+    assert rid == "x" and bidx == 1 and deadline_s == 2.5
+    np.testing.assert_array_equal(w, [0.0, 0.0, 0.7, 0.3])
+
+
+def test_dead_letter_records(tmp_path):
+    dl = str(tmp_path / "dead.jsonl")
+    server = QueryServer(_engine(), ServePolicy(), health="ok",
+                         dead_letter_path=dl)
+    out = server.submit_line(_req("bad", w=[1.0]))
+    assert out[0]["outcome"] == "dead_letter"
+    assert out[0]["reasons"] == ["short_weights"]
+    server.close()
+    rec, = [json.loads(ln) for ln in open(dl)]
+    assert rec["id"] == "bad" and rec["reasons"] == ["short_weights"]
+    assert rec["mask"] == REQ_REASON_SHORT_WEIGHTS and rec["line"]
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+def test_breaker_full_cycle():
+    clk = Clock()
+    br = CircuitBreaker(failures=2, cooldown_s=5.0, clock=clk)
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    assert br.state == "closed"           # 1 < threshold
+    br.record_failure()
+    assert br.state == "open" and br.open_reason == "failures"
+    assert not br.allow()
+    assert br.retry_after() == pytest.approx(5.0)
+    clk.t += 5.0
+    assert br.allow() and br.state == "half_open"   # one probe admitted
+    br.record_success()
+    assert br.state == "closed" and br.open_reason is None
+    # half-open probe FAILURE re-opens immediately (no threshold count)
+    br.record_failure()
+    br.record_failure()
+    clk.t += 5.0
+    assert br.allow() and br.state == "half_open"
+    br.record_failure()
+    assert br.state == "open"
+
+
+def test_breaker_force_open_rearms_cooldown():
+    clk = Clock()
+    br = CircuitBreaker(failures=3, cooldown_s=10.0, clock=clk)
+    br.force_open("health_degraded")
+    clk.t += 8.0
+    br.force_open("fence_audit")          # re-armed: 10 s from NOW
+    assert br.retry_after() == pytest.approx(10.0)
+    assert br.open_reason == "fence_audit"
+
+
+# -- admission control / deadlines ------------------------------------------
+
+def test_shed_oldest_ordering():
+    policy = ServePolicy(queue_max=4, batch_max=4, default_deadline_s=60.0)
+    server = QueryServer(_engine(), policy, health="ok")
+    buf = io.StringIO()
+    lines = [_req(f"q{i}") for i in range(10)]
+    server.run(iter(lines), buf, gulp=True)
+    resps = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+    assert [r["id"] for r in resps if r["outcome"] == "shed"] == \
+        [f"q{i}" for i in range(6)]       # oldest first, in arrival order
+    assert {r["id"] for r in resps if r["outcome"] == "ok"} == \
+        {"q6", "q7", "q8", "q9"}          # the newest queue_max survive
+
+
+def test_deadline_expiry_no_device_work():
+    clk = Clock()
+    server = QueryServer(_engine(), ServePolicy(default_deadline_s=60.0),
+                         health="ok", clock=clk)
+    server.submit_line(_req("fast", deadline_s=1.0))
+    server.submit_line(_req("slow", deadline_s=100.0))
+    clk.t += 2.0                          # "fast" dies in the queue
+    out = {r["id"]: r for r in server.drain()}
+    assert out["fast"]["outcome"] == "deadline" and not out["fast"]["ok"]
+    assert out["slow"]["outcome"] == "ok"
+
+
+# -- degraded serving --------------------------------------------------------
+
+def test_degraded_stamps_and_breaker():
+    clk = Clock()
+    server = QueryServer(_engine(staleness=3), ServePolicy(),
+                         health="degraded", clock=clk)
+    # degraded health at construction force-opens the breaker
+    resp, = server.submit_line(_req("r1"))
+    assert resp["outcome"] == "rejected"
+    assert resp["breaker"] == "health_degraded"
+    assert resp["retry_after_s"] > 0
+    assert resp["degraded"] is True and resp["staleness"] == 3
+
+
+def test_swap_to_healthy_recovers_via_halfopen():
+    clk = Clock()
+    policy = ServePolicy(breaker_cooldown_s=5.0, default_deadline_s=60.0)
+    server = QueryServer(_engine(staleness=3), policy, health="degraded",
+                         clock=clk)
+    server.swap(engine=_engine(staleness=0), health="ok")
+    # recovery is NOT instant: the normal cooldown -> half-open path runs
+    assert server.submit_line(_req("r1"))[0]["outcome"] == "rejected"
+    clk.t += 5.0
+    assert server.submit_line(_req("r2")) == []       # probe admitted
+    ok, = server.drain()
+    assert ok["outcome"] == "ok" and ok["degraded"] is False
+    assert server.breaker.state == "closed"
+
+
+def test_reload_fence_failure_opens_breaker():
+    from mfm_tpu.data.artifacts import ArtifactCorruptError
+
+    def reload_fn():
+        raise ArtifactCorruptError("checksum mismatch")
+
+    server = QueryServer(_engine(), ServePolicy(default_deadline_s=60.0),
+                         health="ok", reload_fn=reload_fn)
+    server.submit_line(_req("r1"))
+    server.poll_reload()
+    assert server.breaker.state == "open"
+    assert server.breaker.open_reason == "fence_audit"
+    out, = server.drain()                 # queued work rejected, not served
+    assert out["outcome"] == "rejected" and out["breaker"] == "fence_audit"
+
+
+# -- the loop end to end ------------------------------------------------------
+
+def test_run_e2e_summary_and_stamps():
+    from mfm_tpu.obs.instrument import serve_summary_from_registry
+
+    before = serve_summary_from_registry()
+    server = QueryServer(_engine(), ServePolicy(batch_max=3,
+                                                default_deadline_s=60.0),
+                         health="ok")
+    buf = io.StringIO()
+    lines = [_req(f"q{i}", benchmark="idx" if i == 0 else None)
+             for i in range(7)]
+    summary = server.run(iter(lines), buf, gulp=True)
+    resps = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+    assert len(resps) == 7 and all(r["outcome"] == "ok" for r in resps)
+    assert all(r["health"] == "ok" and r["staleness"] == 0
+               and r["degraded"] is False for r in resps)
+    with_b = [r for r in resps if r["id"] == "q0"]
+    assert "beta" in with_b[0] and "active_risk" in with_b[0]
+    assert all("beta" not in r for r in resps if r["id"] != "q0")
+    # registry is process-global: assert the DELTA this run contributed
+    assert summary["requests_total"] - before["requests_total"] == 7
+    assert summary["portfolios_total"] - before["portfolios_total"] == 7
+    assert summary["breaker_state"] == "closed"
+    assert summary["query_p50_latency_s"] is not None
+
+
+# -- doctor --serve -----------------------------------------------------------
+
+def _write_serve_manifest(d, serve_block):
+    from mfm_tpu.obs.manifest import build_run_manifest, write_run_manifest
+
+    man = build_run_manifest(backend="cpu",
+                             health={"status": "ok", "checks": {}},
+                             extra={"serve": serve_block})
+    write_run_manifest(os.path.join(d, "serve_manifest.json"), man)
+
+
+def _doctor_rc(args):
+    from mfm_tpu import cli
+
+    with pytest.raises(SystemExit) as exc:
+        cli.main(["doctor", *args])
+    return exc.value.code
+
+
+def test_doctor_serve_audit(tmp_path, capsys):
+    from mfm_tpu.data.artifacts import save_artifact
+
+    d = str(tmp_path)
+    # doctor refuses an empty dir outright; give it one healthy artifact
+    save_artifact(os.path.join(d, "x.npz"), {"a": np.zeros(2)})
+    # no serve manifest at all: --serve makes that a problem
+    assert _doctor_rc([d, "--serve"]) == 1
+    assert _doctor_rc([d]) == 0           # without --serve: nothing to audit
+    # healthy summary: breaker closed, nothing shed
+    _write_serve_manifest(d, {"breaker_state": "closed",
+                              "breaker_open_total": 0, "shed_total": 0,
+                              "shed_rate": 0.0, "requests_total": 5})
+    capsys.readouterr()                   # drop the earlier runs' output
+    assert _doctor_rc([d, "--serve"]) == 0
+    rec = [r for r in json.loads(capsys.readouterr().out)["records"]
+           if r["kind"] == "serve_manifest"][0]
+    assert rec["status"] == "ok" and rec["breaker_state"] == "closed"
+    # breaker open at shutdown: the serve run failed, exit nonzero
+    _write_serve_manifest(d, {"breaker_state": "open",
+                              "breaker_open_total": 2, "shed_total": 3,
+                              "shed_rate": 0.1, "requests_total": 30})
+    assert _doctor_rc([d, "--serve"]) == 1
+    rec = [r for r in json.loads(capsys.readouterr().out)["records"]
+           if r["kind"] == "serve_manifest"][0]
+    assert rec["status"] == "unhealthy"
+    assert any("OPEN at shutdown" in p for p in rec["problems"])
+    assert any("shedding" in w for w in rec["warnings"])

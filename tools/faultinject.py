@@ -31,6 +31,16 @@ recovery contracts the production loop promises (docs/SERVING.md):
   accepts.
 - **Steady state**: after warmup, the per-date guarded serving loop stays
   within ONE jit compile (``assert_max_compiles``).
+- **Query-service faults** (query-*): the request side of the stack
+  (serve/query.py + serve/server.py).  A real ``mfm-tpu serve`` subprocess
+  is SIGKILLed mid-stream and its durable responses must be a bitwise
+  prefix of the clean replay; poisoned request slabs dead-letter with the
+  right reasons while healthy answers stay bitwise; a queue-overflow storm
+  sheds EXACTLY the oldest requests and serves the survivors bitwise; a
+  checkpoint hot-swap under load answers each batch bitwise from its own
+  generation and a corrupt swap trips the breaker (``fence_audit``); and
+  the steady-state query loop holds ``assert_max_compiles(1)`` per padded
+  batch bucket with telemetry on.
 
 Everything is seeded (fault plans, synthetic panel); a failing plan
 replays exactly.  Exit 0 iff every plan passes; ``--out`` writes the JSON
@@ -496,10 +506,326 @@ def run_steady_state(base: Baseline, root: str) -> dict:
     return {"dates_served": 3, "compiles": c.count}
 
 
+# -- query-service plans -----------------------------------------------------
+
+def _query_engine(path: str):
+    """Factor-space engine over a guarded checkpoint (what `mfm-tpu serve`
+    builds).  A fresh instance per call: baselines must not share jit-donated
+    operands with the server under test."""
+    from mfm_tpu.data.artifacts import load_risk_state
+    from mfm_tpu.serve import QueryEngine
+
+    state, meta = load_risk_state(path)
+    return QueryEngine.from_risk_state(state, meta)
+
+
+def _query_requests(seed: int, n: int, k: int,
+                    deadline_s: float = 600.0) -> list:
+    """Seeded JSONL request lines (ids q0..q{n-1}, K factor exposures).
+    Deadlines are generous: these plans assert recovery determinism, not
+    wall-clock behaviour."""
+    rng = np.random.default_rng(seed)
+    return [json.dumps({"id": f"q{i}",
+                        "weights": np.round(rng.normal(0.0, 1.0, k),
+                                            6).tolist(),
+                        "deadline_s": deadline_s}, sort_keys=True)
+            for i in range(n)]
+
+
+def run_query_kill(plan, base: Baseline, root: str) -> dict:
+    """query-kill-mid-batch: SIGKILL a real `mfm-tpu serve` subprocess at
+    the end of a named batch.  Responses emitted before the kill are
+    durable (flushed per drain), and the clean replay's prefix must match
+    them byte-for-byte — same floats, same order."""
+    d = _fresh_workdir(root, plan.name, base.snaps[0])
+    path = os.path.join(d, "state.npz")
+    k = _query_engine(path).K
+    req = os.path.join(d, "req.jsonl")
+    with open(req, "w") as fh:
+        fh.write("\n".join(_query_requests(plan.seed, 24, k)) + "\n")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo_root}
+
+    def _serve_cmd(out_name):
+        return [sys.executable, "-m", "mfm_tpu.cli", "serve", path,
+                "--input", req, "--output", os.path.join(d, out_name),
+                "--dead-letter", os.path.join(d, "dead_letter.jsonl"),
+                "--batch-max", "8", "--deadline-s", "600", "--gulp"]
+
+    kill_env = {**env, "MFM_CHAOS_KILL": plan.param("point"),
+                "MFM_CHAOS_KILL_MATCH": plan.param("match")}
+    proc = subprocess.run(_serve_cmd("resp_killed.jsonl"), env=kill_env,
+                          capture_output=True, text=True, timeout=600)
+    if proc.returncode != -signal.SIGKILL:
+        raise AssertionError(
+            f"{plan.name}: expected the serve loop to die by SIGKILL at "
+            f"{plan.param('match')}, got rc={proc.returncode}\n"
+            f"{proc.stderr[-2000:]}")
+    with open(os.path.join(d, "resp_killed.jsonl")) as fh:
+        survivors = [ln for ln in fh.read().splitlines() if ln]
+    # killed at the END of batch 1's drain: batch 0's 8 responses were
+    # emitted and flushed, batch 1's were computed but never written
+    if len(survivors) != 8:
+        raise AssertionError(f"{plan.name}: expected batch 0's 8 durable "
+                             f"responses before the kill, found "
+                             f"{len(survivors)}")
+    proc2 = subprocess.run(_serve_cmd("resp_clean.jsonl"), env=env,
+                           capture_output=True, text=True, timeout=600)
+    if proc2.returncode != 0:
+        raise AssertionError(f"{plan.name}: clean replay failed "
+                             f"rc={proc2.returncode}\n{proc2.stderr[-2000:]}")
+    with open(os.path.join(d, "resp_clean.jsonl")) as fh:
+        clean = [ln for ln in fh.read().splitlines() if ln]
+    if len(clean) != 24:
+        raise AssertionError(f"{plan.name}: clean replay answered "
+                             f"{len(clean)}/24 requests")
+    if survivors != clean[:len(survivors)]:
+        raise AssertionError(f"{plan.name}: pre-kill responses diverge from "
+                             "the clean replay's prefix — the query loop is "
+                             "not deterministic across restarts")
+    return {"killed_at": plan.param("match"),
+            "durable_responses": len(survivors)}
+
+
+def run_query_poison(plan, base: Baseline, root: str) -> dict:
+    """query-poison-slab: malformed requests dead-letter with the right
+    reason bits and never reach the device; the healthy requests' answers
+    are byte-for-byte the all-clean run's."""
+    import io
+
+    from mfm_tpu.serve import QueryServer, ServePolicy
+
+    d = _fresh_workdir(root, plan.name, base.snaps[0])
+    path = os.path.join(d, "state.npz")
+    engine = _query_engine(path)
+    k = engine.K
+    clean = _query_requests(plan.seed, 18, k)
+    poison = [
+        ('{"id": "p-json", "weights": [0.1,', None, "schema"),
+        (json.dumps({"id": "p-missing"}), "p-missing", "schema"),
+        (json.dumps({"id": "p-nan", "weights": [float("nan")] * k}),
+         "p-nan", "nan_weight"),
+        (json.dumps({"id": "p-short", "weights": [0.5]}),
+         "p-short", "short_weights"),
+        (json.dumps({"id": "p-dtype", "weights": ["x"] * k}),
+         "p-dtype", "dtype"),
+        (json.dumps({"id": "p-bench", "weights": [0.1] * k,
+                     "benchmark": "nope"}), "p-bench", "unknown_benchmark"),
+    ]
+    if len(poison) != int(plan.param("n_poison", len(poison))):
+        raise AssertionError(f"{plan.name}: plan expects "
+                             f"{plan.param('n_poison')} poisoned requests, "
+                             f"harness built {len(poison)}")
+    # interleave one poisoned request every 3 clean ones — the dead-letter
+    # path must not disturb the batching of the requests around it
+    lines = []
+    for i, ln in enumerate(clean):
+        if i % 3 == 0 and i // 3 < len(poison):
+            lines.append(poison[i // 3][0])
+        lines.append(ln)
+    policy = ServePolicy(batch_max=8, default_deadline_s=600.0)
+    dl = os.path.join(d, "dead_letter.jsonl")
+    buf = io.StringIO()
+    QueryServer(engine, policy, health="ok",
+                dead_letter_path=dl).run(iter(lines), buf, gulp=True)
+    resps = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+    with open(dl) as fh:
+        records = [json.loads(ln) for ln in fh.read().splitlines()]
+    got = sorted(((r["id"], tuple(r["reasons"])) for r in records), key=str)
+    want = sorted(((rid, (reason,)) for _, rid, reason in poison), key=str)
+    if got != want:
+        raise AssertionError(f"{plan.name}: dead-letter records {got} != "
+                             f"expected {want}")
+    ok = {r["id"]: r for r in resps if r["outcome"] == "ok"}
+    if set(ok) != {f"q{i}" for i in range(len(clean))}:
+        raise AssertionError(f"{plan.name}: healthy requests not all "
+                             f"answered ok: {sorted(ok)}")
+    # reference: a run that never saw the poison — identical batches, so
+    # identical bytes per healthy id
+    buf2 = io.StringIO()
+    QueryServer(_query_engine(path), policy,
+                health="ok").run(iter(clean), buf2, gulp=True)
+    ref = {r["id"]: r for r in
+           (json.loads(ln) for ln in buf2.getvalue().splitlines())}
+    for rid, resp in ok.items():
+        if resp != ref[rid]:
+            raise AssertionError(f"{plan.name}: healthy response {rid} "
+                                 "diverged from the poison-free run")
+    return {"dead_lettered": len(records), "healthy_ok": len(ok)}
+
+
+def run_query_overflow(plan, base: Baseline, root: str) -> dict:
+    """query-overflow-storm: a storm past the admission bound sheds
+    EXACTLY the oldest requests, in order, and the survivors' answers are
+    bitwise the engine's own."""
+    import io
+
+    from mfm_tpu.obs.instrument import serve_summary_from_registry
+    from mfm_tpu.serve import QueryServer, ServePolicy
+
+    queue_max = int(plan.param("queue_max", 8))
+    storm = int(plan.param("storm", 24))
+    d = _fresh_workdir(root, plan.name, base.snaps[0])
+    path = os.path.join(d, "state.npz")
+    engine = _query_engine(path)
+    lines = _query_requests(plan.seed, storm, engine.K)
+    policy = ServePolicy(queue_max=queue_max, batch_max=queue_max,
+                         default_deadline_s=600.0)
+    before = serve_summary_from_registry()
+    buf = io.StringIO()
+    summary = QueryServer(engine, policy,
+                          health="ok").run(iter(lines), buf, gulp=True)
+    resps = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+    shed = [r["id"] for r in resps if r["outcome"] == "shed"]
+    n_shed = storm - queue_max
+    if shed != [f"q{i}" for i in range(n_shed)]:
+        raise AssertionError(f"{plan.name}: shed set/order {shed} is not "
+                             f"oldest-first q0..q{n_shed - 1}")
+    ok = {r["id"]: r for r in resps if r["outcome"] == "ok"}
+    if set(ok) != {f"q{i}" for i in range(n_shed, storm)}:
+        raise AssertionError(f"{plan.name}: survivors {sorted(ok)} are not "
+                             f"the newest {queue_max} requests")
+    # in-process registry is cumulative across plans: assert the DELTA
+    if summary["shed_total"] - before["shed_total"] != n_shed:
+        raise AssertionError(f"{plan.name}: shed_total counted "
+                             f"{summary['shed_total'] - before['shed_total']}"
+                             f", expected {n_shed}")
+    ref = _query_engine(path)
+    W = np.array([json.loads(lines[i])["weights"]
+                  for i in range(n_shed, storm)], ref.dtype)
+    res = ref.query(W)
+    for j, i in enumerate(range(n_shed, storm)):
+        r = ok[f"q{i}"]
+        if (r["total_vol"] != float(res.total_vol[j])
+                or r["contribution"] != np.asarray(
+                    res.contribution[j]).tolist()):
+            raise AssertionError(f"{plan.name}: survivor q{i} diverged from "
+                                 "the engine's own answer")
+    return {"shed": n_shed, "served": queue_max}
+
+
+def run_query_swap(plan, base: Baseline, root: str) -> dict:
+    """query-ckpt-swap: hot-swap the engine under load — each batch must
+    answer bitwise from its OWN checkpoint generation; a swap to a corrupt
+    checkpoint force-opens the breaker (fence_audit) and the queued work is
+    rejected with a retry-after, never computed on the bad state."""
+    import io
+
+    from mfm_tpu.data.artifacts import ArtifactCorruptError, load_risk_state
+    from mfm_tpu.serve import QueryServer, ServePolicy
+    from mfm_tpu.utils.chaos import corrupt_file
+
+    d = _fresh_workdir(root, plan.name, base.snaps[0])           # gen A
+    d2 = _fresh_workdir(root, plan.name + "-next", base.snaps[1])  # gen B
+    path_a = os.path.join(d, "state.npz")
+    path_b = os.path.join(d2, "state.npz")
+    engine_a = _query_engine(path_a)
+    engine_b = _query_engine(path_b)
+    # gen B again, corrupted: the swap that must NOT be served
+    d3 = _fresh_workdir(root, plan.name + "-corrupt", base.snaps[1])
+    path_c = os.path.join(d3, "state.npz")
+    corrupt_file(path_c, int(plan.param("corrupt_bytes", 8)), plan.seed)
+    try:
+        load_risk_state(path_c)
+    except ArtifactCorruptError as err:
+        fence_err = err
+    else:
+        raise AssertionError(f"{plan.name}: corrupted swap target loaded "
+                             "clean")
+
+    steps = [None, {"engine": engine_b, "health": "ok"}, fence_err]
+
+    def reload_fn():
+        step = steps.pop(0) if steps else None
+        if isinstance(step, Exception):
+            raise step
+        return step
+
+    lines = _query_requests(plan.seed, 24, engine_a.K)
+    policy = ServePolicy(batch_max=8, default_deadline_s=600.0)
+    buf = io.StringIO()
+    server = QueryServer(engine_a, policy, health="ok", reload_fn=reload_fn)
+    server.run(iter(lines), buf, gulp=True)
+    byid = {r["id"]: r for r in
+            (json.loads(ln) for ln in buf.getvalue().splitlines())}
+    W = np.array([json.loads(ln)["weights"] for ln in lines], np.float64)
+    ref_a = _query_engine(path_a).query(W[:8].astype(engine_a.dtype))
+    ref_b = _query_engine(path_b).query(W[8:16].astype(engine_b.dtype))
+    # the reference must be discriminating: gen B answers these weights
+    # differently than gen A would, so a silently-failed swap cannot pass
+    decoy = _query_engine(path_a).query(W[8:16].astype(engine_a.dtype))
+    if np.array_equal(np.asarray(ref_b.total_vol),
+                      np.asarray(decoy.total_vol)):
+        raise AssertionError(f"{plan.name}: generations A and B answer "
+                             "identically — the swap check proves nothing")
+    for start, ref, eng in ((0, ref_a, engine_a), (8, ref_b, engine_b)):
+        for j in range(8):
+            r = byid[f"q{start + j}"]
+            if r["outcome"] != "ok":
+                raise AssertionError(f"{plan.name}: q{start + j} answered "
+                                     f"{r['outcome']}, expected ok")
+            if (r["total_vol"] != float(ref.total_vol[j])
+                    or r["staleness"] != int(eng.staleness)):
+                raise AssertionError(
+                    f"{plan.name}: q{start + j} not served bitwise from its "
+                    "own checkpoint generation")
+    for i in range(16, 24):
+        r = byid[f"q{i}"]
+        if r["outcome"] != "rejected" or r.get("breaker") != "fence_audit" \
+                or not r.get("retry_after_s", 0) > 0:
+            raise AssertionError(f"{plan.name}: q{i} after the corrupt swap "
+                                 f"got {r}, expected a fence_audit rejection "
+                                 "with retry-after")
+    if server.breaker.state != "open" \
+            or server.breaker.open_reason != "fence_audit":
+        raise AssertionError(f"{plan.name}: breaker ended "
+                             f"{server.breaker.state}/"
+                             f"{server.breaker.open_reason}, expected "
+                             "open/fence_audit")
+    return {"swapped_at_batch": 1, "breaker": "fence_audit", "rejected": 8}
+
+
+def run_query_steady(plan, base: Baseline, root: str) -> dict:
+    """query-steady-state: after one warmup round per bucket, an arbitrary
+    number of same-bucket query batches — telemetry recording on every
+    drain — compiles at most once more (the per-bucket <=1-compile
+    contract of serve/query.py)."""
+    from mfm_tpu.serve import QueryServer, ServePolicy, bucket_for
+    from mfm_tpu.utils.contracts import assert_max_compiles
+
+    d = _fresh_workdir(root, plan.name, base.snaps[0])
+    engine = _query_engine(os.path.join(d, "state.npz"))
+    rounds = int(plan.param("rounds", 6))
+    sizes = (5, 20)     # buckets 8 and 32 on the default ladder
+    policy = ServePolicy(batch_max=64, default_deadline_s=600.0)
+    server = QueryServer(engine, policy, health="ok")
+
+    def run_round(r):
+        for s in sizes:
+            for ln in _query_requests(plan.seed + 31 * r + s, s, engine.K):
+                server.submit_line(ln)
+            out = server.drain()
+            if len(out) != s or any(x["outcome"] != "ok" for x in out):
+                raise AssertionError(
+                    f"{plan.name}: round {r} size {s} answered "
+                    f"{[x['outcome'] for x in out]}")
+
+    run_round(0)   # warmup: compiles each bucket once
+    with assert_max_compiles(1, "steady-state query loop") as c:
+        for r in range(1, rounds):
+            run_round(r)
+    return {"rounds": rounds,
+            "buckets": [bucket_for(s) for s in sizes],
+            "steady_compiles": c.count}
+
+
 RUNNERS = {"truncate": run_byte_fault, "corrupt": run_byte_fault,
            "kill": run_kill, "kill_manifest": run_kill_manifest,
            "nan_slab": run_poison, "outlier_slab": run_poison,
-           "universe_slab": run_poison, "flaky_store": run_flaky_store}
+           "universe_slab": run_poison, "flaky_store": run_flaky_store,
+           "query_kill": run_query_kill, "query_poison": run_query_poison,
+           "query_overflow": run_query_overflow, "query_swap": run_query_swap,
+           "query_steady": run_query_steady}
 
 
 def main(argv=None) -> int:
