@@ -768,6 +768,66 @@ def bench_query():
             "serving": summary}
 
 
+def bench_scenario():
+    """Config 7: the batched scenario engine (scenario/engine.py).
+
+    scenarios_per_sec at S = 16 / 256 / 4096 over a CSI300-shaped factor
+    space — a representative mix of vol shocks, regime multipliers and
+    correlation stress, each S padded to its geometric bucket and holding
+    the <=1-compile steady-state contract — plus the obs registry's batch
+    latency percentiles (telemetry recording on, like production)."""
+    from mfm_tpu.obs.instrument import scenario_summary_from_registry
+    from mfm_tpu.scenario import ScenarioBuilder, ScenarioEngine
+    from mfm_tpu.serve import bucket_for
+    from mfm_tpu.utils.contracts import assert_max_compiles
+
+    K = 1 + 31 + 10          # country + industries + styles (config-1 shape)
+    rng = np.random.default_rng(0)
+    A = (rng.standard_normal((K, K)) / np.sqrt(K)).astype(np.float32)
+    cov = (A @ A.T + 1e-3 * np.eye(K, dtype=np.float32)) * 1e-4
+    names = [f"f{i}" for i in range(K)]
+    engine = ScenarioEngine(cov, factor_names=names)
+
+    def specs_for(S):
+        out = []
+        for i in range(S):
+            b = ScenarioBuilder(f"s{i}")
+            b.shock(names[i % K], add=1e-4 * (1 + i % 7))
+            b.vol_regime(1.0 + 0.1 * (i % 5))
+            if i % 3 == 0:
+                b.correlation(0.2 + 0.1 * (i % 4))
+            out.append(b.build())
+        return out
+
+    throughput = {}
+    for S in (16, 256, 4096):
+        specs = specs_for(S)
+        bucket = bucket_for(S)
+        engine.run(specs)  # compile + warmup: the bucket's one allowed compile
+        times, res = [], None
+        with assert_max_compiles(1, f"steady-state scenario bucket {bucket}"):
+            for _ in range(3):
+                t0 = time.perf_counter()
+                res = engine.run(specs)
+                # engine.run already materializes every lane to numpy;
+                # forcing the last cov keeps the span visibly synchronous
+                _force(res[-1].cov[0, 0])
+                times.append(time.perf_counter() - t0)
+        bad = [r.spec.name for r in res if not r.ok]
+        if bad:
+            raise AssertionError(f"bench scenarios rejected: {bad[:5]}")
+        wall = min(times)
+        throughput[str(S)] = {"bucket": bucket, "wall_s": round(wall, 4),
+                              "scenarios_per_sec": round(S / wall)}
+
+    return {"metric": "scenario_throughput",
+            "value": throughput["4096"]["scenarios_per_sec"],
+            "unit": "scenarios/s", "vs_baseline": None,
+            "k_factors": K,
+            "throughput": throughput,
+            "summary": scenario_summary_from_registry()}
+
+
 CONFIGS = {
     "riskmodel": bench_riskmodel,
     "chunk_sweep": bench_chunk_sweep,
@@ -777,6 +837,7 @@ CONFIGS = {
     "alpha": bench_alpha,
     "alpha_alla": bench_alpha_alla,
     "query": bench_query,
+    "scenario": bench_scenario,
 }
 
 

@@ -1385,6 +1385,38 @@ def _doctor(args):
                         "(responses were stamped degraded)")
                 if rec["problems"]:
                     rec["status"] = "unhealthy"
+
+    # --scenarios: audit the scenario manifest beside the artifacts — a
+    # torn write, an embedded spec whose recomputed hash disagrees with
+    # the recorded one, or inconsistent counts all mean the last stress
+    # run cannot be trusted (tools/faultinject.py's scenario plans drive
+    # this exact check after a mid-write SIGKILL)
+    if getattr(args, "scenarios", False):
+        from mfm_tpu.scenario.manifest import (
+            ScenarioManifestError, audit_scenario_manifest,
+            scenario_manifest_path_for,
+        )
+
+        scpath = scenario_manifest_path_for(man_dir)
+        rec = {"file": scpath, "kind": "scenario_manifest", "status": "ok",
+               "problems": [], "warnings": []}
+        records.append(rec)
+        if not os.path.exists(scpath):
+            rec["status"] = "missing"
+            rec["problems"].append(
+                "no scenario_manifest.json beside the artifacts — has "
+                "`mfm-tpu scenario run` run against this checkpoint dir?")
+        else:
+            try:
+                problems, warnings = audit_scenario_manifest(scpath)
+            except ScenarioManifestError as err:
+                rec["status"] = "corrupt"
+                rec["problems"].append(str(err))
+            else:
+                rec["problems"].extend(problems)
+                rec["warnings"].extend(warnings)
+                if rec["problems"]:
+                    rec["status"] = "unhealthy"
     unhealthy = sum(r["status"] != "ok" for r in records)
     print(json.dumps({"audited": len(records), "unhealthy": unhealthy,
                       "records": records}, indent=1))
@@ -1535,6 +1567,99 @@ def _serve(args):
     _metrics_flush(args)
     print(json.dumps({"serve": summary, "manifest": spath},
                      indent=1), file=sys.stderr)
+
+
+def _scenario(args):
+    """Batched stress tests over a guarded risk-state checkpoint: factor
+    shocks, vol-regime multipliers, correlation stress, historical replay
+    and quarantine counterfactuals, all padded into ONE donated jit per
+    S-bucket (docs/SCENARIOS.md).  ``run`` writes an atomic
+    ``scenario_manifest.json`` beside the checkpoint, which
+    ``mfm-tpu doctor --scenarios`` audits; ``list`` prints the preset
+    catalog."""
+    import sys
+
+    from mfm_tpu.scenario import (
+        PRESET_NOTES, PRESETS, ScenarioEngine, ScenarioSpec,
+        build_scenario_manifest, preset, write_scenario_manifest,
+    )
+
+    if args.scmd == "list":
+        catalog = [{"name": n, "note": PRESET_NOTES.get(n, ""),
+                    "kinds": list(PRESETS[n].kinds),
+                    "spec": PRESETS[n].to_dict()}
+                   for n in sorted(PRESETS)]
+        print(json.dumps({"presets": catalog}, indent=1))
+        return
+
+    from mfm_tpu.data.artifacts import (
+        ArtifactCorruptError, ArtifactStaleError, load_risk_state,
+    )
+    from mfm_tpu.obs.instrument import scenario_summary_from_registry
+
+    _metrics_init(args)
+    try:
+        state, meta = load_risk_state(args.state)
+    except (ArtifactCorruptError, ArtifactStaleError) as e:
+        # same refusal as `serve`: a checkpoint past its fence audit is
+        # not a world worth stressing (post-crash triage is `doctor`)
+        raise SystemExit(f"scenario: checkpoint failed its fence audit: {e}")
+    except OSError as e:
+        raise SystemExit(f"scenario: cannot load {args.state}: {e}")
+
+    specs = []
+    try:
+        for name in args.preset:
+            specs.append(preset(name))
+    except KeyError as e:
+        raise SystemExit(f"scenario: {e.args[0]}")
+    for path in args.spec:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                obj = json.load(fh)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"scenario: cannot read spec file {path}: {e}")
+        try:
+            for d in (obj if isinstance(obj, list) else [obj]):
+                specs.append(ScenarioSpec.from_dict(d))
+        except (TypeError, ValueError, KeyError) as e:
+            raise SystemExit(f"scenario: bad spec in {path}: {e}")
+    if not specs:
+        raise SystemExit("scenario run: no scenarios given — use --preset "
+                         "and/or --spec (`mfm-tpu scenario list` shows the "
+                         "catalog)")
+
+    try:
+        engine = ScenarioEngine.from_risk_state(state, meta)
+        results = engine.run(specs, bucket=args.bucket)
+    except ValueError as e:
+        raise SystemExit(f"scenario: {e}")
+
+    out_dir = args.out or (os.path.dirname(args.state) or ".")
+    # a fresh --out must exist as a DIRECTORY before the manifest write:
+    # write_scenario_manifest treats a non-dir path as the file itself
+    os.makedirs(out_dir, exist_ok=True)
+    manifest = build_scenario_manifest(
+        results, engine.factor_names, stamp_json=meta.get("stamp"),
+        backend=jax_backend_name(),
+        summary=scenario_summary_from_registry(),
+        staleness=engine.staleness)
+    mpath = write_scenario_manifest(out_dir, manifest)
+    for r in results:
+        line = {"scenario": r.spec.name, "status": r.status,
+                "problems": list(r.problems),
+                "psd_projected": bool(r.psd_projected)}
+        if r.ok:
+            line["min_eig_stressed"] = float(r.min_eig_stressed)
+        print(json.dumps(line, sort_keys=True))
+    _metrics_flush(args)
+    print(json.dumps({"manifest": mpath, "n_scenarios": len(results),
+                      "n_ok": manifest["n_ok"],
+                      "n_rejected": manifest["n_rejected"],
+                      "n_psd_projected": manifest["n_psd_projected"]},
+                     indent=1), file=sys.stderr)
+    if manifest["n_ok"] == 0:
+        raise SystemExit(1)
 
 
 def jax_backend_name() -> str:
@@ -2070,6 +2195,11 @@ def main(argv=None):
                          "artifacts: exit non-zero if the query service's "
                          "circuit breaker was open at shutdown; warn on "
                          "load shedding / degraded health")
+    dr.add_argument("--scenarios", action="store_true",
+                    help="also audit the scenario_manifest.json beside the "
+                         "artifacts: exit non-zero on a torn manifest, a "
+                         "spec-hash mismatch, or inconsistent counts; warn "
+                         "on rejected scenarios")
     dr.set_defaults(fn=_doctor)
 
     sv = sub.add_parser(
@@ -2123,6 +2253,36 @@ def main(argv=None):
     sv.add_argument("--metrics-dir", default=None, help=_metrics_dir_help)
     sv.set_defaults(fn=_serve)
 
+    sc = sub.add_parser(
+        "scenario",
+        help="batched stress tests over a guarded risk-state checkpoint: "
+             "factor shocks, vol regimes, correlation stress, historical "
+             "replay, quarantine counterfactuals — one donated jit per "
+             "S-bucket, atomic scenario_manifest.json beside the "
+             "checkpoint (docs/SCENARIOS.md)")
+    scs = sc.add_subparsers(dest="scmd", required=True)
+    scs.add_parser("list", help="print the preset scenario catalog")
+    scr = scs.add_parser(
+        "run", help="run scenarios against a checkpoint and write "
+                    "scenario_manifest.json beside it")
+    scr.add_argument("state", help="risk-state .npz saved with quarantine "
+                                   "enabled (scenarios shock its "
+                                   "last_good_cov)")
+    scr.add_argument("--preset", action="append", default=[],
+                     help="preset scenario name, repeatable "
+                          "(`mfm-tpu scenario list` shows the catalog)")
+    scr.add_argument("--spec", action="append", default=[],
+                     help="JSON ScenarioSpec file — one spec object or a "
+                          "list of them (repeatable)")
+    scr.add_argument("--out", default=None,
+                     help="directory for scenario_manifest.json (default: "
+                          "beside the checkpoint)")
+    scr.add_argument("--bucket", type=int, default=None,
+                     help="explicit pad bucket >= the number of scenarios "
+                          "(default: the geometric bucket for S)")
+    scr.add_argument("--metrics-dir", default=None, help=_metrics_dir_help)
+    sc.set_defaults(fn=_scenario)
+
     args = ap.parse_args(argv)
     if getattr(args, "select_out", None) and args.select is None:
         ap.error("--select-out requires --select")
@@ -2136,7 +2296,9 @@ def main(argv=None):
     # subcommands that actually jit: the data-only paths (etl-*, report,
     # crosscheck) must not pay the jax import or touch the cache dir.
     if args.cmd in ("risk", "factors", "demo", "prepare", "pipeline",
-                    "alpha", "serve"):
+                    "alpha", "serve") \
+            or (args.cmd == "scenario"
+                and getattr(args, "scmd", None) == "run"):
         from mfm_tpu.utils.cache import enable_persistent_compilation_cache
 
         enable_persistent_compilation_cache()

@@ -93,8 +93,15 @@ _R7_OBS_MODULES = ("mfm_tpu.utils.obs", "mfm_tpu.obs")
 # treats them as barriers — it neither enters nor crosses them, so the
 # conservative bare-name resolution can't drag the request loop (and,
 # through it, the telemetry registry) into the traced set off a name
-# collision like `run`/`query`/`identity`
-_R7_HOST_ONLY_MODULES = ("mfm_tpu.serve.server", "mfm_tpu.cli")
+# collision like `run`/`query`/`identity`.  The scenario engine and its
+# manifest writer join the list for the same reason: ScenarioEngine.run
+# shares its bare name with the traced RiskModel.run, and both modules
+# record obs metrics / do JSON+fsync IO that must stay host-side (the
+# scenario DEVICE code lives alone in scenario/kernel.py, which stays
+# fully lintable)
+_R7_HOST_ONLY_MODULES = ("mfm_tpu.serve.server", "mfm_tpu.cli",
+                         "mfm_tpu.scenario.engine",
+                         "mfm_tpu.scenario.manifest")
 
 
 def _is_obs_module(module: str) -> bool:

@@ -494,7 +494,7 @@ class RiskModel:
         return outputs, new_state
 
     def update_guarded(self, state: RiskModelState, last_date: str | None = None,
-                       pre_reasons=None):
+                       pre_reasons=None, heal_mask=None):
         """:meth:`update` behind the serving guards (degraded mode).
 
         Health-checks every slab date (serve/guard.py) inside the same
@@ -512,6 +512,9 @@ class RiskModel:
         bitwise-untouched at healthy dates, the last healthy covariance at
         quarantined ones.  ``pre_reasons``: optional (T,) uint32 host-side
         verdicts (:func:`mfm_tpu.serve.guard.host_date_reasons`) OR-ed in.
+        ``heal_mask``: optional (T,) bool forcing the verdict HEALTHY at
+        the marked dates (quarantine counterfactuals, ``mfm_tpu.scenario``);
+        ``None`` is the production path, bitwise-identical to omitting it.
 
         Requires a state built under a quarantine-enabled config
         (:meth:`init_state` seeds the guard leaves).  Same donation story
@@ -537,6 +540,8 @@ class RiskModel:
                 "universe ring and last-good covariance seeded at init)")
         pre = (jnp.zeros((self.T,), jnp.uint32) if pre_reasons is None
                else jnp.asarray(pre_reasons, jnp.uint32))
+        heal = (jnp.zeros((self.T,), bool) if heal_mask is None
+                else jnp.asarray(heal_mask, bool))
         import warnings
 
         with warnings.catch_warnings():
@@ -548,7 +553,7 @@ class RiskModel:
                     self.valid, state.sim_covs, state.nw_carry,
                     state.vr_num, state.vr_den, state.last_good_cov,
                     state.staleness, state.quarantine_count,
-                    state.guard_ring, state.guard_ring_pos, pre,
+                    state.guard_ring, state.guard_ring_pos, pre, heal,
                     jnp.asarray(self.T, jnp.int32),
                     n_industries=self.n_industries, config=self.config,
                     sim_length=state.sim_length,
@@ -678,8 +683,8 @@ def _serve_degraded(vr_cov, eigen_valid, quarantined, last_good, staleness,
 # the guarded serving step: guards, the carried four stages with quarantined
 # dates excised, and the degraded-mode serving scan — still ONE compiled
 # program (the steady-state serving loop stays at <= 1 compile).  Donation
-# adds the guard-state operands (9-13); sim_covs (5) and pre_reasons (14)
-# stay host-owned.
+# adds the guard-state operands (9-13); sim_covs (5), pre_reasons (14) and
+# heal_mask (15) stay host-owned.
 @functools.partial(
     jax.jit,
     static_argnames=("n_industries", "config", "sim_length",
@@ -688,12 +693,12 @@ def _serve_degraded(vr_cov, eigen_valid, quarantined, last_good, staleness,
 )
 def _fused_update_guarded_step(ret, cap, styles, industry, valid, sim_covs,
                                nw_carry, vr_num, vr_den, last_good, staleness,
-                               q_count, ring, ring_pos, pre_reasons, t_count,
-                               *, n_industries, config, sim_length,
+                               q_count, ring, ring_pos, pre_reasons, heal_mask,
+                               t_count, *, n_industries, config, sim_length,
                                eigen_batch_hint):
     quarantined, reasons, ring, ring_pos = guard_slab(
         ret, cap, valid, ring, ring_pos, config.quarantine,
-        pre_reasons=pre_reasons)
+        pre_reasons=pre_reasons, heal_mask=heal_mask)
     m = RiskModel(ret, cap, styles, industry, valid,
                   n_industries=n_industries, config=config)
     outputs, nw_carry_out, vr_carry_out = m._run_carried(

@@ -110,6 +110,22 @@ BREAKER_STATE = REGISTRY.gauge(
 _BREAKER_STATE_CODE = {"closed": 0, "half_open": 1, "open": 2}
 _BREAKER_CODE_STATE = {v: k for k, v in _BREAKER_STATE_CODE.items()}
 
+# -- scenario engine (scenario/engine.py batched stress tests) ----------------
+
+SCENARIOS_RUN_TOTAL = REGISTRY.counter(
+    "mfm_scenarios_run_total", "scenarios answered by admission outcome",
+    labelnames=("status",))   # ok | rejected
+SCENARIO_BATCH_SECONDS = REGISTRY.histogram(
+    "mfm_scenario_batch_seconds",
+    "device wall time per batched scenario run (all S lanes, one jit)")
+SCENARIO_BATCH_SIZE = REGISTRY.histogram(
+    "mfm_scenario_batch_size", "true (unpadded) scenarios per batch",
+    buckets=(1, 2, 8, 32, 128, 512, 2048, 8192, 32768))
+SCENARIO_PSD_PROJECTIONS_TOTAL = REGISTRY.counter(
+    "mfm_scenario_psd_projections_total",
+    "lanes whose stressed covariance went indefinite and was projected "
+    "back to PSD (corr stress past the feasible cone)")
+
 
 # -- recording helpers --------------------------------------------------------
 
@@ -253,6 +269,36 @@ def serve_summary_from_registry() -> dict:
         "breaker_state": _BREAKER_CODE_STATE.get(state_code, "closed"),
         "query_p50_latency_s": (None if p50 != p50 else round(p50, 6)),
         "query_p99_latency_s": (None if p99 != p99 else round(p99, 6)),
+    }
+
+
+def record_scenario_batch(n_true: int, seconds: float) -> None:
+    """Tally one batched scenario run: true (unpadded) S + device wall."""
+    SCENARIO_BATCH_SIZE.observe(int(n_true))
+    SCENARIO_BATCH_SECONDS.observe(float(seconds))
+
+
+def record_scenario_outcome(status: str, n: int = 1) -> None:
+    SCENARIOS_RUN_TOTAL.inc(int(n), status=status)
+
+
+def record_psd_projections(n: int = 1) -> None:
+    SCENARIO_PSD_PROJECTIONS_TOTAL.inc(int(n))
+
+
+def scenario_summary_from_registry() -> dict:
+    """The scenario manifest's ``summary`` block, off the live counters
+    (the one VOLATILE manifest field — latency quantiles don't replay)."""
+    statuses = {k[0]: int(v) for k, v in SCENARIOS_RUN_TOTAL.series().items()}
+    p50 = SCENARIO_BATCH_SECONDS.quantile_est(0.5)
+    p99 = SCENARIO_BATCH_SECONDS.quantile_est(0.99)
+    return {
+        "scenarios": statuses,
+        "scenarios_total": sum(statuses.values()),
+        "psd_projections_total": int(
+            SCENARIO_PSD_PROJECTIONS_TOTAL.value()),
+        "batch_p50_latency_s": (None if p50 != p50 else round(p50, 6)),
+        "batch_p99_latency_s": (None if p99 != p99 else round(p99, 6)),
     }
 
 

@@ -1,0 +1,59 @@
+"""Scenario engine: batched stress tests over the served risk model.
+
+The what-if surface of the stack (docs/SCENARIOS.md): declarative
+:class:`ScenarioSpec` worlds — factor vol shocks, vol-regime overrides,
+correlation stress, historical replays, quarantine counterfactuals —
+compiled by :class:`ScenarioEngine` into ONE batched donated jit per
+geometric S-bucket, with per-scenario rejection isolation and atomic
+``scenario_manifest.json`` evidence audited by ``mfm-tpu doctor
+--scenarios``.
+"""
+
+from mfm_tpu.scenario.counterfactual import (
+    clone_state,
+    make_counterfactual_fn,
+    make_replay_lookup,
+    replay_lookup_from_result,
+)
+from mfm_tpu.scenario.engine import ScenarioEngine, ScenarioResult
+from mfm_tpu.scenario.kernel import scenario_batch
+from mfm_tpu.scenario.manifest import (
+    SCENARIO_MANIFEST_NAME,
+    ScenarioManifestError,
+    audit_scenario_manifest,
+    build_scenario_manifest,
+    read_scenario_manifest,
+    scenario_manifest_path_for,
+    write_scenario_manifest,
+)
+from mfm_tpu.scenario.spec import (
+    PRESET_NOTES,
+    PRESETS,
+    ScenarioBuilder,
+    ScenarioSpec,
+    preset,
+    validate_spec,
+)
+
+__all__ = [
+    "PRESETS",
+    "PRESET_NOTES",
+    "SCENARIO_MANIFEST_NAME",
+    "ScenarioBuilder",
+    "ScenarioEngine",
+    "ScenarioManifestError",
+    "ScenarioResult",
+    "ScenarioSpec",
+    "audit_scenario_manifest",
+    "build_scenario_manifest",
+    "clone_state",
+    "make_counterfactual_fn",
+    "make_replay_lookup",
+    "preset",
+    "read_scenario_manifest",
+    "replay_lookup_from_result",
+    "scenario_batch",
+    "scenario_manifest_path_for",
+    "validate_spec",
+    "write_scenario_manifest",
+]

@@ -1,0 +1,140 @@
+"""Base-covariance resolvers for replay and counterfactual scenarios.
+
+Two of the spec kinds cannot be expressed as a covariance transform — they
+change WHICH world the shock applies to:
+
+- **Historical replay**: the base becomes the covariance the model had
+  fitted through a named stretch of panel history.
+- **Quarantine counterfactual**: the base becomes the served covariance
+  of a REAL guarded re-run with chosen verdicts flipped — not an
+  approximation of the guards, the actual ``update_guarded`` graph with
+  the ``pre_reasons`` / ``heal_mask`` operands set.  "Counterfactual
+  equals a real re-run with flipped verdicts" is therefore true by
+  construction, and tests/test_scenario.py pins it bitwise.
+
+Both resolve HOST-SIDE, per scenario, before the one batched jit — the
+kernel only ever sees (S, K, K) base covariances.  This module builds the
+two injectables :class:`mfm_tpu.scenario.engine.ScenarioEngine` takes
+(``replay_lookup`` / ``counterfactual_fn``) from the artifacts the repo
+already produces: a pipeline result's per-date covariance series and an
+appended slab + its pre-update checkpoint.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def clone_state(state):
+    """Deep-copy a ``RiskModelState``'s array leaves (aux rides along).
+
+    ``update_guarded`` DONATES the checkpoint's carries and guard leaves;
+    a counterfactual must re-run against a copy so the real serving state
+    stays live.  ``jnp.array`` copies each leaf into a fresh JAX-owned
+    buffer (safe to donate)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(jnp.array, state)
+
+
+def make_replay_lookup(dates, covs, valid=None):
+    """``(start, end) -> (K, K) | None`` over a per-date covariance series.
+
+    ``dates``: the history's date labels (compared as normalized strings,
+    the :func:`mfm_tpu.pipeline.date_stamp` order).  ``covs``: (T, K, K)
+    fitted covariances (e.g. ``outputs.vr_cov`` or the guard report's
+    ``served_cov``).  ``valid``: optional (T,) bool (e.g. ``eigen_valid``)
+    — invalid dates never resolve.  The window resolves to the LAST valid
+    date inside it: the covariance fitted through that stretch.
+    """
+    from mfm_tpu.pipeline import date_stamp
+
+    labels = [date_stamp(d) for d in dates]
+    covs = np.asarray(covs)
+    ok = (np.ones(len(labels), bool) if valid is None
+          else np.asarray(valid, bool))
+    if covs.ndim != 3 or covs.shape[0] != len(labels) or \
+            ok.shape != (len(labels),):
+        raise ValueError(f"need (T, K, K) covs + T dates (+ optional (T,) "
+                         f"valid); got covs {covs.shape} over "
+                         f"{len(labels)} dates")
+
+    def lookup(start, end):
+        start, end = date_stamp(start), date_stamp(end)
+        hits = [i for i, d in enumerate(labels)
+                if start <= d <= end and ok[i]]
+        if not hits:
+            return None
+        return covs[hits[-1]]
+
+    return lookup
+
+
+def replay_lookup_from_result(result):
+    """Replay resolver off a :class:`~mfm_tpu.pipeline.RiskPipelineResult`:
+    the guard report's ``served_cov`` series when the run was guarded
+    (what was actually servable on each date), else the raw ``vr_cov``
+    gated on ``eigen_valid``."""
+    if result.report is not None:
+        return make_replay_lookup(
+            result.arrays.dates, np.asarray(result.report.served_cov),
+            valid=~np.asarray(result.report.quarantined, bool))
+    return make_replay_lookup(
+        result.arrays.dates, np.asarray(result.outputs.vr_cov),
+        valid=np.asarray(result.outputs.eigen_valid, bool))
+
+
+def make_counterfactual_fn(model, state, dates):
+    """``(flip_quarantine, flip_heal) -> (K, K)`` via a real guarded re-run.
+
+    ``model``: the :class:`~mfm_tpu.models.risk_model.RiskModel` over the
+    appended slab (its panels are snapshotted to host numpy here, so the
+    closure survives the donating re-runs).  ``state``: the checkpoint
+    BEFORE that slab.  ``dates``: the slab's date labels, in order.
+
+    Each call re-runs ``update_guarded`` on fresh copies with
+    ``pre_reasons`` carrying :data:`~mfm_tpu.serve.guard.REASON_FORCED`
+    at the force-quarantined dates and ``heal_mask`` True at the
+    force-healed ones, and returns the served covariance at the final
+    slab date — exactly what that world would have handed the query
+    layer.  Unknown flip dates raise ``ValueError`` (the engine rejects
+    that scenario, batchmates unaffected).
+    """
+    from mfm_tpu.models.risk_model import RiskModel
+    from mfm_tpu.pipeline import date_stamp
+    from mfm_tpu.serve.guard import REASON_FORCED
+
+    labels = [date_stamp(d) for d in dates]
+    if len(labels) != model.T:
+        raise ValueError(f"{len(labels)} slab dates for a T={model.T} model")
+    # host snapshots: update_guarded donates the panels, so each re-run
+    # builds a fresh RiskModel from these (RiskModel copies numpy inputs
+    # into JAX-owned buffers)
+    panels = {f: np.asarray(getattr(model, f))
+              for f in ("ret", "cap", "styles", "industry", "valid")}
+    n_industries, config = model.n_industries, model.config
+
+    def counterfactual(flip_quarantine, flip_heal):
+        fq = {date_stamp(d) for d in flip_quarantine}
+        fh = {date_stamp(d) for d in flip_heal}
+        unknown = sorted((fq | fh) - set(labels))
+        if unknown:
+            raise ValueError(f"counterfactual flips dates outside the "
+                             f"slab: {unknown[:5]} (slab is "
+                             f"{labels[0]}..{labels[-1]})")
+        pre = np.zeros(len(labels), np.uint32)
+        heal = np.zeros(len(labels), bool)
+        for i, d in enumerate(labels):
+            if d in fq:
+                pre[i] = REASON_FORCED
+            if d in fh:
+                heal[i] = True
+        m = RiskModel(panels["ret"], panels["cap"], panels["styles"],
+                      panels["industry"], panels["valid"],
+                      n_industries=n_industries, config=config)
+        _, report, _ = m.update_guarded(clone_state(state),
+                                        pre_reasons=pre, heal_mask=heal)
+        return np.asarray(report.served_cov[-1])
+
+    return counterfactual

@@ -1,0 +1,116 @@
+"""The batched scenario kernel: S covariance shocks in ONE donated jit.
+
+Every scenario kind :mod:`mfm_tpu.scenario.spec` can express reduces, by
+the time it reaches the device, to the same lane shape: a base covariance
+(what world the shock applies to — today's served matrix, a historical
+replay, a quarantine counterfactual) plus four dense shock operands.  The
+kernel vmaps one lane function over the S axis, so a batch of S scenarios
+IS S independent single runs:
+
+- every per-lane op is elementwise or a within-lane contraction (the
+  eigendecomposition and its reconstruction) — nothing contracts across
+  the S axis, so lane i's bytes cannot depend on its batchmates;
+- the identity lane is a ``jnp.where`` passthrough of the UNTOUCHED base
+  covariance, not an algebraic no-op (``cov / sigma sigma' * sigma
+  sigma'`` is not bitwise-stable) — the identity scenario is
+  bitwise-equal to the unshocked baseline by construction.
+
+Those two properties are the subsystem's correctness anchor
+(tests/test_scenario.py proves both; tools/faultinject.py's
+``scenario-poison-spec`` plan re-proves lane isolation under rejected
+batchmates).
+
+Lane math, in order (PAPER.md's USE4 vocabulary):
+
+1. split the base covariance into vols and correlations,
+2. per-factor vol shocks ``sigma' = max(sigma * scale + shift, 0)``,
+3. the vol-regime multiplier override ``sigma' *= vol_mult`` (the
+   scenario analog of the lambda_F series of stage 4),
+4. correlation stress: off-diagonals scaled by ``1 + corr_beta`` and
+   clipped to [-1, 1] (corr-meltup / diversification-collapse drills),
+5. gated PSD projection: eigendecompose, clamp eigenvalues to a small
+   relative floor, reconstruct — only where the stressed matrix went
+   indefinite (the clip in step 4 can break PSD-ness; a projected lane is
+   flagged so obs/ can count activations).
+
+Shapes are padded to geometric S-buckets by the engine (the query-engine
+bucket discipline, serve/query.py), so the steady state holds <= 1
+compile per bucket — ``assert_max_compiles`` enforced in tests and bench.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _one_scenario(cov, shift, scale, vol_mult, corr_beta, passthrough):
+    """Shock ONE covariance lane; vmapped over S by :func:`scenario_batch`.
+
+    Args:
+      cov: (K, K) base covariance (compute dtype).
+      shift: (K,) additive vol deltas (0 = untouched).
+      scale: (K,) multiplicative vol scales (1 = untouched).
+      vol_mult: scalar vol-regime multiplier override (1 = untouched).
+      corr_beta: scalar off-diagonal stress (0 = untouched; rho' =
+        clip(rho * (1 + corr_beta), -1, 1)).
+      passthrough: scalar bool — True serves ``cov`` back bitwise-untouched
+        (identity scenarios, rejected specs, pad lanes).
+
+    Returns ``(cov_out (K, K), psd_projected bool, min_eig_stressed)``
+    where ``min_eig_stressed`` is the smallest eigenvalue of the stressed
+    matrix BEFORE projection (the audit number the manifest records).
+    """
+    dtype = cov.dtype
+    K = cov.shape[0]
+    eye = jnp.eye(K, dtype=dtype)
+    one = jnp.asarray(1.0, dtype)
+
+    var = jnp.diagonal(cov)
+    sigma = jnp.sqrt(jnp.maximum(var, 0))
+    denom = jnp.outer(sigma, sigma)
+    corr = jnp.where(denom > 0, cov / denom, jnp.zeros((), dtype))
+    corr = corr * (one - eye) + eye
+    corr_s = jnp.clip(corr * (one + corr_beta), -one, one)
+    corr_s = corr_s * (one - eye) + eye
+    sigma_s = jnp.maximum(sigma * scale + shift, 0) * vol_mult
+    cov_s = corr_s * jnp.outer(sigma_s, sigma_s)
+
+    # gated PSD projection.  The eigh runs unconditionally (the gate needs
+    # min_eig and K is small); the clamp floor is a small RELATIVE floor —
+    # eigenvalues of the reconstructed matrix differ from the clamped ones
+    # by O(eps * ||cov||), so clamping at exactly 0 could leave the result
+    # indefinite at compute dtype.  K * eps * lambda_max dominates that
+    # reconstruction error, keeping min-eig >= 0 at compute dtype.
+    w, V = jnp.linalg.eigh(cov_s)
+    min_eig = w[0]
+    floor = jnp.maximum(w[-1], 0) * (K * jnp.finfo(dtype).eps)
+    w_cl = jnp.maximum(w, floor)
+    proj = (V * w_cl) @ V.T
+    proj = 0.5 * (proj + proj.T)
+    needs = min_eig < 0
+    cov_out = jnp.where(needs, proj, cov_s)
+    cov_out = jnp.where(passthrough, cov, cov_out)
+    return cov_out, needs & ~passthrough, jnp.where(passthrough,
+                                                    jnp.zeros((), dtype),
+                                                    min_eig)
+
+
+# Donated jit for the whole batch: every operand is freshly assembled per
+# run by the engine (base covs resolved host-side, shock vectors densified
+# from the specs).  Only the operands whose shape+dtype an output can
+# actually alias are donated — cov (S, K, K) into cov_out and one (S,)
+# float into min_eig_stressed; donating the rest would just warn.  The jit
+# keys on the padded bucket shape only — <= 1 compile per S-bucket in
+# steady state.
+@partial(jax.jit, donate_argnums=(0, 3))
+def scenario_batch(base_cov, shift, scale, vol_mult, corr_beta, passthrough):
+    """Shock S covariance lanes in one compiled program.
+
+    Args are the (S, ...) stacks of :func:`_one_scenario`'s operands.
+    Returns ``(covs (S, K, K), psd_projected (S,), min_eig_stressed (S,))``.
+    """
+    return jax.vmap(_one_scenario)(base_cov, shift, scale, vol_mult,
+                                   corr_beta, passthrough)

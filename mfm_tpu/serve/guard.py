@@ -42,6 +42,8 @@ REASON_UNIVERSE_COLLAPSE = 2  # valid count << trailing-median universe
 REASON_RET_OUTLIER = 4        # too many |ret - median| > mad_k * MAD cells
 REASON_CAP_NONPOS = 8         # non-positive / non-finite cap in universe
 REASON_DATE_ORDER = 16        # host-side: non-monotone or duplicate date
+REASON_FORCED = 32            # host-side: verdict forced by a counterfactual
+                              # (mfm_tpu.scenario) — not a data problem
 
 _REASON_NAMES = (
     (REASON_NAN_DENSITY, "nan_density"),
@@ -49,6 +51,7 @@ _REASON_NAMES = (
     (REASON_RET_OUTLIER, "ret_outlier"),
     (REASON_CAP_NONPOS, "cap_nonpos"),
     (REASON_DATE_ORDER, "date_order"),
+    (REASON_FORCED, "forced"),
 )
 
 
@@ -78,7 +81,8 @@ def guard_ring_init(window: int, dtype) -> tuple[jax.Array, jax.Array]:
             jnp.asarray(0, jnp.int32))
 
 
-def guard_slab(ret, cap, valid, ring, ring_pos, policy, pre_reasons=None):
+def guard_slab(ret, cap, valid, ring, ring_pos, policy, pre_reasons=None,
+               heal_mask=None):
     """Health-check every date of an appended slab, in order.
 
     Args:
@@ -89,6 +93,14 @@ def guard_slab(ret, cap, valid, ring, ring_pos, policy, pre_reasons=None):
       policy: :class:`QuarantinePolicy` (trace-time constants).
       pre_reasons: optional (T,) uint32 host-computed reasons
         (:func:`host_date_reasons`) OR-ed into the verdicts.
+      heal_mask: optional (T,) bool forcing the verdict HEALTHY at the
+        marked dates regardless of what tripped — the quarantine
+        counterfactual of :mod:`mfm_tpu.scenario` ("what if date t had not
+        been quarantined?").  A healed date feeds the trailing-universe
+        ring like any healthy one; its ``reasons`` bits are kept in the
+        report so the counterfactual stays auditable.  ``None`` (the
+        default) is the production path and is bitwise-identical to the
+        pre-heal-mask behaviour.
 
     Returns ``(quarantined (T,) bool, reasons (T,) uint32, ring, ring_pos)``.
     Traced; call from inside the jitted update step.
@@ -98,6 +110,8 @@ def guard_slab(ret, cap, valid, ring, ring_pos, policy, pre_reasons=None):
     one = jnp.asarray(1.0, dtype)
     if pre_reasons is None:
         pre_reasons = jnp.zeros((T,), jnp.uint32)
+    if heal_mask is None:
+        heal_mask = jnp.zeros((T,), bool)
 
     def body(i, state):
         ring, pos, reasons_acc = state
@@ -105,6 +119,7 @@ def guard_slab(ret, cap, valid, ring, ring_pos, policy, pre_reasons=None):
         capt = jax.lax.dynamic_index_in_dim(cap, i, 0, keepdims=False)
         vt = jax.lax.dynamic_index_in_dim(valid, i, 0, keepdims=False)
         pre = jax.lax.dynamic_index_in_dim(pre_reasons, i, 0, keepdims=False)
+        heal = jax.lax.dynamic_index_in_dim(heal_mask, i, 0, keepdims=False)
 
         n_valid = jnp.sum(vt.astype(dtype))
         denom = jnp.maximum(n_valid, one)
@@ -137,7 +152,7 @@ def guard_slab(ret, cap, valid, ring, ring_pos, policy, pre_reasons=None):
             (r_out, REASON_RET_OUTLIER),
             (r_cap, REASON_CAP_NONPOS),
         ), jnp)
-        q_t = reasons != 0
+        q_t = (reasons != 0) & ~heal
 
         # only healthy dates feed the trailing-universe reference
         ring_upd = jax.lax.dynamic_update_index_in_dim(
@@ -153,7 +168,7 @@ def guard_slab(ret, cap, valid, ring, ring_pos, policy, pre_reasons=None):
         jnp.int32(0), jnp.int32(T), body,
         (ring, ring_pos.astype(jnp.int32), jnp.zeros((T,), jnp.uint32)),
     )
-    return reasons != 0, reasons, ring, ring_pos
+    return (reasons != 0) & ~heal_mask, reasons, ring, ring_pos
 
 
 def host_date_reasons(dates, last_date=None) -> "object":

@@ -180,6 +180,10 @@ class QueryEngine:
                              f"K={self.K}")
         self.factor_index = {n: i for i, n in enumerate(self.factor_names)}
         self.staleness = int(staleness)
+        #: name of the scenario this engine's covariance was shocked under
+        #: (None = the plain served matrix; set by :meth:`with_cov`, stamped
+        #: on every response by the serve loop)
+        self.scenario_id: str | None = None
         # jnp.array (owning copy): these are jit operands; never donated,
         # but the engine must not alias caller-mutable numpy memory
         self._cov = jnp.array(cov.astype(self.dtype))
@@ -279,6 +283,35 @@ class QueryEngine:
         if not trim:
             return out
         return QueryOutputs(*(np.asarray(o)[:B] for o in out))
+
+    # -- scenario overlays ---------------------------------------------------
+    def with_cov(self, cov, *, staleness: int | None = None,
+                 scenario_id: str | None = None) -> "QueryEngine":
+        """A sibling engine answering under a DIFFERENT covariance.
+
+        The scenario path (mfm_tpu/scenario/): exposures, specific
+        variances, benchmark tables, stock ids and dtype are SHARED with
+        this engine (immutable device constants — no copies), only the
+        covariance changes.  A query through the sibling runs the same
+        batched kernels, so plain and scenario queries share the per-bucket
+        compile cache.  ``scenario_id`` tags the sibling; the serve loop
+        stamps it on every response answered through it.
+        """
+        import copy
+
+        cov = np.asarray(cov)
+        if cov.shape != (self.K, self.K):
+            raise ValueError(f"cov must be ({self.K}, {self.K}), got "
+                             f"{cov.shape}")
+        if not np.isfinite(cov).all():
+            raise ValueError("scenario covariance contains non-finite "
+                             "entries — refuse to serve it")
+        eng = copy.copy(self)
+        eng._cov = jnp.array(cov.astype(self.dtype))
+        eng.staleness = self.staleness if staleness is None else \
+            int(staleness)
+        eng.scenario_id = scenario_id
+        return eng
 
     # -- construction from served artifacts ---------------------------------
     @classmethod

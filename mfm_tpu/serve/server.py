@@ -55,6 +55,7 @@ REQ_REASON_SHORT_WEIGHTS = 8     # wrong length / empty weight vector
 REQ_REASON_UNKNOWN_FACTOR = 16   # dict weight key not in the engine's space
 REQ_REASON_UNKNOWN_BENCHMARK = 32
 REQ_REASON_WEIGHT_OUTLIER = 64   # |w - med| > mad_k * MAD (policy-gated)
+REQ_REASON_UNKNOWN_SCENARIO = 128  # scenario tag not in the served table
 
 _REQ_REASON_NAMES = (
     (REQ_REASON_SCHEMA, "schema"),
@@ -64,6 +65,7 @@ _REQ_REASON_NAMES = (
     (REQ_REASON_UNKNOWN_FACTOR, "unknown_factor"),
     (REQ_REASON_UNKNOWN_BENCHMARK, "unknown_benchmark"),
     (REQ_REASON_WEIGHT_OUTLIER, "weight_outlier"),
+    (REQ_REASON_UNKNOWN_SCENARIO, "unknown_scenario"),
 )
 
 
@@ -195,24 +197,28 @@ class CircuitBreaker:
 
 
 class _Request:
-    __slots__ = ("rid", "weights", "bidx", "enq_t", "deadline_t")
+    __slots__ = ("rid", "weights", "bidx", "enq_t", "deadline_t", "scenario")
 
-    def __init__(self, rid, weights, bidx, enq_t, deadline_t):
+    def __init__(self, rid, weights, bidx, enq_t, deadline_t, scenario=None):
         self.rid = rid
         self.weights = weights
         self.bidx = bidx
         self.enq_t = enq_t
         self.deadline_t = deadline_t
+        self.scenario = scenario
 
 
-def parse_request(line: str, engine, policy: ServePolicy):
+def parse_request(line: str, engine, policy: ServePolicy, scenarios=None):
     """Decode + guard one JSONL request.
 
     Returns ``(fields_or_None, reason_mask, detail)``: a zero mask means
     the request is admissible and ``fields`` is ``(rid, weights (D,)
-    float, bidx int, deadline_s float)``; a nonzero mask means dead-letter
-    (``detail`` says what tripped, ``rid`` may still be recoverable and is
-    returned inside ``detail``-bearing fields as None).
+    float, bidx int, deadline_s float, scenario str|None)``; a nonzero
+    mask means dead-letter (``detail`` says what tripped, ``rid`` may
+    still be recoverable and is returned inside ``detail``-bearing fields
+    as None).  ``scenarios``: the served scenario table (names only are
+    consulted); a ``scenario`` tag outside it — including ANY tag when no
+    table is served — is ``unknown_scenario``.
     """
     mask = 0
     rid = None
@@ -223,11 +229,20 @@ def parse_request(line: str, engine, policy: ServePolicy):
     if not isinstance(obj, dict):
         return None, REQ_REASON_SCHEMA, "request must be a JSON object"
     rid = obj.get("id")
+    scenario = obj.get("scenario")
+    if scenario is not None:
+        scenario = str(scenario)
     raw_w = obj.get("weights")
     if raw_w is None:
-        return (rid, None, 0, 0.0), REQ_REASON_SCHEMA, "missing 'weights'"
+        return (rid, None, 0, 0.0, scenario), REQ_REASON_SCHEMA, \
+            "missing 'weights'"
 
     detail = ""
+    if scenario is not None and scenario not in (scenarios or {}):
+        mask |= REQ_REASON_UNKNOWN_SCENARIO
+        have = sorted(scenarios) if scenarios else []
+        detail = f"unknown scenario {scenario!r} (serving " \
+            f"{have[:5] if have else 'no scenario table'})"
     if isinstance(raw_w, dict):
         # name-keyed weights: map onto the engine's own axis order.  In
         # factor space the keys are factor names; in stock space stock ids.
@@ -235,7 +250,7 @@ def parse_request(line: str, engine, policy: ServePolicy):
                  else engine.factor_names if engine.space == "factor"
                  else None)
         if names is None:
-            return (rid, None, 0, 0.0), REQ_REASON_SCHEMA, \
+            return (rid, None, 0, 0.0, scenario), REQ_REASON_SCHEMA, \
                 "dict weights need a named axis (engine has no stock ids)"
         index = (engine.factor_index if engine.space == "factor"
                  else {n: i for i, n in enumerate(names)})
@@ -302,7 +317,7 @@ def parse_request(line: str, engine, policy: ServePolicy):
         mask |= REQ_REASON_SCHEMA
         detail = detail or f"bad deadline_s {obj.get('deadline_s')!r}"
         deadline_s = policy.default_deadline_s
-    return (rid, w, bidx, deadline_s), int(mask), detail
+    return (rid, w, bidx, deadline_s, scenario), int(mask), detail
 
 
 class QueryServer:
@@ -321,13 +336,19 @@ class QueryServer:
         returns None (no change) or ``{"engine": ..., "health": ...}``; a
         fence-audit failure (ArtifactCorrupt/Stale) force-opens the
         breaker instead of serving a checkpoint that failed its audit.
+      scenarios: optional ``{name: QueryEngine}`` table of stressed
+        engines (``ScenarioEngine.query_engines``).  A request carrying
+        ``"scenario": name`` is answered from that engine; requests with
+        no tag run the exact baseline path (bitwise-unchanged), and tags
+        outside the table dead-letter with ``unknown_scenario``.
     """
 
     def __init__(self, engine, policy: ServePolicy | None = None, *,
                  health: str = "unknown", dead_letter_path=None,
                  clock: Callable[[], float] = time.monotonic,
-                 reload_fn=None):
+                 reload_fn=None, scenarios=None):
         self.engine = engine
+        self.scenarios: dict = dict(scenarios or {})
         self.policy = policy or ServePolicy()
         self.health = str(health)
         self.breaker = CircuitBreaker(self.policy.breaker_failures,
@@ -343,10 +364,13 @@ class QueryServer:
             self.breaker.force_open("health_degraded")
 
     # -- degraded serving ----------------------------------------------------
-    def _stamp(self, resp: dict) -> dict:
-        resp["staleness"] = int(self.engine.staleness)
+    def _stamp(self, resp: dict, scenario_id: str | None = None,
+               engine=None) -> dict:
+        eng = engine if engine is not None else self.engine
+        resp["scenario_id"] = scenario_id
+        resp["staleness"] = int(eng.staleness)
         resp["health"] = self.health
-        resp["degraded"] = bool(self.engine.staleness > 0
+        resp["degraded"] = bool(eng.staleness > 0
                                 or self.health != "ok")
         return resp
 
@@ -405,18 +429,22 @@ class QueryServer:
                 "id": _peek_id(line), "ok": False, "outcome": "rejected",
                 "retry_after_s": round(self.breaker.retry_after(), 3),
                 "breaker": self.breaker.open_reason or "open"})]
-        fields, mask, detail = parse_request(line, self.engine, self.policy)
+        fields, mask, detail = parse_request(line, self.engine, self.policy,
+                                             scenarios=self.scenarios)
         if mask:
             rid = fields[0] if fields else None
-            self._dead_letter(rid, mask, detail, line)
+            scen = fields[4] if fields else None
+            self._dead_letter(rid, mask, detail, line,
+                              extra={"scenario_id": scen})
             _obs.record_query_outcome("dead_letter")
             return [self._stamp({"id": rid, "ok": False,
                                  "outcome": "dead_letter",
                                  "reasons": req_reason_names(mask),
-                                 "detail": detail})]
-        rid, w, bidx, deadline_s = fields
+                                 "detail": detail}, scenario_id=scen)]
+        rid, w, bidx, deadline_s, scen = fields
         now = self._clock()
-        self._queue.append(_Request(rid, w, bidx, now, now + deadline_s))
+        self._queue.append(_Request(rid, w, bidx, now, now + deadline_s,
+                                    scenario=scen))
         # bounded queue: shedding drops the OLDEST queued work first —
         # under overload the head of the queue is the request whose
         # deadline is nearest death; the freshest work is the most useful
@@ -425,7 +453,8 @@ class QueryServer:
             _obs.record_shed()
             _obs.record_query_outcome("shed")
             out.append(self._stamp({"id": old.rid, "ok": False,
-                                    "outcome": "shed"}))
+                                    "outcome": "shed"},
+                                   scenario_id=old.scenario))
         _obs.record_queue_depth(len(self._queue))
         return out
 
@@ -449,7 +478,8 @@ class QueryServer:
             if now > r.deadline_t:
                 _obs.record_query_outcome("deadline")
                 out.append(self._stamp({"id": r.rid, "ok": False,
-                                        "outcome": "deadline"}))
+                                        "outcome": "deadline"},
+                                       scenario_id=r.scenario))
             else:
                 live.append(r)
         if not live:
@@ -462,39 +492,60 @@ class QueryServer:
                 out.append(self._stamp({
                     "id": r.rid, "ok": False, "outcome": "rejected",
                     "retry_after_s": round(self.breaker.retry_after(), 3),
-                    "breaker": self.breaker.open_reason or "open"}))
+                    "breaker": self.breaker.open_reason or "open"},
+                    scenario_id=r.scenario))
             return out
-        W = np.stack([r.weights for r in live]).astype(self.engine.dtype)
-        bench = [r.bidx for r in live]
-        t0 = time.perf_counter()
-        try:
-            res = self.engine.query(W, bench=bench)
-        except Exception as e:   # noqa: BLE001 — any batch failure trips
-            self.breaker.record_failure()
-            for r in live:
-                _obs.record_query_outcome("error")
-                out.append(self._stamp({"id": r.rid, "ok": False,
-                                        "outcome": "error",
-                                        "detail": str(e)[:500]}))
-            return out
-        dt = time.perf_counter() - t0
-        self.breaker.record_success()
-        _obs.record_query_batch(len(live), dt)
-        done = self._clock()
-        for i, r in enumerate(live):
-            _obs.record_query_outcome("ok")
-            _obs.record_query_latency(max(0.0, done - r.enq_t))
-            resp = {"id": r.rid, "ok": True, "outcome": "ok",
-                    "total_vol": float(res.total_vol[i]),
-                    "factor_var": float(res.factor_var[i]),
-                    "specific_var": float(res.specific_var[i]),
-                    "contribution": np.asarray(
-                        res.contribution[i]).tolist(),
-                    "marginal": np.asarray(res.marginal[i]).tolist()}
-            if r.bidx > 0:
-                resp["active_risk"] = float(res.active_risk[i])
-                resp["beta"] = float(res.beta[i])
-            out.append(self._stamp(resp))
+        # group by scenario tag, first-appearance order: the None group is
+        # the exact pre-scenario path (one stack, one engine.query) so
+        # untagged traffic stays bitwise-identical; each tagged group runs
+        # the same batched path against its stressed engine
+        groups: dict = {}
+        for r in live:
+            groups.setdefault(r.scenario, []).append(r)
+        for scen, grp in groups.items():
+            engine = self.engine if scen is None else self.scenarios.get(scen)
+            if engine is None:
+                # table swapped between admission and drain
+                for r in grp:
+                    _obs.record_query_outcome("error")
+                    out.append(self._stamp(
+                        {"id": r.rid, "ok": False, "outcome": "error",
+                         "detail": f"scenario {scen!r} no longer served"},
+                        scenario_id=scen))
+                continue
+            W = np.stack([r.weights for r in grp]).astype(engine.dtype)
+            bench = [r.bidx for r in grp]
+            t0 = time.perf_counter()
+            try:
+                res = engine.query(W, bench=bench)
+            except Exception as e:   # noqa: BLE001 — any batch failure trips
+                self.breaker.record_failure()
+                for r in grp:
+                    _obs.record_query_outcome("error")
+                    out.append(self._stamp({"id": r.rid, "ok": False,
+                                            "outcome": "error",
+                                            "detail": str(e)[:500]},
+                                           scenario_id=scen, engine=engine))
+                continue
+            dt = time.perf_counter() - t0
+            self.breaker.record_success()
+            _obs.record_query_batch(len(grp), dt)
+            done = self._clock()
+            for i, r in enumerate(grp):
+                _obs.record_query_outcome("ok")
+                _obs.record_query_latency(max(0.0, done - r.enq_t))
+                resp = {"id": r.rid, "ok": True, "outcome": "ok",
+                        "total_vol": float(res.total_vol[i]),
+                        "factor_var": float(res.factor_var[i]),
+                        "specific_var": float(res.specific_var[i]),
+                        "contribution": np.asarray(
+                            res.contribution[i]).tolist(),
+                        "marginal": np.asarray(res.marginal[i]).tolist()}
+                if r.bidx > 0:
+                    resp["active_risk"] = float(res.active_risk[i])
+                    resp["beta"] = float(res.beta[i])
+                out.append(self._stamp(resp, scenario_id=scen,
+                                       engine=engine))
         chaos_point("serve.after_batch", f"batch{self._batch_i}")
         self._batch_i += 1
         return out

@@ -50,6 +50,8 @@ CRASH_POINTS = (
                                    # "batch{i}" so MFM_CHAOS_KILL_MATCH pins
                                    # the kill to an exact batch
                                    # (serve/server.py)
+    "scenario_manifest.after_tmp",  # scenario batch computed, manifest tmp
+                                    # not yet renamed (scenario/manifest.py)
 )
 
 
@@ -200,7 +202,8 @@ class FaultPlan:
     kind: str        # truncate | corrupt | kill | kill_manifest | nan_slab |
                      # outlier_slab | universe_slab | flaky_store |
                      # query_kill | query_poison | query_overflow |
-                     # query_swap | query_steady
+                     # query_swap | query_steady | scenario_kill |
+                     # scenario_poison
     seed: int = 0
     params: tuple = ()   # ((key, value), ...) — hashable, printable
 
@@ -245,4 +248,11 @@ def plan_suite(seed: int = 0) -> tuple:
                   (("corrupt_bytes", 8),)),
         FaultPlan("query-steady-state", "query_steady", s + 15,
                   (("rounds", 6),)),
+        # scenario-engine plans: manifest crash atomicity + per-lane
+        # rejection isolation of the batched stress runner
+        # (mfm_tpu/scenario/)
+        FaultPlan("scenario-kill-mid-batch", "scenario_kill", s + 16,
+                  (("point", "scenario_manifest.after_tmp"),)),
+        FaultPlan("scenario-poison-spec", "scenario_poison", s + 17,
+                  (("n_poison", 3),)),
     )

@@ -191,7 +191,8 @@ def test_plan_suite_is_deterministic():
                                    "outlier_slab", "universe_slab",
                                    "flaky_store", "query_kill",
                                    "query_poison", "query_overflow",
-                                   "query_swap", "query_steady"}
+                                   "query_swap", "query_steady",
+                                   "scenario_kill", "scenario_poison"}
     assert len({p.seed for p in a}) == len(a)
 
 

@@ -275,6 +275,61 @@ def test_r7_telemetry_in_traced_code(tmp_path):
     assert got == [("R7", "bad"), ("R7", "bad"), ("R7", "helper")]
 
 
+def test_r7_scenario_host_only_barrier(tmp_path):
+    """mfm_tpu.scenario.engine / .manifest are host-only: their obs calls
+    and IO are never R7, and ``ScenarioEngine.run``'s bare-name collision
+    with a traced ``run`` must not drag the host engine's telemetry into
+    the traced set.  The device kernel (scenario/kernel.py) is NOT on the
+    host-only list — a doctrine violation there still flags."""
+    res = _lint(tmp_path, {
+        "mfm_tpu/obs/instrument.py": """
+            def record_scenario_batch(n, seconds):
+                pass
+        """,
+        "mfm_tpu/scenario/engine.py": """
+            from mfm_tpu.obs.instrument import record_scenario_batch
+
+            class ScenarioEngine:
+                def run(self, specs):   # collides with RiskModel.run by name
+                    record_scenario_batch(len(specs), 0.1)
+                    return specs
+        """,
+        "mfm_tpu/scenario/manifest.py": """
+            import json
+            import os
+            from mfm_tpu.obs.instrument import record_scenario_batch
+
+            def write_scenario_manifest(path, manifest):
+                record_scenario_batch(1, 0.0)
+                with open(path, "w") as fh:
+                    json.dump(manifest, fh)
+        """,
+        "mfm_tpu/models/risk_model.py": """
+            import jax
+            import jax.numpy as jnp
+
+            class RiskModel:
+                def run(self, x):
+                    return jnp.sum(x)
+
+            @jax.jit
+            def traced(model, x):
+                return model.run(x)   # bare-name resolution: host-only
+                                      # modules must not be candidates
+        """,
+        "mfm_tpu/scenario/kernel.py": """
+            import numpy as np
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def scenario_batch(x):
+                return jnp.asarray(np.mean(x))   # R1: np math in traced code
+        """})
+    assert [(v.rule, v.qualname) for v in res.new] == \
+        [("R1", "scenario_batch")]
+
+
 def test_r7_bare_method_over_approximation(tmp_path):
     """A bare ``.inc(...)`` in traced code resolves (over-approximately)
     against every known def — including obs metric methods — so it flags.

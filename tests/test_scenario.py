@@ -1,0 +1,441 @@
+"""Scenario engine: batched stress tests over one served covariance.
+
+The subsystem's contracts (mfm_tpu/scenario/):
+
+- The IDENTITY scenario is served back bitwise-equal to the baseline —
+  running zero-shock scenarios costs nothing in fidelity.
+- A batch of S scenarios equals S single runs BITWISE, across geometric
+  bucket boundaries: the kernel is lane-independent and the padding is
+  passthrough lanes, never math.
+- Correlation stress past the feasible cone goes indefinite; the gated
+  PSD projection repairs it (min eig >= 0 at compute dtype) and flags
+  the lane + the obs counter.
+- A poisoned spec (NaN shock, corr_beta past the -1 pole) is rejected
+  per-scenario; healthy batchmates' bytes are untouched.
+- A quarantine counterfactual is a REAL guarded re-run with flipped
+  verdicts — engine output equals a manual ``update_guarded`` with the
+  same ``pre_reasons`` / ``heal_mask`` operands, bitwise.
+- Steady state holds the serving discipline: <= 1 compile per S-bucket
+  (assert_max_compiles), same as the query engine.
+
+Everything bitwise is assert_array_equal / tobytes — same discipline as
+tests/test_quarantine.py, whose donation rules also apply (states are
+copied before reuse; panels enter models as jnp.array copies).
+"""
+
+import json
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mfm_tpu.config import QuarantinePolicy, RiskModelConfig
+from mfm_tpu.models.risk_model import RiskModel
+from mfm_tpu.obs import instrument as _obs
+from mfm_tpu.scenario import (
+    PRESETS,
+    ScenarioBuilder,
+    ScenarioEngine,
+    ScenarioManifestError,
+    ScenarioSpec,
+    audit_scenario_manifest,
+    build_scenario_manifest,
+    make_counterfactual_fn,
+    make_replay_lookup,
+    preset,
+    read_scenario_manifest,
+    scenario_manifest_path_for,
+    validate_spec,
+    write_scenario_manifest,
+)
+from mfm_tpu.serve.guard import REASON_FORCED
+from mfm_tpu.utils.contracts import assert_max_compiles
+
+K = 6
+
+
+def _base_cov(seed=0, k=K, dtype=np.float32):
+    """A well-conditioned PSD baseline covariance."""
+    rng = np.random.default_rng(seed)
+    a = rng.normal(size=(k, k))
+    return ((a @ a.T + 1e-2 * np.eye(k)) * 1e-4).astype(dtype)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return ScenarioEngine(_base_cov())
+
+
+def _mixed_specs():
+    """Nine healthy specs spanning every transform axis (S=9 crosses the
+    8 -> 32 bucket boundary vs the S=1 singles)."""
+    return [
+        ScenarioSpec.identity(),
+        ScenarioBuilder("shock-add").shock("f0", add=2e-3).build(),
+        ScenarioBuilder("shock-mult").shock("f1", mult=2.0).build(),
+        ScenarioBuilder("shock-both").shock("f2", add=1e-3, mult=0.5).build(),
+        ScenarioBuilder("regime-hot").vol_regime(3.0).build(),
+        ScenarioBuilder("corr-up").correlation(0.3).build(),
+        ScenarioBuilder("combo").shock("f3", mult=1.5).vol_regime(1.2)
+        .correlation(-0.4).build(),
+        preset("crash-2015-analog"),
+        preset("corr-meltup"),
+    ]
+
+
+# -- spec declaration ---------------------------------------------------------
+
+def test_spec_json_round_trip_and_hash():
+    spec = (ScenarioBuilder("drill")
+            .shock("f1", add=1e-3, mult=2.0).shock("f0", add=-5e-4)
+            .vol_regime(1.5).correlation(0.3)
+            .replay("2024-01-02", "2024-02-29")
+            .flip("2024-03-04").flip("2024-03-05", heal=True).build())
+    back = ScenarioSpec.from_json(spec.to_json())
+    assert back == spec
+    assert back.spec_hash() == spec.spec_hash()
+    # canonical order: dict-built and builder-built specs hash identically
+    twin = ScenarioSpec(name="drill",
+                        shift={"f0": -5e-4, "f1": 1e-3},
+                        scale={"f1": 2.0}, vol_mult=1.5, corr_beta=0.3,
+                        replay=("2024-01-02", "2024-02-29"),
+                        flip_quarantine=("2024-03-04",),
+                        flip_heal=("2024-03-05",))
+    assert twin.spec_hash() == spec.spec_hash()
+    assert set(spec.kinds) == {"vol_shock", "vol_regime", "corr_stress",
+                               "replay", "counterfactual"}
+    assert ScenarioSpec.identity().is_identity
+    assert ScenarioSpec.identity().kinds == ("identity",)
+
+
+def test_spec_from_dict_rejects_bad_wire_forms():
+    with pytest.raises(ValueError, match="JSON object"):
+        ScenarioSpec.from_dict(["not", "a", "dict"])
+    with pytest.raises(ValueError, match="schema_version"):
+        ScenarioSpec.from_dict({"schema_version": 99, "name": "x"})
+    with pytest.raises(ValueError, match="missing 'name'"):
+        ScenarioSpec.from_dict({"vol_mult": 2.0})
+
+
+def test_validate_spec_catches_every_poison_axis():
+    names = [f"f{i}" for i in range(K)]
+
+    def problems(**kw):
+        return validate_spec(ScenarioSpec(name=kw.pop("name", "s"), **kw),
+                             names)
+
+    assert problems() == []
+    assert any("non-finite" in p
+               for p in problems(shift=(("f0", math.nan),)))
+    assert any(">= 0" in p for p in problems(scale=(("f0", -1.0),)))
+    assert any("unknown factor" in p
+               for p in problems(shift=(("nope", 1.0),)))
+    assert any("vol_mult" in p for p in problems(vol_mult=0.0))
+    assert any("vol_mult" in p for p in problems(vol_mult=math.inf))
+    assert any("corr_beta" in p for p in problems(corr_beta=-1.5))
+    assert any("reversed" in p
+               for p in problems(replay=("2024-06-01", "2024-01-01")))
+    assert any("both ways" in p
+               for p in problems(flip_quarantine=("2024-01-05",),
+                                 flip_heal=("2024-01-05",)))
+
+
+# -- bitwise anchors ----------------------------------------------------------
+
+def test_identity_scenario_is_bitwise_baseline(engine):
+    res, = engine.run([ScenarioSpec.identity()])
+    assert res.ok and not res.psd_projected
+    assert res.cov.tobytes() == engine.cov.tobytes()
+    np.testing.assert_array_equal(res.vol_delta(), 0)
+
+
+def test_batch_equals_singles_across_bucket_boundary(engine):
+    specs = _mixed_specs()
+    batch = engine.run(specs)           # S=9 -> bucket 32
+    for spec, got in zip(specs, batch):
+        want, = engine.run([spec])      # S=1 -> bucket 8
+        assert got.ok and want.ok
+        assert got.cov.tobytes() == want.cov.tobytes(), spec.name
+        assert got.psd_projected == want.psd_projected, spec.name
+        np.testing.assert_array_equal(got.factor_vol, want.factor_vol,
+                                      err_msg=spec.name)
+
+
+def test_corr_stress_past_cone_is_projected_psd():
+    # stressed correlations (x1.9, clipped) of this sign pattern are
+    # provably indefinite: [[1,.95,.95],[.95,1,-.95],[.95,-.95,1]]
+    corr = np.array([[1.0, 0.5, 0.5],
+                     [0.5, 1.0, -0.5],
+                     [0.5, -0.5, 1.0]])
+    sigma = np.array([0.01, 0.02, 0.03])
+    cov = (corr * np.outer(sigma, sigma)).astype(np.float32)
+    eng = ScenarioEngine(cov)
+    before = int(_obs.SCENARIO_PSD_PROJECTIONS_TOTAL.value())
+    res, = eng.run([ScenarioBuilder("meltup").correlation(0.9).build()])
+    assert res.ok and res.psd_projected
+    assert res.min_eig_stressed < 0
+    eigs = np.linalg.eigvalsh(res.cov)          # at compute dtype
+    assert eigs.min() >= 0, f"projected cov not PSD: min eig {eigs.min()}"
+    assert int(_obs.SCENARIO_PSD_PROJECTIONS_TOTAL.value()) == before + 1
+
+
+def test_poisoned_specs_reject_without_touching_batchmates(engine):
+    healthy = _mixed_specs()
+    poison = [
+        ScenarioBuilder("p-nan").shock("f0", add=math.nan).build(),
+        ScenarioBuilder("p-corr").correlation(-1.5).build(),
+        ScenarioBuilder("p-vol").vol_regime(-1.0).build(),
+        ScenarioBuilder("p-factor").shock("not-a-factor", add=1e-3).build(),
+    ]
+    mixed = [poison[0]] + healthy[:4] + [poison[1], poison[2]] \
+        + healthy[4:] + [poison[3]]
+    res = {r.spec.name: r for r in engine.run(mixed)}
+    for p in poison:
+        r = res[p.name]
+        assert r.status == "rejected" and r.problems and r.cov is None
+        assert r.vol_delta() is None
+    clean = engine.run(healthy)
+    for want in clean:
+        got = res[want.spec.name]
+        assert got.ok
+        assert got.cov.tobytes() == want.cov.tobytes(), want.spec.name
+
+
+def test_steady_state_holds_one_compile_per_bucket(engine):
+    small = _mixed_specs()[:3]          # S=3 -> bucket 8
+    big = _mixed_specs()                # S=9 -> bucket 32
+    engine.run(small)                   # warm both buckets
+    engine.run(big)
+    with assert_max_compiles(1, "steady-state scenario buckets"):
+        engine.run(big)
+        engine.run(small)
+        # shock values change, shapes don't: still zero new lowerings
+        engine.run([ScenarioBuilder("retune").shock("f5", mult=4.0).build(),
+                    ScenarioBuilder("retune2").vol_regime(0.5).build()])
+
+
+def test_run_refuses_malformed_batches(engine):
+    with pytest.raises(ValueError, match="at least one"):
+        engine.run([])
+    with pytest.raises(ValueError, match="duplicate scenario names"):
+        engine.run([ScenarioSpec.identity("x"), ScenarioSpec.identity("x")])
+    with pytest.raises(ValueError, match="bucket"):
+        engine.run(_mixed_specs(), bucket=4)
+    with pytest.raises(ValueError, match="non-finite"):
+        ScenarioEngine(np.full((3, 3), np.nan, np.float32))
+    with pytest.raises(ValueError, match="factor names"):
+        ScenarioEngine(_base_cov(), factor_names=["just-one"])
+
+
+# -- replay -------------------------------------------------------------------
+
+def test_replay_lookup_resolves_last_valid_date_in_window():
+    dates = [f"2024-01-{d:02d}" for d in (2, 3, 4, 5)]
+    covs = np.stack([np.eye(2) * (i + 1) for i in range(4)])
+    valid = np.array([True, True, False, True])
+    lookup = make_replay_lookup(dates, covs, valid=valid)
+    # window covering an invalid tail date resolves to the last VALID hit
+    np.testing.assert_array_equal(lookup("2024-01-02", "2024-01-04"),
+                                  covs[1])
+    np.testing.assert_array_equal(lookup("2024-01-01", "2024-12-31"),
+                                  covs[3])
+    assert lookup("2023-01-01", "2023-12-31") is None
+    with pytest.raises(ValueError, match="need"):
+        make_replay_lookup(dates, covs[:2])
+
+
+def test_replay_scenarios_rebase_the_shock(engine):
+    dates = ["2024-01-02", "2024-01-03"]
+    hist = np.stack([_base_cov(7), _base_cov(8)])
+    eng = ScenarioEngine(engine.cov,
+                         replay_lookup=make_replay_lookup(dates, hist))
+    plain, shocked, missing = eng.run([
+        ScenarioBuilder("rp").replay(*dates).build(),
+        ScenarioBuilder("rp-hot").replay(*dates).vol_regime(2.0).build(),
+        ScenarioBuilder("rp-miss").replay("1999-01-01",
+                                          "1999-12-31").build(),
+    ])
+    # identity transform on a replayed base: that base, bitwise
+    assert plain.ok and plain.cov.tobytes() == hist[1].tobytes()
+    # shocked replay == shocking an engine whose baseline IS the window
+    want, = ScenarioEngine(hist[1]).run(
+        [ScenarioBuilder("rp-hot").vol_regime(2.0).build()])
+    assert shocked.cov.tobytes() == want.cov.tobytes()
+    assert missing.status == "rejected"
+    assert any("not in the engine's history" in p for p in missing.problems)
+    # no history wired in: replay specs reject instead of guessing
+    none, = engine.run([ScenarioBuilder("rp").replay(*dates).build()])
+    assert none.status == "rejected"
+
+
+# -- quarantine counterfactuals (real guarded re-runs) ------------------------
+
+T, N, P, Q = 32, 16, 3, 2
+T0 = 24
+GCFG = RiskModelConfig(eigen_n_sims=8, eigen_sim_length=T,
+                       quarantine=QuarantinePolicy(enabled=True))
+SLAB_DATES = [f"2024-02-{d:02d}" for d in range(1, T - T0 + 1)]
+
+
+def _panels(seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.normal(0, 0.02, (T, N)),
+        rng.lognormal(10, 1, (T, N)),
+        rng.normal(size=(T, N, Q)),
+        rng.integers(0, P, (T, N)),
+        rng.random((T, N)) > 0.05,
+    )
+
+
+def _model(panels, sl=slice(None)):
+    # fresh JAX-owned buffers per call: update_guarded donates its inputs
+    return RiskModel(*(jnp.array(np.asarray(p)[sl]) for p in panels),
+                     n_industries=P, config=GCFG)
+
+
+def _copy(state):
+    return jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), state)
+
+
+@pytest.fixture(scope="module")
+def guarded():
+    """Prefix checkpoint + the plain (unflipped) slab re-run's report."""
+    panels = _panels()
+    _, st = _model(panels, slice(0, T0)).init_state()
+    _, report, _ = _model(panels, slice(T0, T)).update_guarded(_copy(st))
+    return panels, st, report
+
+
+def test_counterfactual_is_a_real_rerun_with_flipped_verdicts(guarded):
+    panels, st, report = guarded
+    cf = make_counterfactual_fn(_model(panels, slice(T0, T)), st, SLAB_DATES)
+    base = np.asarray(report.served_cov[-1])
+    eng = ScenarioEngine(base, counterfactual_fn=cf)
+
+    flip = SLAB_DATES[2]
+    got, = eng.run([ScenarioBuilder("what-if").flip(flip).build()])
+    assert got.ok
+    # the manual world: same slab, pre_reasons forcing that one date
+    pre = np.zeros(T - T0, np.uint32)
+    pre[2] = REASON_FORCED
+    _, rep, _ = _model(panels, slice(T0, T)).update_guarded(
+        _copy(st), pre_reasons=pre, heal_mask=np.zeros(T - T0, bool))
+    want = np.asarray(rep.served_cov[-1]).astype(base.dtype)
+    assert got.cov.tobytes() == want.tobytes()
+    assert bool(np.asarray(rep.quarantined)[2])
+    # forcing a date out moves the answer vs the unflipped world
+    assert got.cov.tobytes() != base.tobytes()
+
+
+def test_counterfactual_heal_forces_a_poisoned_date_healthy(guarded):
+    panels, st, _ = guarded
+    bad = (np.array(panels[0], copy=True),) + tuple(panels[1:])
+    bad[0][T0 + 1, : int(0.6 * N)] = np.nan    # poison one slab date
+    slab = lambda: _model(bad, slice(T0, T))   # noqa: E731
+
+    _, rep_q, _ = slab().update_guarded(_copy(st))
+    assert bool(np.asarray(rep_q.quarantined)[1])
+    base = np.asarray(rep_q.served_cov[-1])
+
+    cf = make_counterfactual_fn(slab(), st, SLAB_DATES)
+    eng = ScenarioEngine(base, counterfactual_fn=cf)
+    got, = eng.run([ScenarioBuilder("heal")
+                    .flip(SLAB_DATES[1], heal=True).build()])
+    assert got.ok
+    heal = np.zeros(T - T0, bool)
+    heal[1] = True
+    _, rep_h, _ = slab().update_guarded(
+        _copy(st), pre_reasons=np.zeros(T - T0, np.uint32), heal_mask=heal)
+    assert not bool(np.asarray(rep_h.quarantined)[1])
+    want = np.asarray(rep_h.served_cov[-1]).astype(base.dtype)
+    assert got.cov.tobytes() == want.tobytes()
+
+
+def test_counterfactual_guard_rails(guarded):
+    panels, st, report = guarded
+    cf = make_counterfactual_fn(_model(panels, slice(T0, T)), st, SLAB_DATES)
+    eng = ScenarioEngine(np.asarray(report.served_cov[-1]),
+                         counterfactual_fn=cf)
+    outside, ambiguous = eng.run([
+        ScenarioBuilder("cf-outside").flip("1999-01-01").build(),
+        ScenarioBuilder("cf-replay").flip(SLAB_DATES[0])
+        .replay("2024-01-01", "2024-01-31").build(),
+    ])
+    assert outside.status == "rejected"
+    assert any("outside the slab" in p for p in outside.problems)
+    assert ambiguous.status == "rejected"
+    assert any("compose ambiguously" in p for p in ambiguous.problems)
+    # no slab context wired in: counterfactual specs reject
+    bare, = ScenarioEngine(_base_cov()).run(
+        [ScenarioBuilder("cf").flip("2024-02-01").build()])
+    assert bare.status == "rejected"
+    with pytest.raises(ValueError, match="slab dates"):
+        make_counterfactual_fn(_model(panels, slice(T0, T)), st,
+                               SLAB_DATES[:-1])
+
+
+def test_from_risk_state_refuses_unguarded(guarded):
+    panels, _, _ = guarded
+    ucfg = RiskModelConfig(eigen_n_sims=8, eigen_sim_length=T)
+    _, st_u = RiskModel(*(jnp.array(np.asarray(p)) for p in panels),
+                        n_industries=P, config=ucfg).init_state()
+    with pytest.raises(ValueError, match="no served covariance"):
+        ScenarioEngine.from_risk_state(st_u)
+
+
+# -- manifests ----------------------------------------------------------------
+
+def test_manifest_round_trip_and_audit(tmp_path, engine):
+    results = engine.run(_mixed_specs() + [
+        ScenarioBuilder("p-nan").shock("f0", add=math.nan).build()])
+    man = build_scenario_manifest(
+        results, engine.factor_names, stamp_json='{"cfg": 1}',
+        backend="cpu", summary=_obs.scenario_summary_from_registry(),
+        staleness=engine.staleness)
+    path = write_scenario_manifest(str(tmp_path), man)
+    assert path == scenario_manifest_path_for(str(tmp_path))
+    back = read_scenario_manifest(str(tmp_path))
+    assert back["n_scenarios"] == 10 and back["n_ok"] == 9
+    assert back["n_rejected"] == 1 and back["n_psd_projected"] >= 1
+    ok_entries = [e for e in back["scenarios"] if e["status"] == "ok"]
+    assert all("top_vol_swings" in e and "total_vol_after" in e
+               for e in ok_entries)
+    problems, warnings = audit_scenario_manifest(path)
+    assert problems == []
+    assert any("p-nan" in w for w in warnings)
+
+
+def test_manifest_audit_flags_tampering_and_tears(tmp_path, engine):
+    results = engine.run(_mixed_specs()[:2])
+    man = build_scenario_manifest(results, engine.factor_names)
+    path = write_scenario_manifest(str(tmp_path), man)
+
+    tampered = read_scenario_manifest(path)
+    tampered["scenarios"][1]["spec"]["vol_mult"] = 99.0   # edited results
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(tampered, fh)
+    problems, _ = audit_scenario_manifest(path)
+    assert any("spec hash mismatch" in p for p in problems)
+
+    with open(path, "w", encoding="utf-8") as fh:          # torn write
+        fh.write(json.dumps(man)[: len(json.dumps(man)) // 2])
+    with pytest.raises(ScenarioManifestError, match="torn"):
+        read_scenario_manifest(path)
+
+    with open(path, "w", encoding="utf-8") as fh:          # wrong artifact
+        json.dump({"schema_version": 1, "kind": "checkpoint_manifest",
+                   "scenarios": []}, fh)
+    with pytest.raises(ScenarioManifestError, match="not a scenario"):
+        read_scenario_manifest(path)
+    with pytest.raises(ScenarioManifestError, match="unreadable"):
+        read_scenario_manifest(str(tmp_path / "nope.json"))
+
+
+def test_preset_catalog_is_admissible(engine):
+    for name in PRESETS:
+        assert validate_spec(preset(name), engine.factor_names) == []
+    with pytest.raises(KeyError, match="unknown preset"):
+        preset("dot-com-analog")

@@ -91,8 +91,9 @@ def test_parse_request_dict_weights_and_benchmark():
                 deadline_s=2.5)
     fields, mask, _ = parse_request(line, _engine(), ServePolicy())
     assert mask == 0
-    rid, w, bidx, deadline_s = fields
+    rid, w, bidx, deadline_s, scenario = fields
     assert rid == "x" and bidx == 1 and deadline_s == 2.5
+    assert scenario is None
     np.testing.assert_array_equal(w, [0.0, 0.0, 0.7, 0.3])
 
 
@@ -241,6 +242,90 @@ def test_run_e2e_summary_and_stamps():
     assert summary["portfolios_total"] - before["portfolios_total"] == 7
     assert summary["breaker_state"] == "closed"
     assert summary["query_p50_latency_s"] is not None
+
+
+# -- scenario-tagged serving --------------------------------------------------
+
+def _scenario_table(engine):
+    """Two stressed siblings of ``engine`` via the scenario engine's own
+    serve-side sugar (exposures/benchmarks/dtype ride along)."""
+    from mfm_tpu.scenario import ScenarioBuilder, ScenarioEngine
+
+    sc = ScenarioEngine(np.asarray(engine._cov),
+                        factor_names=engine.factor_names)
+    results = sc.run([
+        ScenarioBuilder("hot").vol_regime(2.0).build(),
+        ScenarioBuilder("meltup").correlation(0.9).build(),
+    ])
+    return sc.query_engines(results, engine)
+
+
+def test_every_response_carries_scenario_id():
+    eng = _engine()
+    server = QueryServer(eng, ServePolicy(default_deadline_s=60.0),
+                         health="ok", scenarios=_scenario_table(eng))
+    server.submit_line(_req("plain"))
+    server.submit_line(_req("stressed", scenario="hot"))
+    out = {r["id"]: r for r in server.drain()}
+    assert out["plain"]["scenario_id"] is None
+    assert out["stressed"]["scenario_id"] == "hot"
+    assert out["plain"]["ok"] and out["stressed"]["ok"]
+    # the stressed world answers with MORE risk, same portfolio
+    assert out["stressed"]["total_vol"] > out["plain"]["total_vol"]
+
+
+def test_scenario_groups_answer_from_their_own_engines():
+    eng = _engine()
+    table = _scenario_table(eng)
+    server = QueryServer(eng, ServePolicy(batch_max=8,
+                                          default_deadline_s=60.0),
+                         health="ok", scenarios=table)
+    for i in range(2):
+        server.submit_line(_req(f"p{i}"))
+        server.submit_line(_req(f"h{i}", scenario="hot"))
+        server.submit_line(_req(f"m{i}", scenario="meltup"))
+    out = {r["id"]: r for r in server.drain()}
+    assert all(out[f"p{i}"]["scenario_id"] is None for i in range(2))
+    assert all(out[f"h{i}"]["scenario_id"] == "hot" for i in range(2))
+    assert all(out[f"m{i}"]["scenario_id"] == "meltup" for i in range(2))
+    # each group's answer equals a dedicated server over that engine:
+    # the plain group is the exact pre-scenario path
+    for scen, rid in ((None, "p0"), ("hot", "h0"), ("meltup", "m0")):
+        solo = QueryServer(eng if scen is None else table[scen],
+                           ServePolicy(default_deadline_s=60.0), health="ok")
+        solo.submit_line(_req("ref"))
+        ref, = solo.drain()
+        assert out[rid]["total_vol"] == ref["total_vol"], scen
+
+
+def test_unknown_scenario_dead_letters_with_tag(tmp_path):
+    dl = str(tmp_path / "dead.jsonl")
+    eng = _engine()
+    server = QueryServer(eng, ServePolicy(), health="ok",
+                         dead_letter_path=dl, scenarios=_scenario_table(eng))
+    resp, = server.submit_line(_req("bad", scenario="not-served"))
+    assert resp["outcome"] == "dead_letter"
+    assert resp["reasons"] == ["unknown_scenario"]
+    assert resp["scenario_id"] == "not-served"
+    # ANY tag is unknown when no table is served at all
+    bare = QueryServer(_engine(), ServePolicy(), health="ok")
+    resp, = bare.submit_line(_req("bad2", scenario="hot"))
+    assert resp["reasons"] == ["unknown_scenario"]
+    server.close()
+    rec, = [json.loads(ln) for ln in open(dl)]
+    assert rec["scenario_id"] == "not-served"
+
+
+def test_scenario_swapped_out_between_admission_and_drain():
+    eng = _engine()
+    server = QueryServer(eng, ServePolicy(default_deadline_s=60.0),
+                         health="ok", scenarios=_scenario_table(eng))
+    server.submit_line(_req("r1", scenario="hot"))
+    server.scenarios.pop("hot")           # table swap mid-flight
+    resp, = server.drain()
+    assert resp["outcome"] == "error" and not resp["ok"]
+    assert resp["scenario_id"] == "hot"
+    assert "no longer served" in resp["detail"]
 
 
 # -- doctor --serve -----------------------------------------------------------

@@ -6,8 +6,9 @@
 #   tools/bench_all.sh [OUTDIR]
 #
 # Configs (bench.py): default = config 1 (risk model e2e, the driver metric),
-# beta, factors, alla, alpha, query.  Each prints ONE JSON line; a dead TPU
-# tunnel falls back to CPU with an `errors` field rather than hanging.
+# beta, factors, alla, alpha, query, scenario.  Each prints ONE JSON line; a
+# dead TPU tunnel falls back to CPU with an `errors` field rather than
+# hanging.
 #
 # The config-1 record also carries the serving metrics: daily_update_latency_s
 # (one-date append to the resumable state), guarded_update_latency_s +
@@ -39,14 +40,16 @@ python bench.py --config factors "${plat[@]}" | tail -1 > "$out/config3_factors.
 python bench.py --config alla    "${plat[@]}" | tail -1 > "$out/config4_alla.json"
 python bench.py --config alpha   "${plat[@]}" | tail -1 > "$out/config5_alpha.json"
 python bench.py --config query   "${plat[@]}" | tail -1 > "$out/config6_query.json"
+python bench.py --config scenario "${plat[@]}" | tail -1 > "$out/config7_scenario.json"
 
-# the query-service numbers are only evidence if the service actually
-# recovers: gate config 6 on its chaos plans (bitwise restart recovery,
-# dead-letter quarantine, shed ordering, breaker-on-corrupt-swap, and the
-# <=1-compile-per-bucket steady state)
+# the query-service and scenario numbers are only evidence if the services
+# actually recover: gate configs 6+7 on their chaos plans (bitwise restart
+# recovery, dead-letter quarantine, shed ordering, breaker-on-corrupt-swap,
+# the <=1-compile-per-bucket steady state, scenario-manifest crash
+# atomicity, and per-lane poison isolation)
 python tools/faultinject.py --plans \
-  query-kill-mid-batch,query-poison-slab,query-overflow-storm,query-ckpt-swap,query-steady-state \
-  || { echo "query chaos plans failed — config6 numbers are not evidence" >&2
+  query-kill-mid-batch,query-poison-slab,query-overflow-storm,query-ckpt-swap,query-steady-state,scenario-kill-mid-batch,scenario-poison-spec \
+  || { echo "query/scenario chaos plans failed — config6/7 numbers are not evidence" >&2
        exit 1; }
 
 cat "$out"/config*.json

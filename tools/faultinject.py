@@ -819,13 +819,148 @@ def run_query_steady(plan, base: Baseline, root: str) -> dict:
             "steady_compiles": c.count}
 
 
+# -- scenario-engine plans ---------------------------------------------------
+
+def _manifest_modulo_summary(path: str) -> str:
+    """Canonical JSON of a scenario manifest with its ONE volatile block
+    (the obs latency summary) removed — the bitwise-replay comparison key."""
+    with open(path, encoding="utf-8") as fh:
+        m = json.load(fh)
+    m.pop("summary", None)
+    return json.dumps(m, sort_keys=True)
+
+
+def run_scenario_kill(plan, base: Baseline, root: str) -> dict:
+    """scenario-kill-mid-batch: SIGKILL a real `mfm-tpu scenario run`
+    subprocess between the manifest's tmp write and its rename.  No torn
+    ``scenario_manifest.json`` may exist, the clean re-run must write one
+    ``doctor --scenarios`` accepts, and two clean runs must be byte-equal
+    modulo the volatile obs summary block (the bitwise-replay contract)."""
+    from mfm_tpu.scenario.manifest import (
+        read_scenario_manifest, scenario_manifest_path_for,
+    )
+
+    point = plan.param("point")
+    d = _fresh_workdir(root, plan.name, base.snaps[0])
+    path = os.path.join(d, "state.npz")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo_root}
+
+    def _cmd(out_dir):
+        return [sys.executable, "-m", "mfm_tpu.cli", "scenario", "run", path,
+                "--preset", "crash-2015-analog", "--preset", "corr-meltup",
+                "--preset", "covid-2020-analog", "--out", out_dir]
+
+    proc = subprocess.run(_cmd(d), env={**env, "MFM_CHAOS_KILL": point},
+                          capture_output=True, text=True, timeout=600)
+    if proc.returncode != -signal.SIGKILL:
+        raise AssertionError(
+            f"{plan.name}: expected the scenario run to die by SIGKILL at "
+            f"{point}, got rc={proc.returncode}\n{proc.stderr[-2000:]}")
+    mpath = scenario_manifest_path_for(d)
+    if os.path.exists(mpath):
+        raise AssertionError(f"{plan.name}: a scenario manifest exists "
+                             "despite the kill before its rename — the "
+                             "write is not tmp-then-rename atomic")
+    # clean re-run: manifest lands, doctor accepts it
+    proc2 = subprocess.run(_cmd(d), env=env, capture_output=True, text=True,
+                           timeout=600)
+    if proc2.returncode != 0:
+        raise AssertionError(f"{plan.name}: post-crash scenario run failed "
+                             f"rc={proc2.returncode}\n{proc2.stderr[-2000:]}")
+    man = read_scenario_manifest(mpath)   # raises on a torn manifest
+    if man["n_ok"] != 3 or man["n_rejected"] != 0:
+        raise AssertionError(f"{plan.name}: recovered run answered "
+                             f"n_ok={man['n_ok']}, expected 3")
+    doc = subprocess.run([sys.executable, "-m", "mfm_tpu.cli", "doctor", d,
+                          "--scenarios"],
+                         env=env, capture_output=True, text=True, timeout=600)
+    if doc.returncode != 0:
+        raise AssertionError(f"{plan.name}: doctor --scenarios rejects the "
+                             f"post-crash manifest\n{doc.stdout[-2000:]}")
+    # bitwise replay: a second clean run produces the same manifest modulo
+    # the volatile obs summary
+    d2 = os.path.join(root, plan.name + "-replay")
+    os.makedirs(d2)
+    proc3 = subprocess.run(_cmd(d2), env=env, capture_output=True, text=True,
+                           timeout=600)
+    if proc3.returncode != 0:
+        raise AssertionError(f"{plan.name}: replay run failed "
+                             f"rc={proc3.returncode}\n{proc3.stderr[-2000:]}")
+    if _manifest_modulo_summary(mpath) != _manifest_modulo_summary(
+            scenario_manifest_path_for(d2)):
+        raise AssertionError(f"{plan.name}: two clean scenario runs diverge "
+                             "(modulo the obs summary) — the batch is not "
+                             "bitwise-replayable")
+    return {"killed_at": point, "manifest_after_crash": "absent",
+            "recovered_n_ok": man["n_ok"]}
+
+
+def run_scenario_poison(plan, base: Baseline, root: str) -> dict:
+    """scenario-poison-spec: poisoned specs (NaN shock, corr stress past
+    -1, negative vol regime) are rejected per-lane with reported problems
+    while their healthy batchmates' covariances stay byte-equal to a run
+    that never saw the poison — the lane-isolation contract of the
+    batched kernel."""
+    from mfm_tpu.data.artifacts import load_risk_state
+    from mfm_tpu.scenario import ScenarioBuilder, ScenarioEngine, preset
+
+    d = _fresh_workdir(root, plan.name, base.snaps[0])
+    state, meta = load_risk_state(os.path.join(d, "state.npz"))
+    engine = ScenarioEngine.from_risk_state(state, meta)
+    f0 = engine.factor_names[0]
+    healthy = [preset("crash-2015-analog"), preset("corr-meltup"),
+               ScenarioBuilder("shock-one").shock(f0, add=1e-3).build(),
+               ScenarioBuilder("identity").build()]
+    poison = [
+        ScenarioBuilder("p-nan").shock(f0, add=float("nan")).build(),
+        ScenarioBuilder("p-corr").correlation(-1.5).build(),
+        ScenarioBuilder("p-vol").vol_regime(-1.0).build(),
+    ]
+    if len(poison) != int(plan.param("n_poison", len(poison))):
+        raise AssertionError(f"{plan.name}: plan expects "
+                             f"{plan.param('n_poison')} poisoned specs, "
+                             f"harness built {len(poison)}")
+    # interleave the poison through the batch: lane isolation must not
+    # depend on where the bad lanes sit
+    mixed = [poison[0], healthy[0], healthy[1], poison[1], healthy[2],
+             poison[2], healthy[3]]
+    results = {r.spec.name: r for r in engine.run(mixed)}
+    for p in poison:
+        r = results[p.name]
+        if r.status != "rejected" or not r.problems:
+            raise AssertionError(f"{plan.name}: poisoned spec {p.name} was "
+                                 f"{r.status} with problems {r.problems}, "
+                                 "expected a reported rejection")
+        if r.cov is not None:
+            raise AssertionError(f"{plan.name}: rejected spec {p.name} "
+                                 "still produced a covariance")
+    # reference: a fresh engine that never saw the poison
+    ref_engine = ScenarioEngine.from_risk_state(*load_risk_state(
+        os.path.join(d, "state.npz")))
+    ref = {r.spec.name: r for r in ref_engine.run(healthy)}
+    for h in healthy:
+        got, want = results[h.name], ref[h.name]
+        if not got.ok or not want.ok:
+            raise AssertionError(f"{plan.name}: healthy spec {h.name} "
+                                 f"answered {got.status}/{want.status}")
+        if got.cov.tobytes() != want.cov.tobytes():
+            raise AssertionError(f"{plan.name}: healthy spec {h.name}'s "
+                                 "covariance diverged from the poison-free "
+                                 "run — lanes are not isolated")
+    return {"rejected": [p.name for p in poison],
+            "healthy_bitwise": [h.name for h in healthy]}
+
+
 RUNNERS = {"truncate": run_byte_fault, "corrupt": run_byte_fault,
            "kill": run_kill, "kill_manifest": run_kill_manifest,
            "nan_slab": run_poison, "outlier_slab": run_poison,
            "universe_slab": run_poison, "flaky_store": run_flaky_store,
            "query_kill": run_query_kill, "query_poison": run_query_poison,
            "query_overflow": run_query_overflow, "query_swap": run_query_swap,
-           "query_steady": run_query_steady}
+           "query_steady": run_query_steady,
+           "scenario_kill": run_scenario_kill,
+           "scenario_poison": run_scenario_poison}
 
 
 def main(argv=None) -> int:
