@@ -156,20 +156,33 @@ def _metrics_init(args) -> None:
 
 
 def _metrics_flush(args) -> None:
-    """``--metrics-dir``: write the Prometheus textfile + snapshot JSON."""
+    """``--metrics-dir``: write the Prometheus textfile + snapshot JSON +
+    the run's Chrome trace (atomic, Perfetto-loadable)."""
     mdir = getattr(args, "metrics_dir", None)
     if not mdir:
         return
     from mfm_tpu.obs.exporters import emit_event, write_prometheus_textfile
     from mfm_tpu.obs.metrics import snapshot_json
+    from mfm_tpu.obs.trace import spans, write_chrome_trace
 
     write_prometheus_textfile(os.path.join(mdir, "metrics.prom"))
     with open(os.path.join(mdir, "metrics.json"), "w") as fh:
         fh.write(snapshot_json() + "\n")
+    if spans():
+        write_chrome_trace(os.path.join(mdir, "trace.json"))
     emit_event("info", "run_end", cmd=args.cmd)
 
 
-def _write_manifest_beside(state_path: str, res) -> dict:
+def _root_span(args):
+    """Open the per-run root span; its trace_id lands in the run manifest
+    so ``doctor`` can join manifests to traces.  Explicit start/end (not a
+    context manager) so command bodies stay flat."""
+    from mfm_tpu.obs.trace import new_trace_id, start_span
+
+    return start_span(f"cli.{args.cmd}", trace_id=new_trace_id())
+
+
+def _write_manifest_beside(state_path: str, res, trace_id=None) -> dict:
     """After a checkpoint save: run-manifest next to it (atomic), carrying
     the checkpoint's identity stamp, the guard verdict summary, the live
     metrics snapshot, and the model-health verdict.  Returns the health
@@ -196,6 +209,7 @@ def _write_manifest_beside(state_path: str, res) -> dict:
         metrics_snapshot=REGISTRY.snapshot(),
         guard_summary=guard,
         health=health,
+        extra=({"trace_id": trace_id} if trace_id else None),
     )
     write_run_manifest(manifest_path_for(state_path), manifest)
     return health
@@ -223,6 +237,9 @@ def _risk(args):
         raise SystemExit("--update serves new dates only — run the bias "
                          "acceptance tests on a full-history run instead")
     _metrics_init(args)
+    from mfm_tpu.obs.trace import end_span
+
+    root = _root_span(args)
 
     cfg = PipelineConfig(
         risk=RiskModelConfig(
@@ -278,7 +295,7 @@ def _risk(args):
         )
 
         t0 = time.perf_counter()
-        with _profile_ctx(args.profile):
+        with _profile_ctx(args.profile or args.jax_profile):
             try:
                 res = append_risk_pipeline(args.update, df, config=cfg,
                                            force=args.force)
@@ -291,7 +308,8 @@ def _risk(args):
         from mfm_tpu.obs.instrument import record_stage_seconds
 
         record_stage_seconds("update_total", wall)
-        health = _write_manifest_beside(args.update, res)
+        health = _write_manifest_beside(args.update, res,
+                                        trace_id=root.trace_id)
         if args.save_outputs:
             _save_outputs_npz(res, args.out,
                               args.barra or args.barra_store)
@@ -304,9 +322,11 @@ def _risk(args):
             "mean_r2": float(np.nanmean(np.asarray(res.outputs.r2))),
             "state": args.update,
             "health": health["status"],
+            "trace_id": root.trace_id,
         }
         if res.report is not None:
             rec.update(_report_json(res))
+        end_span(root, wall_s=round(wall, 3))
         _metrics_flush(args)
         print(json.dumps(rec))
         return
@@ -314,7 +334,7 @@ def _risk(args):
     arrays = barra_frame_to_arrays(df, industry_codes=codes)
     t0 = time.perf_counter()
     # the reported wall_s includes the profiler overhead when --profile is on
-    with _profile_ctx(args.profile):
+    with _profile_ctx(args.profile or args.jax_profile):
         res = run_risk_pipeline(arrays=arrays, config=cfg,
                                 with_state=bool(args.save_state))
     _write_result_tables(res, args.out, args.specific_risk)
@@ -329,7 +349,7 @@ def _risk(args):
         from mfm_tpu.pipeline import save_pipeline_state
 
         save_pipeline_state(args.save_state, res)
-        _write_manifest_beside(args.save_state, res)
+        _write_manifest_beside(args.save_state, res, trace_id=root.trace_id)
     if args.save_outputs:
         # the full (T, K, K) covariance series + every stage output as one
         # artifact (the CSV tables only carry the last date's covariance,
@@ -361,11 +381,13 @@ def _risk(args):
     # reference only runs the eigen-portfolio variant
     _maybe_portfolio_bias(res, args)
     _maybe_portfolio_risk(res, args)
+    end_span(root, wall_s=round(wall, 3))
     _metrics_flush(args)
     print(json.dumps({
         "dates": int(arrays.ret.shape[0]), "stocks": int(arrays.ret.shape[1]),
         "factors": len(arrays.factor_names()), "wall_s": round(wall, 3),
         "mean_r2": float(np.nanmean(np.asarray(res.outputs.r2))),
+        "trace_id": root.trace_id,
     }))
 
 
@@ -748,6 +770,9 @@ def _pipeline(args):
         raise SystemExit("the resumable state is the serial scan's carry; "
                          "--append needs --nw-method scan")
     _metrics_init(args)
+    from mfm_tpu.obs.trace import end_span
+
+    root = _root_span(args)
     cfg = PipelineConfig(
         risk=RiskModelConfig(
             nw_lags=args.nw_lags, nw_half_life=args.nw_half_life,
@@ -779,7 +804,7 @@ def _pipeline(args):
     # revision check needs the prior run's table, so read it first
     prev_barra = (pd.read_csv(barra_path)
                   if args.append and os.path.exists(barra_path) else None)
-    with _profile_ctx(args.profile):
+    with _profile_ctx(args.profile or args.jax_profile):
         if args.resume and os.path.exists(barra_path) \
                 and os.path.exists(industry_info_path):
             barra = pd.read_csv(barra_path)
@@ -857,7 +882,8 @@ def _pipeline(args):
 
         state_path = os.path.join(args.out, "risk_state.npz")
         save_pipeline_state(state_path, res)
-        health = _write_manifest_beside(state_path, res)
+        health = _write_manifest_beside(state_path, res,
+                                        trace_id=root.trace_id)
     # acceptance-test compute stays OUT of the reported wall (same policy
     # as _risk's bias block)
     _maybe_portfolio_bias(res, args)
@@ -872,6 +898,7 @@ def _pipeline(args):
         "mean_r2": float(np.nanmean(np.asarray(res.outputs.r2))),
         "alpha_styles": n_alpha_styles,
         "out": args.out,
+        "trace_id": root.trace_id,
     }
     if health is not None:
         rec["health"] = health["status"]
@@ -880,6 +907,7 @@ def _pipeline(args):
         rec["update_wall_s"] = round(update_wall, 3)
     if res.report is not None:
         rec.update(_report_json(res))
+    end_span(root, wall_s=round(wall, 3))
     _metrics_flush(args)
     print(json.dumps(rec))
 
@@ -1383,6 +1411,11 @@ def _doctor(args):
                     rec["warnings"].append(
                         "query service ran with degraded model health "
                         "(responses were stamped degraded)")
+                if not man.get("trace_id"):
+                    rec["warnings"].append(
+                        "serve manifest carries no root trace_id — this "
+                        "run cannot be joined to its trace (pre-tracing "
+                        "build, or tracing disabled)")
                 if rec["problems"]:
                     rec["status"] = "unhealthy"
 
@@ -1415,6 +1448,14 @@ def _doctor(args):
             else:
                 rec["problems"].extend(problems)
                 rec["warnings"].extend(warnings)
+                from mfm_tpu.scenario.manifest import read_scenario_manifest
+
+                summary = read_scenario_manifest(scpath).get("summary") or {}
+                if not summary.get("trace_id"):
+                    rec["warnings"].append(
+                        "scenario manifest carries no root trace_id — "
+                        "this run cannot be joined to its trace "
+                        "(pre-tracing build, or tracing disabled)")
                 if rec["problems"]:
                     rec["status"] = "unhealthy"
     unhealthy = sum(r["status"] != "ok" for r in records)
@@ -1449,10 +1490,12 @@ def _serve(args):
         read_run_manifest, write_run_manifest,
     )
     from mfm_tpu.obs.metrics import REGISTRY
+    from mfm_tpu.obs.trace import end_span
     from mfm_tpu.serve.query import QueryEngine
     from mfm_tpu.serve.server import QueryServer, ServePolicy
 
     _metrics_init(args)
+    root = _root_span(args)
     state_path = args.state
 
     def _dead_letter_startup(rec: dict) -> None:
@@ -1559,13 +1602,15 @@ def _serve(args):
         metrics_snapshot=REGISTRY.snapshot(),
         guard_summary=guard_summary_from_registry(),
         health={"status": server.health, "checks": {}},
-        extra={"serve": summary},
+        extra={"serve": summary, "trace_id": root.trace_id},
     )
     spath = os.path.join(os.path.dirname(state_path) or ".",
                          SERVE_MANIFEST_NAME)
     write_run_manifest(spath, manifest)
+    end_span(root)
     _metrics_flush(args)
-    print(json.dumps({"serve": summary, "manifest": spath},
+    print(json.dumps({"serve": summary, "manifest": spath,
+                      "trace_id": root.trace_id},
                      indent=1), file=sys.stderr)
 
 
@@ -1596,8 +1641,10 @@ def _scenario(args):
         ArtifactCorruptError, ArtifactStaleError, load_risk_state,
     )
     from mfm_tpu.obs.instrument import scenario_summary_from_registry
+    from mfm_tpu.obs.trace import end_span
 
     _metrics_init(args)
+    root = _root_span(args)
     try:
         state, meta = load_risk_state(args.state)
     except (ArtifactCorruptError, ArtifactStaleError) as e:
@@ -1639,10 +1686,15 @@ def _scenario(args):
     # a fresh --out must exist as a DIRECTORY before the manifest write:
     # write_scenario_manifest treats a non-dir path as the file itself
     os.makedirs(out_dir, exist_ok=True)
+    # the root trace id rides in the summary block — the ONE volatile
+    # manifest field — so the bitwise-replay contract
+    # (faultinject's _manifest_modulo_summary) is untouched
+    summary = scenario_summary_from_registry()
+    summary["trace_id"] = root.trace_id
     manifest = build_scenario_manifest(
         results, engine.factor_names, stamp_json=meta.get("stamp"),
         backend=jax_backend_name(),
-        summary=scenario_summary_from_registry(),
+        summary=summary,
         staleness=engine.staleness)
     mpath = write_scenario_manifest(out_dir, manifest)
     for r in results:
@@ -1652,11 +1704,13 @@ def _scenario(args):
         if r.ok:
             line["min_eig_stressed"] = float(r.min_eig_stressed)
         print(json.dumps(line, sort_keys=True))
+    end_span(root)
     _metrics_flush(args)
     print(json.dumps({"manifest": mpath, "n_scenarios": len(results),
                       "n_ok": manifest["n_ok"],
                       "n_rejected": manifest["n_rejected"],
-                      "n_psd_projected": manifest["n_psd_projected"]},
+                      "n_psd_projected": manifest["n_psd_projected"],
+                      "trace_id": root.trace_id},
                      indent=1), file=sys.stderr)
     if manifest["n_ok"] == 0:
         raise SystemExit(1)
@@ -1796,6 +1850,10 @@ def main(argv=None):
     r.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the pipeline run "
                         "into DIR (TensorBoard/Perfetto-viewable)")
+    r.add_argument("--jax-profile", default=None, metavar="DIR",
+                   help="synonym of --profile (the device-profiling flag "
+                        "shared with bench.py): gate jax.profiler.trace "
+                        "around the hot region, output into DIR")
     def _positive_int(v):
         iv = int(v)
         if iv < 1:
@@ -1966,6 +2024,10 @@ def main(argv=None):
     pl.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler trace spanning the factor "
                          "and risk stages into DIR")
+    pl.add_argument("--jax-profile", default=None, metavar="DIR",
+                    help="synonym of --profile (the device-profiling flag "
+                         "shared with bench.py): gate jax.profiler.trace "
+                         "around the hot region, output into DIR")
     pl.add_argument("--portfolio-bias", type=_positive_int, default=None,
                     metavar="Q",
                     help="also run the USE4 random-portfolio bias acceptance "

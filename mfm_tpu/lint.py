@@ -85,7 +85,11 @@ _NP_ALLOWED = {
 _R3_ALLOWED_MODULES = ("mfm_tpu.cli", "mfm_tpu.utils.cache", "bench")
 _R3_ALLOWED_PREFIXES = ("tools.",)
 
-# telemetry modules: host-side only, never reachable from traced code (R7)
+# telemetry modules: host-side only, never reachable from traced code (R7).
+# The mfm_tpu.obs prefix covers the whole subsystem — metrics, exporters,
+# manifests, AND the tracing/profiling additions (obs/trace.py spans sync
+# a monotonic clock per call; obs/profile.py triggers lowering/compiles) —
+# so a span opened or a profile pulled inside a jitted function flags.
 _R7_OBS_MODULES = ("mfm_tpu.utils.obs", "mfm_tpu.obs")
 
 # serving-loop modules that are host-side BY DESIGN (breaker, admission

@@ -5,6 +5,9 @@
 - :mod:`~mfm_tpu.obs.instrument` — metric catalog + recording helpers
 - :mod:`~mfm_tpu.obs.manifest` — atomic per-run manifest beside checkpoints
 - :mod:`~mfm_tpu.obs.health` — USE4 bias / R² drift / outlier monitors
+- :mod:`~mfm_tpu.obs.trace` — request-scoped spans + Chrome-trace export
+- :mod:`~mfm_tpu.obs.profile` — cost_analysis / memory / compile-wall probes
+  (imports jax; import the module explicitly, it is not re-exported here)
 
 Catalog + schemas: ``docs/OBSERVABILITY.md``.
 """
@@ -19,12 +22,19 @@ from mfm_tpu.obs.health import HealthThresholds, evaluate_health
 from mfm_tpu.obs.metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                                  REGISTRY, is_enabled, set_enabled,
                                  snapshot_json)
+from mfm_tpu.obs.trace import (Span, current_trace_id, end_span, new_trace_id,
+                               parse_chrome_trace, render_chrome_trace,
+                               reset_tracing, set_tracing, span, spans,
+                               start_span, tracing_enabled, write_chrome_trace)
 
 __all__ = [
     "Counter", "EventLog", "Gauge", "HealthThresholds", "Histogram",
     "MANIFEST_SCHEMA_VERSION", "ManifestError", "MetricsRegistry", "REGISTRY",
-    "build_run_manifest", "emit_event", "evaluate_health", "is_enabled",
-    "manifest_path_for", "parse_prometheus", "read_run_manifest",
-    "render_prometheus", "route_events_to", "set_enabled", "snapshot_json",
-    "write_prometheus_textfile", "write_run_manifest",
+    "Span", "build_run_manifest", "current_trace_id", "emit_event",
+    "end_span", "evaluate_health", "is_enabled", "manifest_path_for",
+    "new_trace_id", "parse_chrome_trace", "parse_prometheus",
+    "read_run_manifest", "render_chrome_trace", "render_prometheus",
+    "reset_tracing", "route_events_to", "set_enabled", "set_tracing",
+    "snapshot_json", "span", "spans", "start_span", "tracing_enabled",
+    "write_chrome_trace", "write_prometheus_textfile", "write_run_manifest",
 ]

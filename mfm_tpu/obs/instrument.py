@@ -78,6 +78,19 @@ RETRY_BACKOFF_SECONDS = REGISTRY.histogram(
 JIT_COMPILES_TOTAL = REGISTRY.counter(
     "mfm_jit_compiles_total",
     "jit lowerings observed since watch_compiles() (steady state: flat)")
+JIT_COMPILE_SECONDS = REGISTRY.histogram(
+    "mfm_jit_compile_seconds",
+    "per-executable lowering/compile wall (obs.profile.capture_compile_walls)",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0))
+
+# -- tracing (obs/trace.py span ring) -----------------------------------------
+
+TRACE_SPANS_TOTAL = REGISTRY.counter(
+    "mfm_trace_spans_total", "spans finished and recorded to the trace ring")
+TRACE_DROPPED_TOTAL = REGISTRY.counter(
+    "mfm_trace_dropped_total",
+    "oldest spans evicted by ring-buffer overflow (trace is lossy past "
+    "capacity, but counted)")
 
 # -- query service (serve/server.py request loop) -----------------------------
 

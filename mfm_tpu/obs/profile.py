@@ -1,0 +1,149 @@
+"""Device profiling: measured cost/memory/compile-wall for compiled executables.
+
+bench.py's roofline was hand-modeled (`_riskmodel_stage_models` counts the
+flops we *think* each stage does); this module asks XLA what the compiled
+program *actually* does.  Three probes, all HOST-SIDE and execution-free:
+
+- :func:`compiled_cost` — ``compiled.cost_analysis()`` flops / bytes
+  accessed, normalized across the dict / list-of-dict / None shapes JAX
+  returns per backend.
+- :func:`compiled_memory_of` — buffer-assignment byte totals, same fields
+  as :func:`mfm_tpu.utils.obs.compiled_memory` but off an already-compiled
+  executable (one compile serves both probes).
+- :func:`capture_compile_walls` — a scoped listener on the same lowering
+  event ``watch_compiles`` hooks, collecting per-executable compile wall
+  into ``mfm_jit_compile_seconds``.  A warm persistent compile cache can
+  legitimately yield ZERO events — callers must treat an empty capture as
+  "cached", not "free".
+
+:func:`executable_profile` bundles the three and tags ``source`` so the
+roofline records whether its gflop/gbyte figures are measured
+("cost_analysis") or fell back to the static model ("static_model") —
+the acceptance bar for trusting a BENCH trajectory across JAX versions.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable
+
+import jax
+
+from mfm_tpu.obs.instrument import JIT_COMPILE_SECONDS
+
+
+def _normalize_cost(raw) -> dict | None:
+    """``cost_analysis()`` returns a dict on new JAX, a list-of-dict on
+    older releases, and None on backends without HLO cost modeling; fold
+    them all into ``{"flops": float, "bytes_accessed": float}`` or None."""
+    if raw is None:
+        return None
+    if isinstance(raw, (list, tuple)):
+        raw = raw[0] if raw else None
+        if raw is None:
+            return None
+    if not isinstance(raw, dict):
+        return None
+    flops = raw.get("flops")
+    nbytes = raw.get("bytes accessed", raw.get("bytes_accessed"))
+    out = {}
+    if isinstance(flops, (int, float)) and flops == flops and flops >= 0:
+        out["flops"] = float(flops)
+    if isinstance(nbytes, (int, float)) and nbytes == nbytes and nbytes >= 0:
+        out["bytes_accessed"] = float(nbytes)
+    return out or None
+
+
+def compile_fn(fn: Callable, *args, static_argnames=()):
+    """``jax.jit(fn).lower(*args).compile()`` — one compile (or a
+    persistent-cache hit) feeding every probe below."""
+    return jax.jit(fn, static_argnames=static_argnames).lower(*args).compile()
+
+
+def compiled_cost(fn: Callable, *args, static_argnames=()) -> dict | None:
+    """Measured flops / bytes-accessed of the compiled program, or None
+    when the backend's cost analysis is unavailable."""
+    compiled = compile_fn(fn, *args, static_argnames=static_argnames)
+    return cost_of(compiled)
+
+
+def cost_of(compiled) -> dict | None:
+    """:func:`compiled_cost` off an already-compiled executable."""
+    try:
+        return _normalize_cost(compiled.cost_analysis())
+    except Exception:  # cost modeling is advisory; never fail the caller
+        return None
+
+
+def compiled_memory_of(compiled) -> dict:
+    """Buffer-assignment byte totals off an already-compiled executable
+    (field-compatible with ``utils.obs.compiled_memory``)."""
+    try:
+        m = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if m is None:
+        return {}
+    temp = int(m.temp_size_in_bytes)
+    arg = int(m.argument_size_in_bytes)
+    out = int(m.output_size_in_bytes)
+    alias = int(m.alias_size_in_bytes)
+    return {
+        "temp_bytes": temp,
+        "argument_bytes": arg,
+        "output_bytes": out,
+        "alias_bytes": alias,
+        "generated_code_bytes": int(m.generated_code_size_in_bytes),
+        # aliased bytes live in the argument total; don't double-count them
+        "peak_bytes": temp + arg + out - alias,
+    }
+
+
+@contextlib.contextmanager
+def capture_compile_walls():
+    """Scoped compile-wall capture: registers a listener on the same
+    lowering event ``watch_compiles`` uses, yields a list that accumulates
+    each compile's wall seconds (also observed into
+    ``mfm_jit_compile_seconds``), and unregisters on exit.
+
+    An empty list after the block means every executable came from the
+    persistent compile cache — record ``compile_wall_s: None``, not 0.
+    """
+    from jax._src import monitoring
+
+    from mfm_tpu.utils.contracts import _COMPILE_EVENT
+
+    walls: list[float] = []
+
+    def _listener(event: str, duration: float, **kwargs) -> None:
+        if event == _COMPILE_EVENT:
+            walls.append(float(duration))
+            JIT_COMPILE_SECONDS.observe(float(duration))
+
+    monitoring.register_event_duration_secs_listener(_listener)
+    try:
+        yield walls
+    finally:
+        unregister = getattr(
+            monitoring, "_unregister_event_duration_listener_by_callback",
+            None)
+        if unregister is not None:
+            unregister(_listener)
+
+
+def executable_profile(fn: Callable, *args, static_argnames=()) -> dict:
+    """One compile, every probe: measured cost + memory + compile wall,
+    with ``source`` tagging whether the cost figures are measured.
+
+    ``compile_wall_s`` is the summed lowering wall for this call; None
+    when the persistent cache served the executable without compiling.
+    """
+    with capture_compile_walls() as walls:
+        compiled = compile_fn(fn, *args, static_argnames=static_argnames)
+    cost = cost_of(compiled)
+    return {
+        "cost": cost,
+        "memory": compiled_memory_of(compiled),
+        "compile_wall_s": (round(sum(walls), 4) if walls else None),
+        "source": ("cost_analysis" if cost else "static_model"),
+    }

@@ -1,0 +1,329 @@
+"""Request-scoped tracing: spans, a bounded ring buffer, Chrome-trace export.
+
+PR 5's counters say *how much*; this module says *where a specific request
+or run spent its time*.  Zero-dependency (stdlib only), thread-safe, and
+strictly HOST-SIDE like everything under ``mfm_tpu.obs`` (mfmlint R7):
+spans open and close around the jit boundary, never inside it, so tracing
+can never add a compile or a host sync to the fused steps.
+
+Design:
+
+- :func:`span` — context-manager span with monotonic-clock timing and
+  trace/span/parent ids; nesting uses a thread-local stack, so a child
+  opened on the same thread inherits its parent's trace automatically.
+- :func:`start_span` / :func:`end_span` — the explicit pair for async
+  boundaries (a serve request's span opens at admission and closes at
+  response, batches apart), where a context manager cannot bracket the
+  lifetime.
+- A bounded in-memory ring buffer holds finished spans; overflow drops
+  the OLDEST spans and tallies ``mfm_trace_dropped_total`` (a trace that
+  silently forgets is worse than one that admits it).
+- Exporters: :func:`render_chrome_trace` emits Chrome trace-event JSON
+  (Perfetto-loadable ``{"traceEvents": [...]}``, complete "X" events),
+  :func:`parse_chrome_trace` is the schema validator the tests and
+  tooling round-trip through (the Prometheus parse-validator's sibling),
+  :func:`write_chrome_trace` persists atomically (tmp -> fsync -> chaos
+  point -> rename -> dir fsync, like the manifests), and
+  :func:`export_spans_to_events` mirrors spans onto the PR 5 JSONL event
+  stream.
+
+Identifier format follows W3C trace-context sizing: ``trace_id`` is 16
+random bytes hex, ``span_id`` 8 bytes hex — long enough to join across
+manifests, dead letters and responses without coordination.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+
+from mfm_tpu.obs.instrument import TRACE_DROPPED_TOTAL, TRACE_SPANS_TOTAL
+from mfm_tpu.utils.chaos import chaos_point
+
+#: default ring capacity — ~1 MB of spans; a serve storm overflows it by
+#: design (drop-oldest + counted) rather than growing without bound
+DEFAULT_RING_CAPACITY = 4096
+
+#: Chrome trace-event phases the validator accepts (we emit only "X" and
+#: "M", but a hand-edited or foreign trace may carry the rest)
+_CHROME_PHASES = frozenset("XBEiIMCbens")
+
+_enabled = True
+_lock = threading.Lock()
+_ring: collections.deque = collections.deque()
+_capacity = DEFAULT_RING_CAPACITY
+_tls = threading.local()
+
+
+def set_tracing(on: bool) -> None:
+    """Process-wide tracing switch; disabled spans record nothing."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def tracing_enabled() -> bool:
+    return _enabled
+
+
+def set_ring_capacity(n: int) -> None:
+    """Resize the span ring (existing overflow drops oldest, counted)."""
+    global _capacity
+    if int(n) < 1:
+        raise ValueError(f"ring capacity must be >= 1, got {n}")
+    with _lock:
+        _capacity = int(n)
+        _evict_locked()
+
+
+def new_trace_id() -> str:
+    """16 random bytes, hex — W3C trace-context sized."""
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    """8 random bytes, hex."""
+    return os.urandom(8).hex()
+
+
+class Span:
+    """One finished-or-open span.  ``start_us``/``dur_us`` are on the
+    monotonic ``perf_counter`` clock (microseconds) — a consistent
+    process-local timeline, which is all the Chrome trace format needs;
+    ``wall_ts`` is the wall-clock open time for joining to JSONL events."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start_us",
+                 "dur_us", "wall_ts", "tid", "attrs")
+
+    def __init__(self, name, trace_id, span_id, parent_id, start_us,
+                 wall_ts, tid, attrs):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start_us = start_us
+        self.dur_us = None          # None until end_span
+        self.wall_ts = wall_ts
+        self.tid = tid
+        self.attrs = attrs
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def _evict_locked() -> None:
+    dropped = 0
+    while len(_ring) > _capacity:
+        _ring.popleft()
+        dropped += 1
+    if dropped:
+        TRACE_DROPPED_TOTAL.inc(dropped)
+
+
+def start_span(name: str, *, trace_id: str | None = None,
+               parent_id: str | None = None, **attrs) -> Span:
+    """Open a span WITHOUT touching the thread-local nesting stack — the
+    async half of the API (a serve request opens here at admission and
+    :func:`end_span` closes it at response, possibly batches later).
+
+    ``trace_id``/``parent_id`` default to the calling thread's current
+    span when one is open (so an explicit span started under ``span()``
+    still joins its trace), else a fresh trace begins.
+    """
+    st = _stack()
+    cur = st[-1] if st else None
+    if trace_id is None:
+        trace_id = cur.trace_id if cur is not None else new_trace_id()
+    if parent_id is None and cur is not None and cur.trace_id == trace_id:
+        parent_id = cur.span_id
+    return Span(str(name), str(trace_id), new_span_id(), parent_id,
+                time.perf_counter() * 1e6, round(time.time(), 3),
+                threading.get_ident(), dict(attrs))
+
+
+def end_span(sp: Span, **attrs) -> Span:
+    """Close a span: stamp its duration, merge late attrs, push it onto
+    the ring (oldest dropped + counted past capacity).  Idempotence is
+    the caller's job — ending twice records twice."""
+    sp.dur_us = max(0.0, time.perf_counter() * 1e6 - sp.start_us)
+    if attrs:
+        # dict-merge operator, not .update(): the linter's conservative
+        # bare-name call graph would join this to RiskModel.update and mark
+        # every span-closing caller jax-touching
+        sp.attrs |= attrs
+    if not _enabled:
+        return sp
+    TRACE_SPANS_TOTAL.inc()
+    with _lock:
+        _ring.append(sp)
+        _evict_locked()
+    return sp
+
+
+@contextlib.contextmanager
+def span(name: str, *, trace_id: str | None = None,
+         parent_id: str | None = None, **attrs):
+    """Context-manager span: nests via the thread-local stack, so children
+    opened inside inherit this trace; an exception ends the span with an
+    ``error`` attr and propagates."""
+    sp = start_span(name, trace_id=trace_id, parent_id=parent_id, **attrs)
+    st = _stack()
+    st.append(sp)
+    try:
+        yield sp
+    except BaseException as e:
+        end_span(sp, error=f"{type(e).__name__}: {e}"[:500])
+        raise
+    finally:
+        st.pop()
+    end_span(sp)
+
+
+def current_trace_id() -> str | None:
+    """The calling thread's open trace id, if any span is open."""
+    st = _stack()
+    return st[-1].trace_id if st else None
+
+
+def spans() -> list:
+    """Snapshot of the ring's finished spans, oldest first."""
+    with _lock:
+        return list(_ring)
+
+
+def reset_tracing() -> None:
+    """Drop every recorded span and this thread's nesting stack (tests)."""
+    global _capacity
+    with _lock:
+        _ring.clear()
+        _capacity = DEFAULT_RING_CAPACITY
+    _tls.stack = []
+
+
+# -- Chrome trace-event export ------------------------------------------------
+
+def chrome_trace_events(span_list=None) -> list:
+    """The ring (or an explicit span list) as Chrome trace-event dicts:
+    complete ("X") events, µs timestamps, ids and attrs under ``args``."""
+    pid = os.getpid()
+    out = []
+    for s in (spans() if span_list is None else span_list):
+        args = {"trace_id": s.trace_id, "span_id": s.span_id}
+        if s.parent_id:
+            args["parent_id"] = s.parent_id
+        for k in sorted(s.attrs):
+            if k not in args:
+                args[k] = s.attrs[k]
+        out.append({"name": s.name, "cat": "mfm", "ph": "X",
+                    "ts": round(s.start_us, 3),
+                    "dur": round(s.dur_us or 0.0, 3),
+                    "pid": pid, "tid": int(s.tid), "args": args})
+    return out
+
+
+def render_chrome_trace(span_list=None) -> str:
+    """Perfetto-loadable JSON text: ``{"traceEvents": [...]}``."""
+    return json.dumps({"traceEvents": chrome_trace_events(span_list),
+                       "displayTimeUnit": "ms"},
+                      sort_keys=True, default=str)
+
+
+def parse_chrome_trace(text: str) -> list:
+    """Schema-validate Chrome trace-event JSON; returns the event list.
+
+    Accepts both the object form (``{"traceEvents": [...]}``) we emit and
+    the bare-array form Perfetto also loads.  Raises ValueError on
+    anything either consumer would choke on — which is the point: the
+    trace we ship must load.
+    """
+    try:
+        obj = json.loads(text)
+    except ValueError as e:
+        raise ValueError(f"not valid JSON ({e}) — torn trace file?") from e
+    if isinstance(obj, dict):
+        events = obj.get("traceEvents")
+        if not isinstance(events, list):
+            raise ValueError("object form lacks a traceEvents list")
+    elif isinstance(obj, list):
+        events = obj
+    else:
+        raise ValueError(f"trace must be an object or array, got "
+                         f"{type(obj).__name__}")
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            raise ValueError(f"{where}: event is not an object")
+        ph = ev.get("ph")
+        if not isinstance(ph, str) or ph not in _CHROME_PHASES:
+            raise ValueError(f"{where}: bad phase {ph!r}")
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            raise ValueError(f"{where}: missing/empty name")
+        if ph != "M":        # metadata events carry no timestamp
+            ts = ev.get("ts")
+            if not isinstance(ts, (int, float)) or ts < 0:
+                raise ValueError(f"{where}: bad ts {ts!r}")
+        for key in ("pid", "tid"):
+            if not isinstance(ev.get(key), int):
+                raise ValueError(f"{where}: {key} must be an int, got "
+                                 f"{ev.get(key)!r}")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                raise ValueError(f"{where}: complete event needs dur >= 0, "
+                                 f"got {dur!r}")
+        if "args" in ev and not isinstance(ev["args"], dict):
+            raise ValueError(f"{where}: args must be an object")
+    return events
+
+
+def write_chrome_trace(path: str, span_list=None) -> str:
+    """Atomic trace flush (tmp -> fsync -> chaos point -> rename -> dir
+    fsync), same discipline as the manifests — a SIGKILL mid-flush must
+    never leave a torn trace file.  Returns the final path."""
+    text = render_chrome_trace(span_list)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(text)
+            fh.write("\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    chaos_point("trace.after_tmp", path)
+    os.replace(tmp, path)
+    try:
+        fd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:  # pragma: no cover - exotic filesystems
+        pass
+    return path
+
+
+def export_spans_to_events(span_list=None, level: str = "info") -> int:
+    """Mirror spans onto the JSONL event stream (one ``span`` event each,
+    routed wherever ``route_events_to`` points).  Returns the count."""
+    from mfm_tpu.obs.exporters import emit_event
+
+    sl = spans() if span_list is None else span_list
+    for s in sl:
+        emit_event(level, "span", name=s.name, trace_id=s.trace_id,
+                   span_id=s.span_id, parent_id=s.parent_id,
+                   dur_s=round((s.dur_us or 0.0) / 1e6, 6),
+                   **{f"attr_{k}": v for k, v in sorted(s.attrs.items())})
+    return len(sl)

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import hashlib
 import json
 import time
 from typing import Callable
@@ -40,6 +41,7 @@ from typing import Callable
 import numpy as np
 
 from mfm_tpu.obs import instrument as _obs
+from mfm_tpu.obs import trace as _trace
 from mfm_tpu.serve._checks import combine_reason_bits, mad_outlier_cells, \
     names_of_mask
 from mfm_tpu.utils.chaos import chaos_point
@@ -197,15 +199,27 @@ class CircuitBreaker:
 
 
 class _Request:
-    __slots__ = ("rid", "weights", "bidx", "enq_t", "deadline_t", "scenario")
+    __slots__ = ("rid", "weights", "bidx", "enq_t", "deadline_t", "scenario",
+                 "trace_id", "span")
 
-    def __init__(self, rid, weights, bidx, enq_t, deadline_t, scenario=None):
+    def __init__(self, rid, weights, bidx, enq_t, deadline_t, scenario=None,
+                 trace_id=None, span=None):
         self.rid = rid
         self.weights = weights
         self.bidx = bidx
         self.enq_t = enq_t
         self.deadline_t = deadline_t
         self.scenario = scenario
+        self.trace_id = trace_id
+        self.span = span
+
+
+def _line_trace_id(line: str) -> str:
+    """Host-generated trace id for a request that didn't bring one:
+    derived from the request BYTES, not os.urandom, so a replayed stream
+    reuses the same ids and the chaos plans' bitwise-prefix contract on
+    the response stream survives tracing."""
+    return hashlib.sha256(line.encode("utf-8", "replace")).hexdigest()[:32]
 
 
 def parse_request(line: str, engine, policy: ServePolicy, scenarios=None):
@@ -213,12 +227,14 @@ def parse_request(line: str, engine, policy: ServePolicy, scenarios=None):
 
     Returns ``(fields_or_None, reason_mask, detail)``: a zero mask means
     the request is admissible and ``fields`` is ``(rid, weights (D,)
-    float, bidx int, deadline_s float, scenario str|None)``; a nonzero
-    mask means dead-letter (``detail`` says what tripped, ``rid`` may
-    still be recoverable and is returned inside ``detail``-bearing fields
-    as None).  ``scenarios``: the served scenario table (names only are
-    consulted); a ``scenario`` tag outside it — including ANY tag when no
-    table is served — is ``unknown_scenario``.
+    float, bidx int, deadline_s float, scenario str|None, trace_id
+    str|None)``; a nonzero mask means dead-letter (``detail`` says what
+    tripped, ``rid`` may still be recoverable and is returned inside
+    ``detail``-bearing fields as None).  ``trace_id`` is the caller's own
+    when the request JSON carries one, else None (the server derives a
+    deterministic one at admission).  ``scenarios``: the served scenario
+    table (names only are consulted); a ``scenario`` tag outside it —
+    including ANY tag when no table is served — is ``unknown_scenario``.
     """
     mask = 0
     rid = None
@@ -232,9 +248,12 @@ def parse_request(line: str, engine, policy: ServePolicy, scenarios=None):
     scenario = obj.get("scenario")
     if scenario is not None:
         scenario = str(scenario)
+    trace_id = obj.get("trace_id")
+    if trace_id is not None:
+        trace_id = str(trace_id)
     raw_w = obj.get("weights")
     if raw_w is None:
-        return (rid, None, 0, 0.0, scenario), REQ_REASON_SCHEMA, \
+        return (rid, None, 0, 0.0, scenario, trace_id), REQ_REASON_SCHEMA, \
             "missing 'weights'"
 
     detail = ""
@@ -250,7 +269,8 @@ def parse_request(line: str, engine, policy: ServePolicy, scenarios=None):
                  else engine.factor_names if engine.space == "factor"
                  else None)
         if names is None:
-            return (rid, None, 0, 0.0, scenario), REQ_REASON_SCHEMA, \
+            return (rid, None, 0, 0.0, scenario, trace_id), \
+                REQ_REASON_SCHEMA, \
                 "dict weights need a named axis (engine has no stock ids)"
         index = (engine.factor_index if engine.space == "factor"
                  else {n: i for i, n in enumerate(names)})
@@ -317,7 +337,7 @@ def parse_request(line: str, engine, policy: ServePolicy, scenarios=None):
         mask |= REQ_REASON_SCHEMA
         detail = detail or f"bad deadline_s {obj.get('deadline_s')!r}"
         deadline_s = policy.default_deadline_s
-    return (rid, w, bidx, deadline_s, scenario), int(mask), detail
+    return (rid, w, bidx, deadline_s, scenario, trace_id), int(mask), detail
 
 
 class QueryServer:
@@ -365,13 +385,14 @@ class QueryServer:
 
     # -- degraded serving ----------------------------------------------------
     def _stamp(self, resp: dict, scenario_id: str | None = None,
-               engine=None) -> dict:
+               engine=None, trace_id: str | None = None) -> dict:
         eng = engine if engine is not None else self.engine
         resp["scenario_id"] = scenario_id
         resp["staleness"] = int(eng.staleness)
         resp["health"] = self.health
         resp["degraded"] = bool(eng.staleness > 0
                                 or self.health != "ok")
+        resp["trace_id"] = trace_id
         return resp
 
     def swap(self, engine=None, health: str | None = None) -> None:
@@ -428,23 +449,32 @@ class QueryServer:
             return [self._stamp({
                 "id": _peek_id(line), "ok": False, "outcome": "rejected",
                 "retry_after_s": round(self.breaker.retry_after(), 3),
-                "breaker": self.breaker.open_reason or "open"})]
+                "breaker": self.breaker.open_reason or "open"},
+                trace_id=_peek_trace_id(line) or _line_trace_id(line))]
         fields, mask, detail = parse_request(line, self.engine, self.policy,
                                              scenarios=self.scenarios)
         if mask:
             rid = fields[0] if fields else None
             scen = fields[4] if fields else None
+            tid = (fields[5] if fields else None) or _line_trace_id(line)
             self._dead_letter(rid, mask, detail, line,
-                              extra={"scenario_id": scen})
+                              extra={"scenario_id": scen, "trace_id": tid})
             _obs.record_query_outcome("dead_letter")
             return [self._stamp({"id": rid, "ok": False,
                                  "outcome": "dead_letter",
                                  "reasons": req_reason_names(mask),
-                                 "detail": detail}, scenario_id=scen)]
-        rid, w, bidx, deadline_s, scen = fields
+                                 "detail": detail}, scenario_id=scen,
+                                trace_id=tid)]
+        rid, w, bidx, deadline_s, scen, tid = fields
+        if tid is None:
+            tid = _line_trace_id(line)
         now = self._clock()
+        # request span opens at admission and ends with the final outcome
+        # (possibly batches later) — the explicit start/end half of the API
+        sp = _trace.start_span("serve.request", trace_id=tid, parent_id=None,
+                               request_id=rid, scenario=scen)
         self._queue.append(_Request(rid, w, bidx, now, now + deadline_s,
-                                    scenario=scen))
+                                    scenario=scen, trace_id=tid, span=sp))
         # bounded queue: shedding drops the OLDEST queued work first —
         # under overload the head of the queue is the request whose
         # deadline is nearest death; the freshest work is the most useful
@@ -452,9 +482,12 @@ class QueryServer:
             old = self._queue.popleft()
             _obs.record_shed()
             _obs.record_query_outcome("shed")
+            if old.span is not None:
+                _trace.end_span(old.span, outcome="shed")
             out.append(self._stamp({"id": old.rid, "ok": False,
                                     "outcome": "shed"},
-                                   scenario_id=old.scenario))
+                                   scenario_id=old.scenario,
+                                   trace_id=old.trace_id))
         _obs.record_queue_depth(len(self._queue))
         return out
 
@@ -477,9 +510,12 @@ class QueryServer:
         for r in taken:
             if now > r.deadline_t:
                 _obs.record_query_outcome("deadline")
+                if r.span is not None:
+                    _trace.end_span(r.span, outcome="deadline")
                 out.append(self._stamp({"id": r.rid, "ok": False,
                                         "outcome": "deadline"},
-                                       scenario_id=r.scenario))
+                                       scenario_id=r.scenario,
+                                       trace_id=r.trace_id))
             else:
                 live.append(r)
         if not live:
@@ -489,11 +525,13 @@ class QueryServer:
             # failed reload / degraded health): reject the queued work
             for r in live:
                 _obs.record_query_outcome("rejected")
+                if r.span is not None:
+                    _trace.end_span(r.span, outcome="rejected")
                 out.append(self._stamp({
                     "id": r.rid, "ok": False, "outcome": "rejected",
                     "retry_after_s": round(self.breaker.retry_after(), 3),
                     "breaker": self.breaker.open_reason or "open"},
-                    scenario_id=r.scenario))
+                    scenario_id=r.scenario, trace_id=r.trace_id))
             return out
         # group by scenario tag, first-appearance order: the None group is
         # the exact pre-scenario path (one stack, one engine.query) so
@@ -508,32 +546,51 @@ class QueryServer:
                 # table swapped between admission and drain
                 for r in grp:
                     _obs.record_query_outcome("error")
+                    if r.span is not None:
+                        _trace.end_span(r.span, outcome="error")
                     out.append(self._stamp(
                         {"id": r.rid, "ok": False, "outcome": "error",
                          "detail": f"scenario {scen!r} no longer served"},
-                        scenario_id=scen))
+                        scenario_id=scen, trace_id=r.trace_id))
                 continue
             W = np.stack([r.weights for r in grp]).astype(engine.dtype)
             bench = [r.bidx for r in grp]
+            # batch-execution child span: joins the first member's trace as
+            # a child of its request span; every member's trace_id rides in
+            # args (capped) so any slow request can be joined to its batch
+            head = grp[0]
+            bsp = _trace.start_span(
+                "serve.batch", trace_id=head.trace_id,
+                parent_id=(head.span.span_id if head.span else None),
+                batch=self._batch_i, scenario=scen, n=len(grp),
+                trace_ids=[r.trace_id for r in grp[:32]])
             t0 = time.perf_counter()
             try:
                 res = engine.query(W, bench=bench)
             except Exception as e:   # noqa: BLE001 — any batch failure trips
+                _trace.end_span(bsp, outcome="error")
                 self.breaker.record_failure()
                 for r in grp:
                     _obs.record_query_outcome("error")
+                    if r.span is not None:
+                        _trace.end_span(r.span, outcome="error")
                     out.append(self._stamp({"id": r.rid, "ok": False,
                                             "outcome": "error",
                                             "detail": str(e)[:500]},
-                                           scenario_id=scen, engine=engine))
+                                           scenario_id=scen, engine=engine,
+                                           trace_id=r.trace_id))
                 continue
             dt = time.perf_counter() - t0
+            _trace.end_span(bsp, outcome="ok")
             self.breaker.record_success()
             _obs.record_query_batch(len(grp), dt)
             done = self._clock()
             for i, r in enumerate(grp):
                 _obs.record_query_outcome("ok")
                 _obs.record_query_latency(max(0.0, done - r.enq_t))
+                if r.span is not None:
+                    _trace.end_span(r.span, outcome="ok",
+                                    batch=self._batch_i)
                 resp = {"id": r.rid, "ok": True, "outcome": "ok",
                         "total_vol": float(res.total_vol[i]),
                         "factor_var": float(res.factor_var[i]),
@@ -545,7 +602,7 @@ class QueryServer:
                     resp["active_risk"] = float(res.active_risk[i])
                     resp["beta"] = float(res.beta[i])
                 out.append(self._stamp(resp, scenario_id=scen,
-                                       engine=engine))
+                                       engine=engine, trace_id=r.trace_id))
         chaos_point("serve.after_batch", f"batch{self._batch_i}")
         self._batch_i += 1
         return out
@@ -595,3 +652,13 @@ def _peek_id(line: str):
         return obj.get("id") if isinstance(obj, dict) else None
     except (ValueError, TypeError):
         return None
+
+
+def _peek_trace_id(line: str):
+    """Best-effort caller trace id off a line we're rejecting unparsed."""
+    try:
+        obj = json.loads(line)
+    except (ValueError, TypeError):
+        return None
+    tid = obj.get("trace_id") if isinstance(obj, dict) else None
+    return str(tid) if tid is not None else None

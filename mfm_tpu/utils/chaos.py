@@ -52,6 +52,8 @@ CRASH_POINTS = (
                                    # (serve/server.py)
     "scenario_manifest.after_tmp",  # scenario batch computed, manifest tmp
                                     # not yet renamed (scenario/manifest.py)
+    "trace.after_tmp",             # Chrome-trace flush: tmp durable, final
+                                   # trace.json not yet renamed (obs/trace.py)
 )
 
 
@@ -203,7 +205,7 @@ class FaultPlan:
                      # outlier_slab | universe_slab | flaky_store |
                      # query_kill | query_poison | query_overflow |
                      # query_swap | query_steady | scenario_kill |
-                     # scenario_poison
+                     # scenario_poison | trace_kill
     seed: int = 0
     params: tuple = ()   # ((key, value), ...) — hashable, printable
 
@@ -255,4 +257,8 @@ def plan_suite(seed: int = 0) -> tuple:
                   (("point", "scenario_manifest.after_tmp"),)),
         FaultPlan("scenario-poison-spec", "scenario_poison", s + 17,
                   (("n_poison", 3),)),
+        # tracing: SIGKILL mid trace-flush must leave no torn trace file
+        # and an untouched (bitwise) checkpoint (obs/trace.py)
+        FaultPlan("trace-kill-mid-flush", "trace_kill", s + 18,
+                  (("point", "trace.after_tmp"),)),
     )

@@ -192,7 +192,8 @@ def test_plan_suite_is_deterministic():
                                    "flaky_store", "query_kill",
                                    "query_poison", "query_overflow",
                                    "query_swap", "query_steady",
-                                   "scenario_kill", "scenario_poison"}
+                                   "scenario_kill", "scenario_poison",
+                                   "trace_kill"}
     assert len({p.seed for p in a}) == len(a)
 
 

@@ -275,6 +275,60 @@ def test_r7_telemetry_in_traced_code(tmp_path):
     assert got == [("R7", "bad"), ("R7", "bad"), ("R7", "helper")]
 
 
+def test_r7_trace_and_profile_in_traced_code(tmp_path):
+    """The tracing/profiling additions (obs/trace.py, obs/profile.py) are
+    telemetry like the rest of mfm_tpu.obs: a span opened or a profile
+    pulled inside traced code is R7; the same calls bracketing the jit
+    boundary from the host — and from the host-only serving loop — are
+    clean."""
+    res = _lint(tmp_path, {
+        "mfm_tpu/obs/trace.py": """
+            def start_span(name, **attrs):
+                return object()
+
+            def end_span(sp, **attrs):
+                return sp
+        """,
+        "mfm_tpu/obs/profile.py": """
+            def executable_profile(fn, *args):
+                return {}
+        """,
+        "mfm_tpu/model.py": """
+            import jax
+            import jax.numpy as jnp
+            from mfm_tpu.obs import profile
+            from mfm_tpu.obs.trace import end_span, start_span
+
+            def stepper(x):
+                sp = start_span("inner")            # traced-reachable: R7
+                y = x * 2
+                end_span(sp)                        # traced-reachable: R7
+                return y
+
+            @jax.jit
+            def bad(x):
+                profile.executable_profile(None)    # R7: obs.profile
+                return jnp.sum(stepper(x))
+
+            def host(x):
+                sp = start_span("update")           # host side: fine
+                y = bad(x)
+                end_span(sp)
+                profile.executable_profile(bad, x)  # host side: fine
+                return y
+        """,
+        "mfm_tpu/serve/server.py": """
+            from mfm_tpu.obs.trace import end_span, start_span
+
+            class QueryServer:
+                def drain(self):
+                    sp = start_span("serve.batch")  # host-only module: fine
+                    return end_span(sp, outcome="ok")
+        """})
+    got = sorted((v.rule, v.qualname) for v in res.new)
+    assert got == [("R7", "bad"), ("R7", "stepper"), ("R7", "stepper")]
+
+
 def test_r7_scenario_host_only_barrier(tmp_path):
     """mfm_tpu.scenario.engine / .manifest are host-only: their obs calls
     and IO are never R7, and ``ScenarioEngine.run``'s bare-name collision

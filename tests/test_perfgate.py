@@ -1,0 +1,149 @@
+"""The perf-regression sentinel (tools/perfgate.py): metric extraction,
+same-backend baseline selection, tolerance bands, the overhead floor, and
+the non-zero exit on an injected synthetic regression."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import perfgate  # noqa: E402
+
+
+def _risk_rec(value, backend="cpu", **extra):
+    rec = {"metric": "csi300_riskmodel_e2e_wall", "value": value,
+           "backend": backend}
+    rec.update(extra)
+    return rec
+
+
+def _write_traj(d, *recs):
+    for i, rec in enumerate(recs, 1):
+        with open(os.path.join(d, f"BENCH_r{i:02d}.json"), "w") as fh:
+            json.dump({"n": i, "rc": 0, "parsed": rec}, fh)
+
+
+def test_extract_metrics_per_config():
+    m = perfgate.extract_metrics(_risk_rec(
+        12.5, daily_update_latency_s=0.04, telemetry_overhead_frac=0.001,
+        tracing_overhead_frac=0.0008))
+    assert m == {"e2e_wall_s": 12.5, "daily_update_latency_s": 0.04,
+                 "telemetry_overhead_frac": 0.001,
+                 "tracing_overhead_frac": 0.0008}
+    assert perfgate.extract_metrics(
+        {"metric": "portfolio_query_throughput", "value": 9000}) == \
+        {"portfolios_per_sec": 9000}
+    assert perfgate.extract_metrics(
+        {"metric": "scenario_throughput", "value": 400}) == \
+        {"scenarios_per_sec": 400}
+    # failed rounds (value null) and junk extract to nothing
+    assert perfgate.extract_metrics(_risk_rec(None)) == {}
+    assert perfgate.extract_metrics("nope") == {}
+
+
+def test_gate_passes_within_band_and_fails_past_it(tmp_path):
+    _write_traj(str(tmp_path), _risk_rec(10.0), _risk_rec(11.0))
+    traj = perfgate.load_trajectory(str(tmp_path))
+    assert [t["name"] for t in traj] == ["BENCH_r01.json", "BENCH_r02.json"]
+
+    ok = perfgate.gate_record(_risk_rec(12.0), traj)   # 10.0 * 1.25 = 12.5
+    assert ok["regressions"] == []
+    (check,) = ok["checks"]
+    assert check["baseline"] == 10.0 and check["baseline_run"] == \
+        "BENCH_r01.json"
+
+    bad = perfgate.gate_record(_risk_rec(13.0), traj)
+    assert [c["metric"] for c in bad["regressions"]] == ["e2e_wall_s"]
+    # a widened band clears it
+    assert perfgate.gate_record(_risk_rec(13.0), traj,
+                                tolerances={"e2e_wall_s": 0.5})[
+        "regressions"] == []
+
+
+def test_higher_is_better_direction(tmp_path):
+    _write_traj(str(tmp_path),
+                {"metric": "portfolio_query_throughput", "value": 10000,
+                 "backend": "cpu"})
+    traj = perfgate.load_trajectory(str(tmp_path))
+    cur = {"metric": "portfolio_query_throughput", "value": 7000,
+           "backend": "cpu"}                     # 10000 * 0.8 = 8000 floor
+    assert perfgate.gate_record(cur, traj)["regressions"]
+    cur["value"] = 8500
+    assert perfgate.gate_record(cur, traj)["regressions"] == []
+
+
+def test_cross_backend_records_never_compare(tmp_path):
+    _write_traj(str(tmp_path), _risk_rec(1.0, backend="tpu"))
+    verdict = perfgate.gate_record(_risk_rec(50.0, backend="cpu"),
+                                   perfgate.load_trajectory(str(tmp_path)))
+    assert verdict["checks"] == [] and verdict["regressions"] == []
+    assert any("baseline" in s["reason"] for s in verdict["skipped"])
+
+
+def test_overhead_floor_suppresses_sub_budget_jitter(tmp_path):
+    _write_traj(str(tmp_path), _risk_rec(
+        10.0, telemetry_overhead_frac=0.0002, tracing_overhead_frac=0.0002))
+    traj = perfgate.load_trajectory(str(tmp_path))
+    # 4x the baseline fraction but far under the 1% budget: not a regression
+    ok = perfgate.gate_record(_risk_rec(
+        10.0, telemetry_overhead_frac=0.0008, tracing_overhead_frac=0.0008),
+        traj)
+    assert ok["regressions"] == []
+    # past the band AND past the budget: caught
+    bad = perfgate.gate_record(_risk_rec(
+        10.0, tracing_overhead_frac=0.02), traj)
+    assert [c["metric"] for c in bad["regressions"]] == \
+        ["tracing_overhead_frac"]
+
+
+def test_unreadable_trajectory_files_are_skipped(tmp_path):
+    _write_traj(str(tmp_path), _risk_rec(10.0))
+    with open(os.path.join(str(tmp_path), "BENCH_r99.json"), "w") as fh:
+        fh.write('{"torn')
+    traj = perfgate.load_trajectory(str(tmp_path))
+    assert [t["name"] for t in traj] == ["BENCH_r01.json"]
+
+
+@pytest.mark.slow
+def test_cli_exits_nonzero_on_injected_regression(tmp_path):
+    """The acceptance drill: a synthetic slowdown against a synthetic
+    trajectory makes ``perfgate`` (and therefore ``bench.py --compare`` and
+    ``tools/bench_all.sh``) exit non-zero."""
+    d = str(tmp_path)
+    _write_traj(d, _risk_rec(10.0, daily_update_latency_s=0.05))
+    cur = os.path.join(d, "current.json")
+
+    def run(rec, *extra):
+        with open(cur, "w") as fh:
+            json.dump(rec, fh)
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "perfgate.py"),
+             cur, "--root", d, *extra],
+            capture_output=True, text=True, timeout=120)
+
+    good = run(_risk_rec(10.4, daily_update_latency_s=0.051))
+    assert good.returncode == 0, good.stdout + good.stderr
+    assert "PASS" in good.stdout
+
+    bad = run(_risk_rec(20.0, daily_update_latency_s=0.2))
+    assert bad.returncode == 1
+    assert "REGRESSED" in bad.stdout and "FAIL" in bad.stdout
+
+    # per-metric overrides rescue a deliberate trade-off
+    widened = run(_risk_rec(20.0, daily_update_latency_s=0.2),
+                  "--tol", "e2e_wall_s=1.5", "--tol",
+                  "daily_update_latency_s=4.0")
+    assert widened.returncode == 0, widened.stdout
+
+    # a non-record input is a usage error (rc 2), not a pass
+    with open(cur, "w") as fh:
+        json.dump({"hello": 1}, fh)
+    assert subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perfgate.py"), cur,
+         "--root", d], capture_output=True, text=True,
+        timeout=120).returncode == 2

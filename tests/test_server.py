@@ -91,9 +91,9 @@ def test_parse_request_dict_weights_and_benchmark():
                 deadline_s=2.5)
     fields, mask, _ = parse_request(line, _engine(), ServePolicy())
     assert mask == 0
-    rid, w, bidx, deadline_s, scenario = fields
+    rid, w, bidx, deadline_s, scenario, trace_id = fields
     assert rid == "x" and bidx == 1 and deadline_s == 2.5
-    assert scenario is None
+    assert scenario is None and trace_id is None
     np.testing.assert_array_equal(w, [0.0, 0.0, 0.7, 0.3])
 
 
@@ -375,3 +375,113 @@ def test_doctor_serve_audit(tmp_path, capsys):
     assert rec["status"] == "unhealthy"
     assert any("OPEN at shutdown" in p for p in rec["problems"])
     assert any("shedding" in w for w in rec["warnings"])
+
+
+def test_doctor_warns_when_serve_manifest_lacks_trace_id(tmp_path, capsys):
+    from mfm_tpu.data.artifacts import save_artifact
+    from mfm_tpu.obs.manifest import build_run_manifest, write_run_manifest
+
+    d = str(tmp_path)
+    save_artifact(os.path.join(d, "x.npz"), {"a": np.zeros(2)})
+    block = {"breaker_state": "closed", "breaker_open_total": 0,
+             "shed_total": 0, "shed_rate": 0.0, "requests_total": 5}
+    # a pre-tracing manifest (no root trace_id): healthy, but warned —
+    # the run cannot be joined to its trace
+    _write_serve_manifest(d, block)
+    assert _doctor_rc([d, "--serve"]) == 0
+    rec = [r for r in json.loads(capsys.readouterr().out)["records"]
+           if r["kind"] == "serve_manifest"][0]
+    assert any("trace_id" in w for w in rec["warnings"])
+    # with the root trace_id stamped the warning disappears
+    write_run_manifest(
+        os.path.join(d, "serve_manifest.json"),
+        build_run_manifest(backend="cpu",
+                           health={"status": "ok", "checks": {}},
+                           extra={"serve": block, "trace_id": "a" * 32}))
+    assert _doctor_rc([d, "--serve"]) == 0
+    rec = [r for r in json.loads(capsys.readouterr().out)["records"]
+           if r["kind"] == "serve_manifest"][0]
+    assert not any("trace_id" in w for w in rec["warnings"])
+
+
+# -- trace propagation --------------------------------------------------------
+
+def test_supplied_trace_id_round_trips_and_spans_link():
+    from mfm_tpu.obs import trace as _trace
+
+    _trace.reset_tracing()
+    try:
+        server = QueryServer(_engine(), ServePolicy(default_deadline_s=60.0),
+                             health="ok")
+        tid = "t" * 32
+        server.submit_line(_req("q1", trace_id=tid))
+        resp, = server.drain()
+        assert resp["trace_id"] == tid
+        got = {s.name: s for s in _trace.spans()}
+        req_sp, batch_sp = got["serve.request"], got["serve.batch"]
+        assert req_sp.trace_id == tid and batch_sp.trace_id == tid
+        assert batch_sp.parent_id == req_sp.span_id
+        assert req_sp.attrs["request_id"] == "q1"
+        assert req_sp.attrs["outcome"] == "ok"
+        assert batch_sp.attrs["n"] == 1
+    finally:
+        _trace.reset_tracing()
+
+
+def test_generated_trace_id_is_derived_from_request_bytes():
+    from mfm_tpu.serve.server import _line_trace_id
+
+    line = _req("q1")
+    ids = []
+    for _ in range(2):                    # two fresh servers, same bytes
+        server = QueryServer(_engine(), ServePolicy(default_deadline_s=60.0),
+                             health="ok")
+        server.submit_line(line)
+        resp, = server.drain()
+        ids.append(resp["trace_id"])
+    assert ids[0] == ids[1] == _line_trace_id(line)
+    assert len(ids[0]) == 32
+
+
+def test_dead_letter_and_reject_carry_trace_id(tmp_path):
+    from mfm_tpu.serve.server import _line_trace_id
+
+    dl = str(tmp_path / "dead.jsonl")
+    server = QueryServer(_engine(), ServePolicy(), health="ok",
+                         dead_letter_path=dl)
+    resp, = server.submit_line(_req("bad", w=[1.0], trace_id="d" * 32))
+    assert resp["trace_id"] == "d" * 32
+    line2 = _req("bad2", w=[1.0])
+    resp2, = server.submit_line(line2)
+    assert resp2["trace_id"] == _line_trace_id(line2)
+    server.close()
+    recs = {r["id"]: r for r in map(json.loads, open(dl))}
+    assert recs["bad"]["trace_id"] == "d" * 32
+    assert recs["bad2"]["trace_id"] == _line_trace_id(line2)
+    # breaker rejection (degraded health) stamps the id too
+    deg = QueryServer(_engine(staleness=3), ServePolicy(), health="degraded")
+    rej, = deg.submit_line(_req("r1", trace_id="e" * 32))
+    assert rej["outcome"] == "rejected" and rej["trace_id"] == "e" * 32
+
+
+def test_shed_and_deadline_outcomes_keep_trace_ids():
+    from mfm_tpu.obs import trace as _trace
+
+    _trace.reset_tracing()
+    try:
+        policy = ServePolicy(queue_max=2, batch_max=2,
+                             default_deadline_s=60.0)
+        server = QueryServer(_engine(), policy, health="ok")
+        buf = io.StringIO()
+        server.run(iter([_req(f"q{i}") for i in range(4)]), buf, gulp=True)
+        resps = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+        assert {r["outcome"] for r in resps} == {"shed", "ok"}
+        assert all(len(r["trace_id"]) == 32 for r in resps)
+        by_outcome = {}
+        for s in _trace.spans():
+            if s.name == "serve.request":
+                by_outcome.setdefault(s.attrs.get("outcome"), []).append(s)
+        assert len(by_outcome["shed"]) == 2
+        assert len(by_outcome["ok"]) == 2
+    finally:
+        _trace.reset_tracing()

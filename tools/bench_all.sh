@@ -42,14 +42,26 @@ python bench.py --config alpha   "${plat[@]}" | tail -1 > "$out/config5_alpha.js
 python bench.py --config query   "${plat[@]}" | tail -1 > "$out/config6_query.json"
 python bench.py --config scenario "${plat[@]}" | tail -1 > "$out/config7_scenario.json"
 
+# perf-regression sentinel: gate the fresh records against the committed
+# BENCH_r*.json trajectory (tools/perfgate.py; per-metric tolerance bands,
+# same-backend baselines only).  A regression fails the sweep — slower
+# numbers are a finding, not evidence to file.
+for rec in "$out/config1_risk.json" "$out/config6_query.json" \
+           "$out/config7_scenario.json"; do
+  python tools/perfgate.py "$rec" \
+    || { echo "perfgate: $rec regressed vs the BENCH_r*.json trajectory" >&2
+         exit 1; }
+done
+
 # the query-service and scenario numbers are only evidence if the services
 # actually recover: gate configs 6+7 on their chaos plans (bitwise restart
 # recovery, dead-letter quarantine, shed ordering, breaker-on-corrupt-swap,
 # the <=1-compile-per-bucket steady state, scenario-manifest crash
-# atomicity, and per-lane poison isolation)
+# atomicity, per-lane poison isolation, and trace-flush crash atomicity —
+# a SIGKILL mid trace.json flush must tear neither trace nor checkpoint)
 python tools/faultinject.py --plans \
-  query-kill-mid-batch,query-poison-slab,query-overflow-storm,query-ckpt-swap,query-steady-state,scenario-kill-mid-batch,scenario-poison-spec \
-  || { echo "query/scenario chaos plans failed — config6/7 numbers are not evidence" >&2
+  query-kill-mid-batch,query-poison-slab,query-overflow-storm,query-ckpt-swap,query-steady-state,scenario-kill-mid-batch,scenario-poison-spec,trace-kill-mid-flush \
+  || { echo "query/scenario/trace chaos plans failed — config6/7 numbers are not evidence" >&2
        exit 1; }
 
 cat "$out"/config*.json

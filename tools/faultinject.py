@@ -361,6 +361,85 @@ def run_kill_manifest(plan, base: Baseline, root: str) -> dict:
             "recovered_manifest_health": man["health"]["status"]}
 
 
+def run_trace_kill(plan, base: Baseline, root: str) -> dict:
+    """trace-kill-mid-flush: SIGKILL between the Chrome-trace tmp write and
+    its rename — the trace is the LAST artifact a ``--metrics-dir`` run
+    flushes, so the checkpoint and manifest (fenced before it) must be
+    untouched (carries bitwise, next slab bitwise), no torn trace.json may
+    exist, and a clean rerun must leave a parseable trace plus a
+    doctor-green directory."""
+    from mfm_tpu.data.artifacts import load_risk_state
+    from mfm_tpu.obs.manifest import read_run_manifest
+    from mfm_tpu.obs.trace import parse_chrome_trace
+
+    point = plan.param("point")
+    d = _fresh_workdir(root, plan.name, base.snaps[0])
+    path = os.path.join(d, "state.npz")
+    mdir = os.path.join(d, "metrics")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo_root}
+
+    def _update_cmd(slab_csv, table):
+        table.to_csv(slab_csv, index=False)
+        return [sys.executable, "-m", "mfm_tpu.cli", "risk",
+                "--barra", slab_csv, "--update", path, "--quarantine",
+                "--eigen-sims", str(EIGEN_SIMS),
+                "--eigen-sim-length", str(T_TOTAL),
+                "--metrics-dir", mdir,
+                "--out", os.path.join(d, "tables")]
+
+    cmd = _update_cmd(os.path.join(d, "slab0.csv"), base.slabs[0])
+    proc = subprocess.run(cmd, env={**env, "MFM_CHAOS_KILL": point},
+                          capture_output=True, text=True, timeout=600)
+    if proc.returncode != -signal.SIGKILL:
+        raise AssertionError(
+            f"{plan.name}: expected the subprocess to die by SIGKILL at "
+            f"{point}, got rc={proc.returncode}\n{proc.stderr[-2000:]}")
+    trace_path = os.path.join(mdir, "trace.json")
+    if os.path.exists(trace_path):
+        raise AssertionError(f"{plan.name}: a trace.json exists despite the "
+                             "kill before its rename — the flush is not "
+                             "tmp-then-rename atomic")
+    # the checkpoint and manifest were fenced BEFORE the trace flush: the
+    # slab must be carried, replay must be bitwise, the manifest must read
+    # cleanly and already carry its root trace_id
+    state, meta = load_risk_state(path)
+    if meta["last_date"] != base.slab_dates[0][-1]:
+        raise AssertionError(f"{plan.name}: checkpoint does not carry the "
+                             "appended dates — trace kill corrupted it")
+    _assert_carries_equal(_carries(state), base.carries[0],
+                          f"{plan.name} (subprocess checkpoint)")
+    man = read_run_manifest(os.path.join(d, "run_manifest.json"))
+    if not man.get("trace_id"):
+        raise AssertionError(f"{plan.name}: manifest fenced before the "
+                             "trace flush carries no root trace_id")
+    res = _append(path, base.slabs[1], base.cfg)
+    _assert_outputs_equal(_outputs_by_date(res), base.outputs[1],
+                          base.slab_dates[1], plan.name)
+    # a clean rerun must flush a parseable, Perfetto-loadable trace
+    cmd2 = _update_cmd(os.path.join(d, "slab2.csv"), base.slabs[2])
+    proc2 = subprocess.run(cmd2, env=env, capture_output=True, text=True,
+                           timeout=600)
+    if proc2.returncode != 0:
+        raise AssertionError(f"{plan.name}: post-crash update failed "
+                             f"rc={proc2.returncode}\n{proc2.stderr[-2000:]}")
+    with open(trace_path, encoding="utf-8") as fh:
+        text = fh.read()
+    try:
+        events = parse_chrome_trace(text)
+    except ValueError as err:
+        raise AssertionError(f"{plan.name}: recovered trace.json fails the "
+                             f"schema check: {err}")
+    doc = subprocess.run([sys.executable, "-m", "mfm_tpu.cli", "doctor", d],
+                         env=env, capture_output=True, text=True, timeout=600)
+    if doc.returncode != 0:
+        raise AssertionError(f"{plan.name}: doctor rejects the post-crash "
+                             f"state\n{doc.stdout[-2000:]}")
+    return {"killed_at": point, "trace_after_crash": "absent",
+            "recovered_trace_events": len(events),
+            "manifest_trace_id": man["trace_id"]}
+
+
 _POISON_OK_REASONS = {
     # NaN returns are dropped by the frame->arrays conversion, so a
     # NaN-poisoned CSV date manifests as universe collapse downstream of
@@ -960,7 +1039,8 @@ RUNNERS = {"truncate": run_byte_fault, "corrupt": run_byte_fault,
            "query_overflow": run_query_overflow, "query_swap": run_query_swap,
            "query_steady": run_query_steady,
            "scenario_kill": run_scenario_kill,
-           "scenario_poison": run_scenario_poison}
+           "scenario_poison": run_scenario_poison,
+           "trace_kill": run_trace_kill}
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""Perf-regression sentinel over the BENCH_r*.json trajectory.
+
+The driver records one bench JSON per round (``BENCH_r*.json`` at the repo
+root, each a wrapper whose ``parsed`` field holds the record bench.py
+printed).  This gate compares a freshly produced record against that
+trajectory and exits non-zero when a key serving metric regressed past its
+tolerance band — the teeth behind "don't ship a slower build".
+
+Metrics and bands (overridable per metric with ``--tol``):
+
+- lower-is-better: e2e wall (``value``), ``daily_update_latency_s``,
+  ``guarded_update_latency_s``, and the two overhead fractions
+  (``telemetry_overhead_frac`` / ``tracing_overhead_frac``, which also get
+  an absolute floor at the documented 1% budget — a 0.0002 -> 0.0004 jitter
+  doubles the fraction without meaning anything).
+- higher-is-better: ``portfolios_per_sec``, ``scenarios_per_sec``.
+
+The baseline per metric is the BEST same-backend value in the trajectory
+(min for walls, max for throughputs) — comparing a CPU-fallback run against
+a TPU round would only ever cry wolf, so cross-backend records are skipped.
+A record with no comparable baseline passes (you cannot regress from
+nothing), but the report says so.
+
+Used three ways: ``python bench.py --compare`` gates the record it just
+produced; ``tools/bench_all.sh`` gates the riskmodel record of a full
+sweep; ``python tools/perfgate.py RECORD.json`` gates any saved record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: metric -> (direction, relative tolerance band, absolute floor|None).
+#: A lower-is-better metric regresses when current > best * (1 + tol) AND
+#: current > floor; higher-is-better when current < best * (1 - tol).
+METRIC_SPECS = {
+    "e2e_wall_s": ("lower", 0.25, None),
+    "daily_update_latency_s": ("lower", 0.25, None),
+    "guarded_update_latency_s": ("lower", 0.25, None),
+    "telemetry_overhead_frac": ("lower", 0.50, 0.01),
+    "tracing_overhead_frac": ("lower", 0.50, 0.01),
+    "portfolios_per_sec": ("higher", 0.20, None),
+    "scenarios_per_sec": ("higher", 0.20, None),
+}
+
+
+def extract_metrics(rec) -> dict:
+    """Flatten one bench record into the gate's metric namespace.  Unknown
+    or failed records (value None) yield an empty/partial dict — the gate
+    skips what it cannot read rather than failing the build on a malformed
+    round."""
+    out = {}
+    if not isinstance(rec, dict):
+        return out
+    metric = rec.get("metric")
+    if metric == "csi300_riskmodel_e2e_wall":
+        out["e2e_wall_s"] = rec.get("value")
+        for k in ("daily_update_latency_s", "guarded_update_latency_s",
+                  "telemetry_overhead_frac", "tracing_overhead_frac"):
+            out[k] = rec.get(k)
+    elif metric == "portfolio_query_throughput":
+        out["portfolios_per_sec"] = rec.get("value")
+    elif metric == "scenario_throughput":
+        out["scenarios_per_sec"] = rec.get("value")
+    return {k: v for k, v in out.items()
+            if isinstance(v, (int, float)) and v == v}
+
+
+def _unwrap(obj):
+    """BENCH_r*.json files are driver wrappers ``{"n", "cmd", "rc",
+    "parsed", "tail"}``; bare records (e.g. a saved ``bench.py`` line) are
+    accepted as-is."""
+    if isinstance(obj, dict) and "metric" in obj:
+        return obj
+    if isinstance(obj, dict):
+        parsed = obj.get("parsed")
+        if isinstance(parsed, dict) and "metric" in parsed:
+            return parsed
+    return None
+
+
+def load_trajectory(root: str = REPO) -> list:
+    """All readable BENCH_r*.json records under ``root``, oldest first.
+    Unparseable files are skipped (a torn round must not wedge the gate)."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        try:
+            with open(path, encoding="utf-8") as f:
+                obj = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        rec = _unwrap(obj)
+        if rec is not None:
+            out.append({"name": os.path.basename(path), "record": rec})
+    return out
+
+
+def gate_record(rec, trajectory, tolerances=None) -> dict:
+    """Compare ``rec`` against the same-backend trajectory.  Returns a
+    verdict dict: ``checks`` (every metric compared), ``regressions`` (the
+    failing subset), ``skipped`` (metrics with no baseline or no current
+    value), ``backend``, ``baseline_runs``."""
+    tolerances = tolerances or {}
+    backend = rec.get("backend") if isinstance(rec, dict) else None
+    current = extract_metrics(rec)
+
+    # best same-backend value per metric (+ where it came from)
+    best = {}
+    runs = set()
+    for entry in trajectory:
+        base = entry["record"]
+        if base.get("backend") != backend:
+            continue
+        for k, v in extract_metrics(base).items():
+            direction = METRIC_SPECS[k][0]
+            better = (v < best[k][0] if direction == "lower"
+                      else v > best[k][0]) if k in best else True
+            if better:
+                best[k] = (v, entry["name"])
+            runs.add(entry["name"])
+
+    checks, skipped = [], []
+    for name, (direction, tol, floor) in METRIC_SPECS.items():
+        cur = current.get(name)
+        if cur is None:
+            skipped.append({"metric": name, "reason": "not in this record"})
+            continue
+        if name not in best:
+            skipped.append({"metric": name,
+                            "reason": f"no {backend or 'unknown'}-backend "
+                                      "baseline in trajectory"})
+            continue
+        base_v, base_run = best[name]
+        tol = float(tolerances.get(name, tol))
+        if direction == "lower":
+            limit = base_v * (1.0 + tol)
+            regressed = cur > limit and (floor is None or cur > floor)
+        else:
+            limit = base_v * (1.0 - tol)
+            regressed = cur < limit
+        checks.append({"metric": name, "direction": direction,
+                       "current": cur, "baseline": base_v,
+                       "baseline_run": base_run, "limit": round(limit, 6),
+                       "tolerance": tol, "floor": floor,
+                       "regressed": bool(regressed)})
+    return {"backend": backend, "checks": checks,
+            "regressions": [c for c in checks if c["regressed"]],
+            "skipped": skipped, "baseline_runs": sorted(runs)}
+
+
+def format_report(verdict: dict) -> str:
+    lines = [f"perfgate: backend={verdict['backend'] or 'unknown'} "
+             f"baselines={','.join(verdict['baseline_runs']) or 'none'}"]
+    for c in verdict["checks"]:
+        arrow = "<=" if c["direction"] == "lower" else ">="
+        status = "REGRESSED" if c["regressed"] else "ok"
+        lines.append(
+            f"  [{status:9s}] {c['metric']}: {c['current']} "
+            f"(want {arrow} {c['limit']}; best {c['baseline']} "
+            f"from {c['baseline_run']}, tol {c['tolerance']:.0%})")
+    for s in verdict["skipped"]:
+        lines.append(f"  [skipped  ] {s['metric']}: {s['reason']}")
+    n = len(verdict["regressions"])
+    lines.append(f"perfgate: {'FAIL — %d regression(s)' % n if n else 'PASS'}"
+                 f" ({len(verdict['checks'])} compared,"
+                 f" {len(verdict['skipped'])} skipped)")
+    return "\n".join(lines)
+
+
+def _parse_tols(pairs) -> dict:
+    out = {}
+    for p in pairs or ():
+        name, _, val = p.partition("=")
+        if name not in METRIC_SPECS:
+            raise SystemExit(f"perfgate: unknown metric {name!r} "
+                             f"(known: {', '.join(sorted(METRIC_SPECS))})")
+        try:
+            out[name] = float(val)
+        except ValueError:
+            raise SystemExit(f"perfgate: bad tolerance {p!r} "
+                             "(want metric=frac, e.g. e2e_wall_s=0.3)")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="gate a bench record against the BENCH_r*.json "
+                    "trajectory; exit 1 on regression")
+    ap.add_argument("record", help="path to a bench JSON record (bare or "
+                                   "driver-wrapped), or '-' for stdin")
+    ap.add_argument("--root", default=REPO, metavar="DIR",
+                    help="directory holding BENCH_r*.json (default: repo "
+                         "root)")
+    ap.add_argument("--tol", action="append", metavar="METRIC=FRAC",
+                    help="override one metric's relative tolerance band "
+                         "(repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the verdict as JSON instead of the text "
+                         "report")
+    args = ap.parse_args(argv)
+
+    if args.record == "-":
+        obj = json.load(sys.stdin)
+    else:
+        with open(args.record, encoding="utf-8") as f:
+            obj = json.load(f)
+    rec = _unwrap(obj)
+    if rec is None:
+        print("perfgate: record has no 'metric' field (not a bench record)",
+              file=sys.stderr)
+        return 2
+
+    verdict = gate_record(rec, load_trajectory(args.root),
+                          tolerances=_parse_tols(args.tol))
+    if args.json:
+        print(json.dumps(verdict, sort_keys=True))
+    else:
+        print(format_report(verdict))
+    return 1 if verdict["regressions"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
