@@ -212,6 +212,27 @@ def bench_riskmodel():
 
     upd_s = _time3(update_step)
 
+    # the incremental-eigen serving path (config.eigen_incremental=True):
+    # the same single-date append at FULL eigen fidelity — the appended
+    # date's Monte-Carlo bias is computed from the frozen draw stream and
+    # the carried prefix moments instead of freezing sim covariances, so
+    # the eigen work per served date is O(M) eighs, not O(T*M)
+    import dataclasses as _dci
+    icfg = _dci.replace(cfg, eigen_sim_length=None, eigen_incremental=True)
+    rm_inc = RiskModel(*[_prefix(a) for a in args], n_industries=P,
+                       config=icfg)
+    _, istate0 = rm_inc.init_state()
+
+    def eigen_update_step():
+        st = jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True),
+                                    istate0)
+        fresh = [jnp.array(a[-1:], copy=True) for a in args]
+        m = RiskModel(*fresh, n_industries=P, config=icfg)
+        out, _ = m.update(st)
+        return _checksum(out)
+
+    eig_upd_s = _time3(eigen_update_step)
+
     # the PRODUCTION serving path is guarded (input guards + degraded-mode
     # quarantine, serve/guard.py): same single-date append through
     # update_guarded, so the overhead of health-checking every slab is a
@@ -374,6 +395,7 @@ def bench_riskmodel():
     # assembled from the registry's flat view — bench output and a metrics
     # scrape can never disagree
     for name, s in (("fused_e2e", tpu_s), ("daily_update", upd_s),
+                    ("eigen_update", eig_upd_s),
                     ("guarded_update", gupd_s), ("regression", reg_s),
                     ("newey_west", nw_s), ("eigen", eig_s),
                     ("vol_regime", vr_s)):
@@ -419,6 +441,16 @@ def bench_riskmodel():
             "daily_update_latency_s": round(_stage_s("daily_update"), 4),
             "update_dates_per_sec": round(1.0 / upd_s),
             "update_speedup_vs_e2e": round(tpu_s / upd_s, 1),
+            # the eigen stage alone (unfused wall) and the incremental-eigen
+            # single-date append (full-fidelity MC bias per served date,
+            # config.eigen_incremental=True) — the two walls the eigen
+            # optimisation work is gated on (tools/perfgate.py)
+            "eigen_stage_wall_s": round(_stage_s("eigen"), 4),
+            "eigen_update_latency_s": round(_stage_s("eigen_update"), 4),
+            # which Monte-Carlo dtype produced these numbers (the bf16 path
+            # is a different draw realization — records are only comparable
+            # within a dtype)
+            "eigen_mc_dtype": cfg.eigen_mc_dtype or "float32",
             # the guarded (production) serving path: input guards +
             # degraded-mode quarantine run inside the same fused step,
             # WITH per-date telemetry recording (the production loop's

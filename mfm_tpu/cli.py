@@ -248,6 +248,8 @@ def _risk(args):
             eigen_n_sims=args.eigen_sims, eigen_scale_coef=args.eigen_scale,
             eigen_chunk=args.eigen_chunk,
             eigen_sim_length=args.eigen_sim_length,
+            eigen_mc_dtype=args.eigen_mc_dtype,
+            eigen_incremental=args.eigen_incremental,
             vol_regime_half_life=args.vr_half_life, seed=args.seed,
             quarantine=QuarantinePolicy(enabled=args.quarantine),
         ),
@@ -780,6 +782,8 @@ def _pipeline(args):
             eigen_n_sims=args.eigen_sims, eigen_scale_coef=args.eigen_scale,
             eigen_chunk=args.eigen_chunk,
             eigen_sim_length=args.eigen_sim_length,
+            eigen_mc_dtype=args.eigen_mc_dtype,
+            eigen_incremental=args.eigen_incremental,
             vol_regime_half_life=args.vr_half_life, seed=args.seed,
             quarantine=QuarantinePolicy(enabled=args.quarantine),
         ),
@@ -1300,7 +1304,13 @@ def _doctor(args):
                     "the live file changed after its pointer swap")
         if meta.get("kind") == "risk_state":
             required = (set(_NW_SCALARS) | set(_NW_STACKED)
-                        | {"vr_num", "vr_den", "sim_covs"})
+                        | {"vr_num", "vr_den"})
+            # the eigen stage's resumable form: frozen sim covariances, or
+            # (eigen_incremental) the draw stream + prefix-moment carry
+            if "eig_draws" in arrays:
+                required |= {"eig_draws", "eig_R", "eig_p", "eig_n"}
+            else:
+                required |= {"sim_covs"}
             missing = sorted(required - set(arrays))
             if missing:
                 rec["problems"].append(
@@ -1881,6 +1891,25 @@ def main(argv=None):
         "from-scratch rerun on the same draws (bitwise comparability)")
     r.add_argument("--eigen-sim-length", type=_positive_int, default=None,
                    metavar="L", help=_eigen_sim_length_help)
+    _eigen_mc_dtype_help = (
+        "storage dtype for the eigen Monte-Carlo draws/scaled-cov assembly "
+        "(eigh and accumulation stay f32).  'bfloat16' halves the stage's "
+        "memory traffic; outputs change within the documented eigenfactor-"
+        "bias parity budget (tools/parity_budget.json: eigen_mc_bf16), NOT "
+        "bitwise — leave unset for the bitwise default path")
+    r.add_argument("--eigen-mc-dtype", choices=["bfloat16"], default=None,
+                   help=_eigen_mc_dtype_help)
+    _eigen_incremental_help = (
+        "causal incremental eigen: each date's Monte-Carlo bias uses "
+        "exactly the draw prefix available at that date, and the raw draw "
+        "moments ride the checkpoint as a carry — `--update` then appends "
+        "a date in O(1) eigen work (one simulated eigh batch) instead of "
+        "recomputing the whole history's bias, bitwise-equal to the "
+        "corresponding full-history rerun under this same flag.  "
+        "Incompatible with --eigen-sim-length (the draw count is the "
+        "date count by construction)")
+    r.add_argument("--eigen-incremental", action="store_true",
+                   help=_eigen_incremental_help)
 
     r.add_argument("--save-state", default=None, metavar="FILE.npz",
                    help="also checkpoint the resumable scan state (NW/vol-"
@@ -2008,6 +2037,10 @@ def main(argv=None):
                     metavar="N|auto|none", help=_eigen_chunk_help)
     pl.add_argument("--eigen-sim-length", type=_positive_int, default=None,
                     metavar="L", help=_eigen_sim_length_help)
+    pl.add_argument("--eigen-mc-dtype", choices=["bfloat16"], default=None,
+                    help=_eigen_mc_dtype_help)
+    pl.add_argument("--eigen-incremental", action="store_true",
+                    help=_eigen_incremental_help)
     pl.add_argument("--vr-half-life", type=float, default=42.0)
     pl.add_argument("--seed", type=int, default=0)
     pl.add_argument("--dtype", default="float32")

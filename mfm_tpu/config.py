@@ -191,6 +191,32 @@ class RiskModelConfig:
     #: identical (same per-date op sequence, chunk-invariant solver
     #: dispatch).
     eigen_chunk: int | str | None = "auto"
+    #: Monte-Carlo draw/assembly dtype for the eigen bias simulation
+    #: (models/eigen.py).  None (default) keeps everything at the panel
+    #: compute dtype — bitwise-unchanged.  "bfloat16" draws the sims and
+    #: forms the scaled Gram matrices in bf16 with f32 accumulation
+    #: (dot-general ``preferred_element_type``) and runs the eighs in f32;
+    #: the result is NOT bitwise the f32 path but is gated by the
+    #: eigenfactor-bias parity budget (tools/parity_budget.json
+    #: ``eigen_mc_bf16``) instead.  Changes the numbers => part of
+    #: ``identity()``.
+    eigen_mc_dtype: str | None = None
+    #: opt-in incremental eigen draws for the daily serving loop.  The
+    #: default draw construction is ``normal(key, (M, K, T))`` whose values
+    #: depend on the total length T, so a checkpoint's Monte-Carlo bias can
+    #: only stay bitwise against a full-history rerun by pinning
+    #: ``eigen_sim_length``.  With ``eigen_incremental=True`` the draws are
+    #: instead generated once into a power-of-two padded bucket
+    #: ``(M, K, Tpad)`` and the per-date sim covariances are re-estimated
+    #: from the first ``T`` columns under a mask — a construction whose
+    #: first-T values are INVARIANT as T grows, so the eigen bias tracks the
+    #: growing history at full fidelity (sim_length == T, like a default
+    #: full-history run) while each daily update stays O(new dates):
+    #: bitwise-suffix-equal to a mode-on full-history rebuild
+    #: (tests/test_risk_state.py).  Mutually exclusive with a pinned
+    #: ``eigen_sim_length``.  Changes the draw values => part of
+    #: ``identity()``.
+    eigen_incremental: bool = False
     vol_regime_half_life: float = 42.0
     seed: int = 0
     #: serving-loop input guards + degraded mode (serve/guard.py); disabled
@@ -210,7 +236,8 @@ class RiskModelConfig:
         return (
             self.nw_lags, self.nw_half_life, self.nw_method,
             self.eigen_n_sims, self.eigen_scale_coef, self.eigen_sim_length,
-            self.eigen_sim_sweeps, self.vol_regime_half_life, self.seed,
+            self.eigen_sim_sweeps, self.eigen_mc_dtype,
+            self.eigen_incremental, self.vol_regime_half_life, self.seed,
             self.quarantine.identity(),
         )
 
@@ -236,6 +263,23 @@ class RiskModelConfig:
         if not ok:
             raise ValueError(
                 f"eigen_chunk must be an int >= 1, None, or 'auto'; got {c!r}"
+            )
+        if self.eigen_mc_dtype not in (None, "bfloat16"):
+            raise ValueError(
+                f"eigen_mc_dtype must be None or 'bfloat16', "
+                f"got {self.eigen_mc_dtype!r}"
+            )
+        if not isinstance(self.eigen_incremental, bool):
+            raise ValueError(
+                f"eigen_incremental must be a bool, "
+                f"got {self.eigen_incremental!r}"
+            )
+        if self.eigen_incremental and self.eigen_sim_length is not None:
+            raise ValueError(
+                "eigen_incremental=True tracks the growing panel length "
+                "(sim_length == T) by construction; a pinned "
+                f"eigen_sim_length ({self.eigen_sim_length}) contradicts it "
+                "— pick one"
             )
 
 

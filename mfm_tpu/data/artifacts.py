@@ -290,8 +290,26 @@ def save_risk_state(path: str, state, meta: dict | None = None):
                     else np.zeros((0,) + np.asarray(S).shape, np.asarray(S).dtype),
         "vr_num": np.asarray(state.vr_num),
         "vr_den": np.asarray(state.vr_den),
-        "sim_covs": np.asarray(state.sim_covs),
     }
+    # exactly one of the two eigen representations is present: the frozen
+    # simulated covariances (default mode) or the draw tensor + raw prefix
+    # moments (config.eigen_incremental)
+    if state.sim_covs is not None:
+        arrays["sim_covs"] = np.asarray(state.sim_covs)
+    eig_draws_dtype = None
+    if state.eig_draws is not None:
+        d = np.asarray(state.eig_draws)
+        if d.dtype.kind not in "fiub":
+            # extension dtypes (bf16 under eigen_mc_dtype) do not survive
+            # npz: np.load hands back raw void bytes, breaking both the
+            # payload digest and the consumer.  Store the bit pattern as a
+            # same-width unsigned view and record the real dtype in meta.
+            eig_draws_dtype = str(d.dtype)
+            d = d.view(np.dtype(f"u{d.dtype.itemsize}"))
+        arrays["eig_draws"] = d
+        arrays["eig_R"] = np.asarray(state.eig_R)
+        arrays["eig_p"] = np.asarray(state.eig_p)
+        arrays["eig_n"] = np.asarray(state.eig_n)
     if state.guarded:
         arrays["guard_last_good_cov"] = np.asarray(state.last_good_cov)
         arrays["guard_staleness"] = np.asarray(state.staleness)
@@ -306,6 +324,8 @@ def save_risk_state(path: str, state, meta: dict | None = None):
         "stamp": _stamp_to_json(state.stamp),
         "last_date": state.last_date,
     }
+    if eig_draws_dtype is not None:
+        state_meta["eig_draws_dtype"] = eig_draws_dtype
     save_artifact(path, arrays, {**state_meta, **(meta or {})}, fenced=True)
 
 
@@ -326,7 +346,14 @@ def load_risk_state(path: str, force: bool = False):
 
     arrays, meta = load_artifact(path, fenced=True, force=force)
     missing = (set(_NW_SCALARS) | set(_NW_STACKED)
-               | {"vr_num", "vr_den", "sim_covs"}) - set(arrays)
+               | {"vr_num", "vr_den"}) - set(arrays)
+    # the eigen stage is either the frozen sim_covs (default) or the
+    # incremental draws+moments quartet — a checkpoint must carry one
+    incremental = "eig_draws" in arrays
+    if incremental:
+        missing |= {"eig_R", "eig_p", "eig_n"} - set(arrays)
+    elif "sim_covs" not in arrays:
+        missing.add("sim_covs")
     if meta.get("kind") != "risk_state" or missing:
         raise ValueError(f"{path}: not a risk-state artifact"
                          + (f" — missing field(s) {sorted(missing)}"
@@ -354,16 +381,25 @@ def load_risk_state(path: str, force: bool = False):
             guard_ring=own("guard_ring"),
             guard_ring_pos=own("guard_ring_pos"),
         )
+    eig = {}
+    if incremental:
+        draws = arrays["eig_draws"]
+        if meta.get("eig_draws_dtype"):
+            # reverse the save-side unsigned bit-pattern view (bf16 etc.)
+            draws = draws.view(np.dtype(meta["eig_draws_dtype"]))
+        eig = dict(eig_draws=jnp.array(draws), eig_R=own("eig_R"),
+                   eig_p=own("eig_p"), eig_n=own("eig_n"))
     state = RiskModelState(
         nw_carry,
         own("vr_num"),
         own("vr_den"),
-        own("sim_covs"),
+        own("sim_covs") if "sim_covs" in arrays else None,
         sim_length=meta["sim_length"],
         eigen_batch_hint=int(meta["eigen_batch_hint"]),
         stamp=_stamp_from_json(meta["stamp"]),
         last_date=meta.get("last_date"),
         **guard,
+        **eig,
     )
     return state, meta
 

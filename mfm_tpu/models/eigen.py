@@ -31,8 +31,24 @@ TPU re-design (four structural wins over the reference's loop):
 Bitwise replication of the reference's draws is impossible by construction
 (np.random's MT19937 + SVD-based multivariate_normal); golden tests inject
 the draws, production uses ``jax.random`` (SURVEY.md §7.3).  Eigenvector
-signs are canonicalized (largest component positive) so results are
-bit-stable across backends/kernels.
+signs are canonicalized (largest component positive) wherever eigenvectors
+are *exposed*; inside this stage every consumer of the F0 basis is
+sign-invariant, so the canonicalization pass is skipped there (see the
+``canonical_signs=False`` notes below).
+
+Two opt-in variants ride the same stage (``RiskModelConfig``):
+
+- ``eigen_mc_dtype="bfloat16"``: the draws and the scaled-cov assembly run
+  in bf16 with f32 accumulation (``preferred_element_type``) and f32 eighs —
+  gated by the eigenfactor-bias parity budget (``tools/parity_budget.json``,
+  key ``eigen_mc_bf16``), not bitwise.
+- ``eigen_incremental=True``: the Monte-Carlo becomes *causal* — date t's
+  simulated covariances are estimated from exactly the draw columns
+  available at date t, via the raw prefix moments ``(R, p, n)`` carried in
+  :class:`~mfm_tpu.models.risk_model.RiskModelState`.  Draws are generated
+  per-column (:func:`simulated_eigen_draws`), so growing the bucket never
+  rewrites history, and the per-date recursion is strictly sequential, so a
+  resumed slab is bitwise the suffix of the full-history run.
 """
 
 from __future__ import annotations
@@ -85,16 +101,80 @@ def sim_sweeps_for(n_factors: int, dtype, sim_length: int) -> int:
 
 @highest_matmul_precision
 def simulated_eigen_covs(
-    key: jax.Array, n_factors: int, sim_length: int, n_sims: int, dtype=jnp.float32
+    key: jax.Array, n_factors: int, sim_length: int, n_sims: int,
+    dtype=jnp.float32, mc_dtype=None,
 ) -> jax.Array:
     """Sample covariances C_m of M standard-normal (K, T_sim) draws.
 
     Matches ``np.cov`` semantics: demean each row over the T_sim samples,
-    normalize by (T_sim - 1).  Shape (M, K, K).
+    normalize by (T_sim - 1).  Shape (M, K, K), always ``dtype``.
+
+    ``mc_dtype`` (the ``eigen_mc_dtype`` knob): draws are generated in that
+    dtype and the Gram contraction runs with ``dtype`` accumulation
+    (``preferred_element_type`` — one dot-general, never a bf16 running
+    sum, which would swamp at production sim lengths).  The mean is also
+    accumulated in ``dtype`` and rounded back for the subtraction, so the
+    demeaned samples stay in ``mc_dtype``.  ``None`` is the bitwise
+    original path.
     """
-    draws = jax.random.normal(key, (n_sims, n_factors, sim_length), dtype=dtype)
-    d = draws - jnp.mean(draws, axis=-1, keepdims=True)
-    return jnp.einsum("mkt,mlt->mkl", d, d) / (sim_length - 1)
+    if mc_dtype is None:
+        draws = jax.random.normal(
+            key, (n_sims, n_factors, sim_length), dtype=dtype)
+        d = draws - jnp.mean(draws, axis=-1, keepdims=True)
+        return jnp.einsum("mkt,mlt->mkl", d, d) / (sim_length - 1)
+    md = jnp.dtype(mc_dtype)
+    draws = jax.random.normal(key, (n_sims, n_factors, sim_length), dtype=md)
+    mu = jnp.mean(draws.astype(dtype), axis=-1, keepdims=True)
+    d = draws - mu.astype(md)
+    gram = jnp.einsum("mkt,mlt->mkl", d, d, preferred_element_type=dtype)
+    return gram.astype(dtype) / (sim_length - 1)
+
+
+def draw_bucket(T: int) -> int:
+    """Power-of-two draw-bucket capacity >= T (floor 64).
+
+    Incremental mode pre-generates the (M, K, bucket) draw tensor, so every
+    compiled shape downstream changes only when the history crosses a power
+    of two — the steady-state serving loop stays at <= 1 compile between
+    (rare, logarithmically spaced) bucket rollovers.
+    """
+    b = 64
+    while b < T:
+        b *= 2
+    return b
+
+
+def simulated_eigen_draws(key: jax.Array, n_factors: int, bucket: int,
+                          n_sims: int, dtype=jnp.float32,
+                          mc_dtype=None) -> jax.Array:
+    """The frozen (M, K, bucket) standard-normal draw tensor behind
+    incremental mode, generated **per column**: column t comes from
+    ``fold_in(key, t)``.
+
+    Per-column generation is the load-bearing property: a bigger bucket is
+    a strict prefix-extension of a smaller one (``jax.random.normal(key,
+    (M, K, T))[..., :n]`` does NOT equal ``normal(key, (M, K, n))``, so a
+    single monolithic draw would rewrite the already-consumed history on
+    every bucket rollover and break the bitwise-suffix contract).
+    """
+    md = jnp.dtype(mc_dtype) if mc_dtype is not None else jnp.dtype(dtype)
+    # R2: s32 iota — the column index only folds into the key
+    cols = jax.vmap(
+        lambda t: jax.random.normal(jax.random.fold_in(key, t),
+                                    (n_sims, n_factors), dtype=md)
+    )(jnp.arange(bucket, dtype=jnp.int32))
+    return jnp.moveaxis(cols, 0, -1)  # (M, K, bucket)
+
+
+def eigen_carry_init(n_sims: int, n_factors: int, dtype=jnp.float32) -> tuple:
+    """The ``(R, p, n)`` raw prefix moments of incremental mode before any
+    date: R (M, K, K) sum of per-column outer products, p (M, K) column sum,
+    n (s32) columns consumed.  All-zero — the recursion is exact, so
+    resuming from any checkpointed carry reproduces the uninterrupted run
+    bitwise (same contract as ``vr_init_carry``)."""
+    return (jnp.zeros((n_sims, n_factors, n_factors), dtype),
+            jnp.zeros((n_sims, n_factors), dtype),
+            jnp.zeros((), jnp.int32))
 
 
 # working-set accounting for the chunked Monte-Carlo: the G tensor itself
@@ -150,6 +230,38 @@ def auto_eigen_chunk(T: int, n_sims: int, n_factors: int, itemsize: int = 4,
     return int(max(1, min(T, budget // per_date)))
 
 
+def _bias_ratios(G, d0_c, dtype, prefer_pallas, sim_sweeps, batch_hint):
+    """(c, M, K, K) scaled-Gram batch + (c, K) F0 eigenvalues -> (c, K) mean
+    bias ratios v^2 — the one body every assembly variant (full batch,
+    chunked stream, bf16, incremental) funnels into, so their per-date op
+    sequence past assembly is identical by construction."""
+    Dm, Dm_hat = batched_eigh_weighted_diag(
+        G, d0_c[:, None, :], prefer_pallas=prefer_pallas,
+        sweeps=sim_sweeps, batch_hint=batch_hint)
+    # rank pairing, order-invariant across backends: i-th smallest sim
+    # eigenvalue pairs with the i-th smallest D0 (D0 is already
+    # ascending).  One variadic key-value sort: ~3x cheaper on TPU than
+    # argsort + two take_along_axis gathers over the same (c, M, K)
+    # tensors (measured 0.15 s at CSI300 scale); is_stable matches
+    # jnp.argsort's tie order.
+    Dm, Dm_hat = jax.lax.sort((Dm, Dm_hat), dimension=-1, num_keys=1,
+                              is_stable=True)
+    # A numerically-zero sim eigenvalue (rank-deficient covariance:
+    # D0_k = 0 zeroes G's k-th row/column, and LAPACK/Jacobi may emit 0
+    # or -eps there) would make the ratio 0/0 or a huge spurious value —
+    # substitute ratio 1 wherever |Dm| is below eps * lambda_max.  The
+    # substituted value only shifts v in directions the rebuild then
+    # scales by D0 ~ 0.
+    eps = jnp.asarray(jnp.finfo(dtype).eps, dtype)
+    thr = eps * jnp.max(jnp.abs(Dm), axis=-1, keepdims=True)
+    degenerate = jnp.abs(Dm) <= thr
+    ratio = jnp.where(degenerate, 1.0,
+                      Dm_hat / jnp.where(degenerate, 1.0, Dm))
+    # clamp: tiny-negative Dm just above thr could still push the mean
+    # negative, and sqrt of a negative poisons the whole date with NaN
+    return jnp.maximum(jnp.mean(ratio, axis=1), 0.0)  # (c, K)
+
+
 @highest_matmul_precision
 def eigen_risk_adjust_by_time(
     covs: jax.Array,
@@ -161,6 +273,7 @@ def eigen_risk_adjust_by_time(
     sim_length: int | None = None,
     chunk: int | None = None,
     batch_hint: int | None = None,
+    mc_dtype=None,
 ):
     """Batched adjustment over the date axis.
 
@@ -204,6 +317,15 @@ def eigen_risk_adjust_by_time(
     incremental update path passes the INIT-time T*M so a one-date slab
     dispatches its sim eighs exactly like the full history it extends —
     slab-invariant the same way the chunk stream is chunk-invariant.
+
+    ``mc_dtype`` (the ``eigen_mc_dtype`` knob): assemble the (c, M, K, K)
+    G transient in that dtype — sqrt-eigenvalue scale factors and sim_covs
+    rounded once, the per-date outer-scale matrix formed as a dot-general,
+    and ONE multiply over the big tensor instead of the default path's two
+    chained broadcast multiplies — then cast to ``covs.dtype`` for the
+    (always full-precision) eighs.  The restructure lives only on this
+    non-default path: it changes rounding, so the ``None`` default keeps
+    the original op sequence bitwise.
     """
     dtype = covs.dtype
     T = covs.shape[0]
@@ -216,7 +338,14 @@ def eigen_risk_adjust_by_time(
     eye = jnp.eye(K, dtype=dtype)
     safe = jnp.where(valid[:, None, None], covs, eye)
 
-    D0, U0 = batched_eigh(safe, prefer_pallas=prefer_pallas)  # (T,K), (T,K,K)
+    # canonical_signs=False: within this stage the F0 basis is sign-
+    # invariant — s and psd read D0 only, and the rebuild einsum below
+    # carries U0 quadratically (sign flips are exact FP negations that
+    # square away term-by-term) — so skipping the canonicalization pass
+    # (argmax + gather + multiply over (T, K, K)) is bitwise-identical on
+    # every output while shaving the hot path.
+    D0, U0 = batched_eigh(safe, prefer_pallas=prefer_pallas,
+                          canonical_signs=False)  # (T,K), (T,K,K)
     psd = D0[..., 0] >= 0  # ascending order -> min eigenvalue first
     s = jnp.sqrt(jnp.maximum(D0, 0.0))
 
@@ -228,6 +357,9 @@ def eigen_risk_adjust_by_time(
     # reads it back; pairing is restored below by sorting the scalar
     # (Dm, Dm_hat) pairs.  Signs square away in W*W.
     # D_hat = diag(U_m' F0 U_m) with U_m = U0 W  ->  sum_k W_ki^2 D0_k
+    md = None if mc_dtype is None else jnp.dtype(mc_dtype)
+    sim_lo = None if md is None else sim_covs.astype(md)
+
     def _sim_bias_v2(s_c, d0_c):
         """(c, K) sqrt-eigvals + eigvals -> (c, K) mean bias ratios v^2.
 
@@ -235,32 +367,18 @@ def eigen_risk_adjust_by_time(
         both the full-batch and the chunked path run, so their per-date op
         sequence (and hence their result) is identical by construction.
         """
-        G = s_c[:, None, :, None] * sim_covs[None] * s_c[:, None, None, :]
-        Dm, Dm_hat = batched_eigh_weighted_diag(
-            G, d0_c[:, None, :], prefer_pallas=prefer_pallas,
-            sweeps=sim_sweeps, batch_hint=batch_hint)
-        # rank pairing, order-invariant across backends: i-th smallest sim
-        # eigenvalue pairs with the i-th smallest D0 (D0 is already
-        # ascending).  One variadic key-value sort: ~3x cheaper on TPU than
-        # argsort + two take_along_axis gathers over the same (c, M, K)
-        # tensors (measured 0.15 s at CSI300 scale); is_stable matches
-        # jnp.argsort's tie order.
-        Dm, Dm_hat = jax.lax.sort((Dm, Dm_hat), dimension=-1, num_keys=1,
-                                  is_stable=True)
-        # A numerically-zero sim eigenvalue (rank-deficient covariance:
-        # D0_k = 0 zeroes G's k-th row/column, and LAPACK/Jacobi may emit 0
-        # or -eps there) would make the ratio 0/0 or a huge spurious value —
-        # substitute ratio 1 wherever |Dm| is below eps * lambda_max.  The
-        # substituted value only shifts v in directions the rebuild then
-        # scales by D0 ~ 0.
-        eps = jnp.asarray(jnp.finfo(dtype).eps, dtype)
-        thr = eps * jnp.max(jnp.abs(Dm), axis=-1, keepdims=True)
-        degenerate = jnp.abs(Dm) <= thr
-        ratio = jnp.where(degenerate, 1.0,
-                          Dm_hat / jnp.where(degenerate, 1.0, Dm))
-        # clamp: tiny-negative Dm just above thr could still push the mean
-        # negative, and sqrt of a negative poisons the whole date with NaN
-        return jnp.maximum(jnp.mean(ratio, axis=1), 0.0)  # (c, K)
+        if md is None:
+            G = s_c[:, None, :, None] * sim_covs[None] * s_c[:, None, None, :]
+        else:
+            # mixed-precision assembly: the (c, K, K) outer-scale matrix is
+            # one dot-general over the rounded scale factors, then a single
+            # multiply forms the big (c, M, K, K) transient in mc_dtype —
+            # cast up only at the eigh input
+            s_lo = s_c.astype(md)
+            S = jnp.einsum("ck,cl->ckl", s_lo, s_lo)
+            G = (S[:, None] * sim_lo[None]).astype(dtype)
+        return _bias_ratios(G, d0_c, dtype, prefer_pallas, sim_sweeps,
+                            batch_hint)
 
     if chunk is None or chunk >= T:
         v2 = _sim_bias_v2(s, D0)  # (T, K)
@@ -289,6 +407,149 @@ def eigen_risk_adjust_by_time(
     ok = valid & psd
     out = jnp.where(ok[:, None, None], out, jnp.nan)
     return out, ok
+
+
+@highest_matmul_precision
+def eigen_risk_adjust_incremental(
+    covs: jax.Array,
+    valid: jax.Array,
+    draws: jax.Array,
+    carry: tuple,
+    scale_coef: float = 1.4,
+    *,
+    prefer_pallas: bool | None = None,
+    sim_sweeps: int | None = None,
+    chunk: int | None = None,
+    batch_hint: int | None = None,
+    skip_mask: jax.Array | None = None,
+    mc_dtype=None,
+):
+    """Causal (expanding-draw) eigen adjustment — the incremental mode.
+
+    The default stage estimates ONE set of simulated covariances from
+    ``sim_length`` draws and applies it to every date — date t's bias then
+    depends on the total panel length, so a checkpoint has to freeze
+    ``sim_covs`` and serve stale-count sims forever.  Here the Monte-Carlo
+    is *causal* instead: each non-skipped date consumes the next column of
+    the frozen per-column ``draws`` tensor (:func:`simulated_eigen_draws`)
+    and folds it into the raw prefix moments ``carry = (R, p, n)``
+    (:func:`eigen_carry_init`) BEFORE its own bias is measured, so date t's
+    simulated covariances ``C_m(t) = (R - p p'/n) / (n - 1)`` estimate from
+    exactly the draw prefix available at date t.  Because the moment
+    recursion is strictly sequential (a ``fori_loop`` inside a chunk
+    ``scan`` — never a parallel prefix) and the carry is exact, a slab
+    resumed from a checkpointed carry is **bitwise** the suffix of the
+    full-history run, chunk- and slab-boundary-invariant — the same
+    contract as the Newey-West and vol-regime carries.
+
+    ``C_m(t)`` is the one-pass raw-moment form of ``np.cov`` (draws are
+    standard normal, mean ~0, so the classic cancellation hazard is absent);
+    dates with n < 2 get the identity substitute (they are Newey-West-
+    invalid anyway — min_valid >= K — so the value is never served).
+
+    ``skip_mask`` ((T,) bool) excises dates exactly like the NW/vol-regime
+    carries: a skipped date consumes no draw column and leaves (R, p, n)
+    bitwise untouched, so (good, BAD, good) matches (good, good).  Padded
+    chunk-tail dates ride the same mechanism.
+
+    ``sim_sweeps`` must be resolved by the CALLER (host-side, from the
+    running count via :func:`sim_sweeps_for`) — it is a static solver knob
+    and this function sees only traced counts.
+
+    Returns ``(out, ok, carry_out)``.
+    """
+    dtype = covs.dtype
+    T = covs.shape[0]
+    K = covs.shape[-1]
+    M = draws.shape[0]
+    if batch_hint is None:
+        batch_hint = T * M
+    eye = jnp.eye(K, dtype=dtype)
+    safe = jnp.where(valid[:, None, None], covs, eye)
+
+    # sign-invariant F0 basis, same argument as eigen_risk_adjust_by_time
+    D0, U0 = batched_eigh(safe, prefer_pallas=prefer_pallas,
+                          canonical_signs=False)
+    psd = D0[..., 0] >= 0
+    s = jnp.sqrt(jnp.maximum(D0, 0.0))
+    skip = (jnp.zeros((T,), bool) if skip_mask is None
+            else skip_mask.astype(bool))
+
+    md = None if mc_dtype is None else jnp.dtype(mc_dtype)
+
+    def _chunk_body(mom, xs):
+        R, p, n = mom
+        s_c, d0_c, skip_c = xs  # (c, K), (c, K), (c,)
+        c = s_c.shape[0]
+
+        def date_step(i, st):
+            R, p, n, Cs = st
+            sk = jax.lax.dynamic_index_in_dim(skip_c, i, 0, keepdims=False)
+            # column n is the next unconsumed draw (dynamic_slice clamps the
+            # unreachable-by-construction overflow read; risk_model rolls
+            # the bucket before it can fill).  bf16 draws cast up exactly —
+            # the moments always accumulate in the compute dtype.
+            x = jax.lax.dynamic_index_in_dim(
+                draws, n, 2, keepdims=False).astype(dtype)
+            # optimization_barrier pins the mul->add/sub rounding chains:
+            # XLA CPU forms FMAs opportunistically and PER COMPILATION, so
+            # without the barriers a different chunk/slab shape can contract
+            # `R + x x'` or `R - p p'/n` into a single-rounding FMA and
+            # break the bitwise chunk/slab invariance this mode promises
+            # (observed: 1-ulp moment drift amplified through the
+            # ill-conditioned early-date eighs).
+            o = jax.lax.optimization_barrier(x[:, :, None] * x[:, None, :])
+            R1 = jnp.where(sk, R, R + o)
+            p1 = jnp.where(sk, p, p + x)
+            n1 = jnp.where(sk, n, n + jnp.int32(1))
+            nf = n1.astype(dtype)
+            mu = p1 / jnp.maximum(nf, 1.0)
+            pp = jax.lax.optimization_barrier(
+                mu[:, :, None] * p1[:, None, :])
+            Craw = (R1 - pp) / jnp.maximum(nf - 1.0, 1.0)
+            C = jnp.where(n1 >= jnp.int32(2), Craw,
+                          jnp.broadcast_to(eye, Craw.shape))
+            Cs = jax.lax.dynamic_update_index_in_dim(Cs, C, i, 0)
+            return R1, p1, n1, Cs
+
+        # R2: explicit s32 bounds, like every traced loop counter here
+        R, p, n, Cs = jax.lax.fori_loop(
+            jnp.int32(0), jnp.int32(c), date_step,
+            (R, p, n, jnp.zeros((c, M, K, K), dtype)))
+        if md is None:
+            G = s_c[:, None, :, None] * Cs * s_c[:, None, None, :]
+        else:
+            s_lo = s_c.astype(md)
+            S = jnp.einsum("ck,cl->ckl", s_lo, s_lo)
+            G = (S[:, None] * Cs.astype(md)).astype(dtype)
+        v2_c = _bias_ratios(G, d0_c, dtype, prefer_pallas, sim_sweeps,
+                            batch_hint)
+        return (R, p, n), v2_c
+
+    if chunk is None or chunk >= T:
+        mom, v2 = _chunk_body(tuple(carry), (s, D0, skip))
+    else:
+        from mfm_tpu.parallel.mesh import replicate_under_mesh
+
+        pad = (-T) % chunk
+        # padded tail dates are skip=True: they must consume no draw column
+        s_p = jnp.pad(s, ((0, pad), (0, 0)))
+        d0_p = jnp.pad(D0, ((0, pad), (0, 0)))
+        skip_p = jnp.pad(skip, ((0, pad),), constant_values=True)
+        n_chunks = (T + pad) // chunk
+        s_p, d0_p, skip_p = replicate_under_mesh((
+            s_p.reshape(n_chunks, chunk, K),
+            d0_p.reshape(n_chunks, chunk, K),
+            skip_p.reshape(n_chunks, chunk)))
+        mom, v2s = jax.lax.scan(_chunk_body, tuple(carry),
+                                (s_p, d0_p, skip_p))
+        v2 = replicate_under_mesh(v2s.reshape(n_chunks * chunk, K)[:T])
+
+    v = scale_coef * (jnp.sqrt(v2) - 1.0) + 1.0
+    out = jnp.einsum("tik,tk,tjk->tij", U0, v * v * D0, U0)
+    ok = valid & psd
+    out = jnp.where(ok[:, None, None], out, jnp.nan)
+    return out, ok, mom
 
 
 def eigen_risk_adjust(

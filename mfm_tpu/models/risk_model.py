@@ -31,8 +31,13 @@ import numpy as np
 from mfm_tpu.config import RiskModelConfig
 from mfm_tpu.models.eigen import (
     auto_eigen_chunk,
+    draw_bucket,
+    eigen_carry_init,
     eigen_risk_adjust_by_time,
+    eigen_risk_adjust_incremental,
+    sim_sweeps_for,
     simulated_eigen_covs,
+    simulated_eigen_draws,
 )
 from mfm_tpu.models.newey_west import (
     newey_west_expanding,
@@ -91,7 +96,7 @@ class RiskModelState:
     nw_carry: tuple
     vr_num: jax.Array
     vr_den: jax.Array
-    sim_covs: jax.Array
+    sim_covs: jax.Array | None
     sim_length: int | None
     eigen_batch_hint: int
     stamp: tuple
@@ -105,12 +110,24 @@ class RiskModelState:
     quarantine_count: jax.Array | None = None  # s32 scalar
     guard_ring: jax.Array | None = None      # (universe_window,)
     guard_ring_pos: jax.Array | None = None  # s32 scalar
+    #: incremental-eigen carry (config.eigen_incremental; all four together,
+    #: None otherwise, and sim_covs is None in that mode): the frozen
+    #: per-column draw tensor (models/eigen.py::simulated_eigen_draws) and
+    #: the exact raw prefix moments (R, p, n) of the columns consumed so
+    #: far.  sim_length then mirrors the host-side date count (the draw
+    #: cursor's upper bound, used for bucket rollover and the static sweep
+    #: tier) rather than a frozen draw length.
+    eig_draws: jax.Array | None = None       # (M, K, bucket)
+    eig_R: jax.Array | None = None           # (M, K, K)
+    eig_p: jax.Array | None = None           # (M, K)
+    eig_n: jax.Array | None = None           # s32 scalar
 
     def tree_flatten(self):
         children = (self.nw_carry, self.vr_num, self.vr_den, self.sim_covs,
                     self.last_good_cov, self.staleness,
                     self.quarantine_count, self.guard_ring,
-                    self.guard_ring_pos)
+                    self.guard_ring_pos, self.eig_draws, self.eig_R,
+                    self.eig_p, self.eig_n)
         aux = (self.sim_length, self.eigen_batch_hint, self.stamp,
                self.last_date)
         return children, aux
@@ -118,14 +135,16 @@ class RiskModelState:
     @classmethod
     def tree_unflatten(cls, aux, children):
         (nw_carry, vr_num, vr_den, sim_covs, last_good_cov, staleness,
-         quarantine_count, guard_ring, guard_ring_pos) = children
+         quarantine_count, guard_ring, guard_ring_pos, eig_draws, eig_R,
+         eig_p, eig_n) = children
         sim_length, eigen_batch_hint, stamp, last_date = aux
         return cls(nw_carry, vr_num, vr_den, sim_covs,
                    sim_length=sim_length, eigen_batch_hint=eigen_batch_hint,
                    stamp=stamp, last_date=last_date,
                    last_good_cov=last_good_cov, staleness=staleness,
                    quarantine_count=quarantine_count, guard_ring=guard_ring,
-                   guard_ring_pos=guard_ring_pos)
+                   guard_ring_pos=guard_ring_pos, eig_draws=eig_draws,
+                   eig_R=eig_R, eig_p=eig_p, eig_n=eig_n)
 
     @property
     def t(self) -> int:
@@ -206,7 +225,7 @@ class RiskModel:
             sim_len = self.config.eigen_sim_length or self.T
             sim_covs = simulated_eigen_covs(
                 key, self.K, sim_len, self.config.eigen_n_sims,
-                dtype=nw_cov.dtype,
+                dtype=nw_cov.dtype, mc_dtype=self.config.eigen_mc_dtype,
             )
         # value validation happens in RiskModelConfig.__post_init__; "auto"
         # (None here) lets eigen_risk_adjust_by_time derive the sweep cap
@@ -220,18 +239,60 @@ class RiskModel:
             chunk=self._resolve_eigen_chunk(sim_covs.shape[0],
                                             nw_cov.dtype.itemsize),
             batch_hint=batch_hint,
+            mc_dtype=self.config.eigen_mc_dtype,
         )
 
     def _resolve_eigen_chunk(self, n_sims: int, itemsize: int) -> int | None:
         """config.eigen_chunk -> a concrete date-chunk size (or None).
 
         "auto" consults live memory headroom, so resolution happens at trace
-        time, once per compile (models.eigen.auto_eigen_chunk).
+        time, once per compile (models.eigen.auto_eigen_chunk).  Under
+        ``eigen_mc_dtype`` the streamed G transient is assembled in the MC
+        dtype, so its itemsize (2 for bf16) sizes the chunk, not the
+        compute dtype's.
         """
         c = self.config.eigen_chunk
         if c == "auto":
+            if self.config.eigen_mc_dtype is not None:
+                itemsize = jnp.dtype(self.config.eigen_mc_dtype).itemsize
             return auto_eigen_chunk(self.T, n_sims, self.K, itemsize)
         return c
+
+    # -- incremental-eigen (config.eigen_incremental) helpers ---------------
+    def _eigen_sweeps(self, count: int) -> int:
+        """Static Jacobi sweep cap for the simulated eighs at ``count``
+        consumed draw columns — resolved HOST-side (it keys the jit cache),
+        so the fused steps retrace only at the rare sim_sweeps_for tier
+        boundaries (4K / 32K), never per update."""
+        sweeps = self.config.eigen_sim_sweeps
+        if sweeps == "auto":
+            return sim_sweeps_for(self.K, self.ret.dtype, count)
+        return sweeps
+
+    def _fresh_eigen_draws(self, count: int) -> jax.Array:
+        """The (M, K, bucket(count)) per-column draw tensor.  Prefix-stable
+        by construction (simulated_eigen_draws), so a bucket rollover
+        regenerates every already-consumed column bitwise."""
+        return simulated_eigen_draws(
+            jax.random.key(self.config.seed), self.K, draw_bucket(count),
+            self.config.eigen_n_sims, dtype=self.ret.dtype,
+            mc_dtype=self.config.eigen_mc_dtype)
+
+    def _advance_eigen_host(self, state) -> tuple:
+        """Host-side incremental-eigen bookkeeping for one update: advance
+        the date-count mirror by the slab length, roll the draw bucket over
+        when the mirror outgrows it (prefix-stable regeneration — every
+        already-consumed column reproduces bitwise), and resolve the static
+        sweep cap.  Returns ``(eig_draws, eigen_sweeps, sim_length)``;
+        outside incremental mode it passes the state's values through
+        untouched (eig_draws None, sweeps None)."""
+        if not self.config.eigen_incremental:
+            return state.eig_draws, None, state.sim_length
+        mirror = state.sim_length + self.T
+        eig_draws = state.eig_draws
+        if mirror > eig_draws.shape[-1]:
+            eig_draws = self._fresh_eigen_draws(mirror)
+        return eig_draws, self._eigen_sweeps(mirror), mirror
 
     # -- stage 4 -----------------------------------------------------------
     def vol_regime_adj_by_time(self, factor_ret, eigen_cov, eigen_valid):
@@ -244,9 +305,30 @@ class RiskModel:
     def run(self, key=None, sim_covs=None, sim_length=None) -> RiskModelOutputs:
         factor_ret, specific_ret, r2 = self.reg_by_time()
         nw_cov, nw_valid = self.newey_west_by_time(factor_ret)
-        eigen_cov, eigen_valid = self.eigen_risk_adj_by_time(
-            nw_cov, nw_valid, key=key, sim_covs=sim_covs, sim_length=sim_length
-        )
+        if self.config.eigen_incremental:
+            # causal eigen: same outputs as init_state's full-history run
+            # (the serving contract incremental mode is defined by)
+            if sim_covs is not None or key is not None:
+                raise ValueError(
+                    "eigen_incremental=True derives its draws from "
+                    "config.seed (they are part of the resumable identity) "
+                    "— injected key/sim_covs would break the bitwise-suffix "
+                    "contract")
+            eigen_cov, eigen_valid, _ = eigen_risk_adjust_incremental(
+                nw_cov, nw_valid, self._fresh_eigen_draws(self.T),
+                eigen_carry_init(self.config.eigen_n_sims, self.K,
+                                 nw_cov.dtype),
+                self.config.eigen_scale_coef,
+                sim_sweeps=self._eigen_sweeps(self.T),
+                chunk=self._resolve_eigen_chunk(self.config.eigen_n_sims,
+                                                nw_cov.dtype.itemsize),
+                mc_dtype=self.config.eigen_mc_dtype,
+            )
+        else:
+            eigen_cov, eigen_valid = self.eigen_risk_adj_by_time(
+                nw_cov, nw_valid, key=key, sim_covs=sim_covs,
+                sim_length=sim_length
+            )
         vr_cov, lamb = self.vol_regime_adj_by_time(factor_ret, eigen_cov, eigen_valid)
         return RiskModelOutputs(
             factor_ret, specific_ret, r2,
@@ -270,13 +352,17 @@ class RiskModel:
         panel — the jit cache keys only on shapes, config and sim_length.
         """
         sim_len = sim_length
-        if sim_covs is None:
+        if self.config.eigen_incremental:
+            # run() generates the per-column draws in-graph from config.seed
+            # and refuses injected key/sim_covs — nothing to resolve here
+            sim_covs, sim_len = None, None
+        elif sim_covs is None:
             if key is None:
                 key = jax.random.key(self.config.seed)
             sim_len = self.config.eigen_sim_length or self.T
             sim_covs = simulated_eigen_covs(
                 key, self.K, sim_len, self.config.eigen_n_sims,
-                dtype=self.ret.dtype,
+                dtype=self.ret.dtype, mc_dtype=self.config.eigen_mc_dtype,
             )
         import warnings
 
@@ -293,7 +379,8 @@ class RiskModel:
 
     # -- incremental daily-update path --------------------------------------
     def _run_carried(self, sim_covs, sim_length, nw_carry=None, vr_carry=None,
-                     eigen_batch_hint=None, dyn_length=None, skip_mask=None):
+                     eigen_batch_hint=None, dyn_length=None, skip_mask=None,
+                     eig_draws=None, eig_carry=None, eigen_sweeps=None):
         """:meth:`run` with resumable scans: same four stages, but Newey-West
         and vol-regime run through their ``*_resume`` forms so the exact EWMA
         carries come out alongside the outputs.  With ``None`` carries this
@@ -302,7 +389,14 @@ class RiskModel:
         bitwise.  ``skip_mask`` ((T,) bool, None = no guards, the exact
         pre-guard graph) excises quarantined dates from both recursions and
         forces their ``nw_valid`` False so the eigen/vol-regime stages treat
-        them as invalid."""
+        them as invalid.
+
+        Under ``config.eigen_incremental`` the eigen stage runs its causal
+        form instead (``eig_draws`` + the ``eig_carry`` raw prefix moments,
+        ``eigen_sweeps`` the host-resolved static sweep cap), and the
+        returned 4-tuple's last element is the advanced eigen carry (None
+        otherwise).  ``skip_mask`` excises dates from the eigen draw cursor
+        exactly like the EWMA carries."""
         if self.T == 1:
             # XLA collapses a unit date batch into a different (gemv)
             # lowering of the residual matvec — 1 ulp off the batched
@@ -324,7 +418,36 @@ class RiskModel:
             half_life=self.config.nw_half_life, min_valid=self.K,
             carry=nw_carry, dyn_length=dyn_length, skip_mask=skip_mask,
         )
-        if self.T == 1:
+        eig_carry_out = None
+        if self.config.eigen_incremental:
+            if self.T == 1:
+                # same unit-batch pinning as the regression above — but the
+                # duplicate lane is marked skip=True, so it consumes no draw
+                # column and the carry after the two-lane scan equals the
+                # carry after lane 0 alone, bitwise
+                esk = (jnp.zeros((1,), bool) if skip_mask is None
+                       else skip_mask)
+                ec, ev, eig_carry_out = eigen_risk_adjust_incremental(
+                    jnp.concatenate([nw_cov, nw_cov], axis=0),
+                    jnp.concatenate([nw_valid, nw_valid], axis=0),
+                    eig_draws, eig_carry, self.config.eigen_scale_coef,
+                    sim_sweeps=eigen_sweeps, batch_hint=eigen_batch_hint,
+                    skip_mask=jnp.concatenate([esk, jnp.ones((1,), bool)]),
+                    mc_dtype=self.config.eigen_mc_dtype,
+                )
+                eigen_cov, eigen_valid = ec[:1], ev[:1]
+            else:
+                eigen_cov, eigen_valid, eig_carry_out = (
+                    eigen_risk_adjust_incremental(
+                        nw_cov, nw_valid, eig_draws, eig_carry,
+                        self.config.eigen_scale_coef,
+                        sim_sweeps=eigen_sweeps,
+                        chunk=self._resolve_eigen_chunk(
+                            eig_draws.shape[0], nw_cov.dtype.itemsize),
+                        batch_hint=eigen_batch_hint, skip_mask=skip_mask,
+                        mc_dtype=self.config.eigen_mc_dtype,
+                    ))
+        elif self.T == 1:
             # same unit-batch pinning as the regression above, for the
             # per-date eigen MC
             eigen_cov, eigen_valid = self.eigen_risk_adj_by_time(
@@ -348,7 +471,7 @@ class RiskModel:
             factor_ret, specific_ret, r2,
             nw_cov, nw_valid, eigen_cov, eigen_valid, vr_cov, lamb,
         )
-        return outputs, nw_carry_out, vr_carry_out
+        return outputs, nw_carry_out, vr_carry_out, eig_carry_out
 
     def _stamp(self) -> tuple:
         """Identity of (shape, dtype, math config) a checkpoint must match."""
@@ -373,16 +496,38 @@ class RiskModel:
         :meth:`update` appends further dates in O(1) per date.
         """
         self._require_scan_method("init_state")
+        incremental = self.config.eigen_incremental
         sim_len = sim_length
-        if sim_covs is None:
-            if key is None:
-                key = jax.random.key(self.config.seed)
-            sim_len = self.config.eigen_sim_length or self.T
-            sim_covs = simulated_eigen_covs(
-                key, self.K, sim_len, self.config.eigen_n_sims,
-                dtype=self.ret.dtype,
-            )
-        hint = self.T * int(sim_covs.shape[0])
+        eig_draws = eig_R = eig_p = eig_n = None
+        sweeps = None
+        if incremental:
+            if sim_covs is not None or key is not None:
+                raise ValueError(
+                    "eigen_incremental=True derives its draws from "
+                    "config.seed (they are part of the resumable identity) "
+                    "— injected key/sim_covs would break the bitwise-suffix "
+                    "contract")
+            # sim_length becomes the host-side date-count mirror: the draw
+            # cursor's upper bound, driving bucket rollover and the static
+            # sweep tier.  The fused step gets sim_length=None so the jit
+            # cache never keys on the growing count.
+            sim_len = self.T
+            eig_draws = self._fresh_eigen_draws(self.T)
+            eig_R, eig_p, eig_n = eigen_carry_init(
+                self.config.eigen_n_sims, self.K, self.ret.dtype)
+            sweeps = self._eigen_sweeps(self.T)
+            hint = self.T * self.config.eigen_n_sims
+        else:
+            if sim_covs is None:
+                if key is None:
+                    key = jax.random.key(self.config.seed)
+                sim_len = self.config.eigen_sim_length or self.T
+                sim_covs = simulated_eigen_covs(
+                    key, self.K, sim_len, self.config.eigen_n_sims,
+                    dtype=self.ret.dtype,
+                    mc_dtype=self.config.eigen_mc_dtype,
+                )
+            hint = self.T * int(sim_covs.shape[0])
         # the guard ring seeds from the history's universe sizes — read them
         # BEFORE the fused call donates (and may invalidate) self.valid
         guarded = self.config.quarantine.enabled
@@ -393,18 +538,24 @@ class RiskModel:
         with warnings.catch_warnings():
             warnings.filterwarnings(
                 "ignore", message=".*[Dd]onat.*", category=UserWarning)
-            outputs, nw_carry, (vr_num, vr_den) = _fused_init_step(
+            outputs, nw_carry, (vr_num, vr_den), eig_carry = _fused_init_step(
                 self.ret, self.cap, self.styles, self.industry, self.valid,
-                sim_covs, n_industries=self.n_industries, config=self.config,
-                sim_length=sim_len, eigen_batch_hint=hint,
+                sim_covs, eig_draws, eig_R, eig_p, eig_n,
+                n_industries=self.n_industries, config=self.config,
+                sim_length=None if incremental else sim_len,
+                eigen_batch_hint=hint, eigen_sweeps=sweeps,
             )
+        if incremental:
+            eig_R, eig_p, eig_n = eig_carry
         guard = {}
         if guarded:
             guard = self._seed_guard_state(outputs, counts)
         state = RiskModelState(
             nw_carry, vr_num, vr_den, sim_covs,
             sim_length=sim_len, eigen_batch_hint=hint,
-            stamp=self._stamp(), last_date=last_date, **guard,
+            stamp=self._stamp(), last_date=last_date,
+            eig_draws=eig_draws, eig_R=eig_R, eig_p=eig_p, eig_n=eig_n,
+            **guard,
         )
         return outputs, state
 
@@ -465,22 +616,29 @@ class RiskModel:
                 f"{state.stamp}, this model is {expect} — refusing to resume "
                 f"under different shapes/dtype/math config"
             )
+        eig_draws, sweeps, mirror = self._advance_eigen_host(state)
         import warnings
 
         with warnings.catch_warnings():
             warnings.filterwarnings(
                 "ignore", message=".*[Dd]onat.*", category=UserWarning)
-            outputs, nw_carry, (vr_num, vr_den) = _fused_update_step(
-                self.ret, self.cap, self.styles, self.industry, self.valid,
-                state.sim_covs, state.nw_carry, state.vr_num, state.vr_den,
-                jnp.asarray(self.T, jnp.int32),
-                n_industries=self.n_industries, config=self.config,
-                sim_length=state.sim_length,
-                eigen_batch_hint=state.eigen_batch_hint,
-            )
+            outputs, nw_carry, (vr_num, vr_den), eig_carry = \
+                _fused_update_step(
+                    self.ret, self.cap, self.styles, self.industry,
+                    self.valid, state.sim_covs, state.nw_carry, state.vr_num,
+                    state.vr_den, jnp.asarray(self.T, jnp.int32),
+                    eig_draws, state.eig_R, state.eig_p, state.eig_n,
+                    n_industries=self.n_industries, config=self.config,
+                    sim_length=(None if self.config.eigen_incremental
+                                else state.sim_length),
+                    eigen_batch_hint=state.eigen_batch_hint,
+                    eigen_sweeps=sweeps,
+                )
+        eig_R, eig_p, eig_n = (eig_carry if eig_carry is not None
+                               else (None, None, None))
         new_state = RiskModelState(
             nw_carry, vr_num, vr_den, state.sim_covs,
-            sim_length=state.sim_length,
+            sim_length=mirror,
             eigen_batch_hint=state.eigen_batch_hint,
             stamp=state.stamp,
             last_date=state.last_date if last_date is None else last_date,
@@ -490,6 +648,7 @@ class RiskModel:
             quarantine_count=state.quarantine_count,
             guard_ring=state.guard_ring,
             guard_ring_pos=state.guard_ring_pos,
+            eig_draws=eig_draws, eig_R=eig_R, eig_p=eig_p, eig_n=eig_n,
         )
         return outputs, new_state
 
@@ -542,12 +701,13 @@ class RiskModel:
                else jnp.asarray(pre_reasons, jnp.uint32))
         heal = (jnp.zeros((self.T,), bool) if heal_mask is None
                 else jnp.asarray(heal_mask, bool))
+        eig_draws, sweeps, mirror = self._advance_eigen_host(state)
         import warnings
 
         with warnings.catch_warnings():
             warnings.filterwarnings(
                 "ignore", message=".*[Dd]onat.*", category=UserWarning)
-            outputs, report, nw_carry, (vr_num, vr_den), guard = \
+            outputs, report, nw_carry, (vr_num, vr_den), guard, eig_carry = \
                 _fused_update_guarded_step(
                     self.ret, self.cap, self.styles, self.industry,
                     self.valid, state.sim_covs, state.nw_carry,
@@ -555,20 +715,26 @@ class RiskModel:
                     state.staleness, state.quarantine_count,
                     state.guard_ring, state.guard_ring_pos, pre, heal,
                     jnp.asarray(self.T, jnp.int32),
+                    eig_draws, state.eig_R, state.eig_p, state.eig_n,
                     n_industries=self.n_industries, config=self.config,
-                    sim_length=state.sim_length,
+                    sim_length=(None if self.config.eigen_incremental
+                                else state.sim_length),
                     eigen_batch_hint=state.eigen_batch_hint,
+                    eigen_sweeps=sweeps,
                 )
         last_good, staleness, q_count, ring, ring_pos = guard
+        eig_R, eig_p, eig_n = (eig_carry if eig_carry is not None
+                               else (None, None, None))
         new_state = RiskModelState(
             nw_carry, vr_num, vr_den, state.sim_covs,
-            sim_length=state.sim_length,
+            sim_length=mirror,
             eigen_batch_hint=state.eigen_batch_hint,
             stamp=state.stamp,
             last_date=state.last_date if last_date is None else last_date,
             last_good_cov=last_good, staleness=staleness,
             quarantine_count=q_count, guard_ring=ring,
             guard_ring_pos=ring_pos,
+            eig_draws=eig_draws, eig_R=eig_R, eig_p=eig_p, eig_n=eig_n,
         )
         return outputs, report, new_state
 
@@ -608,23 +774,36 @@ def _fused_risk_step(ret, cap, styles, industry, valid, sim_covs, *,
 # ``eigen_batch_hint`` is static because it gates solver dispatch
 # (ops/eigh.py) — it is frozen in the state at init, so the update step
 # compiles once per slab shape and never retraces as the history grows.
+# ``eigen_sweeps`` (config.eigen_incremental only) is the host-resolved
+# static Jacobi sweep cap — it moves only at the rare sim_sweeps_for tier
+# boundaries, so steady state stays at <= 1 compile.  The eigen raw-moment
+# carry (eig_R, eig_p, eig_n) is donated like the EWMA carries; eig_draws
+# is NOT (the host threads the frozen draw tensor into every next update,
+# like sim_covs).  All four are None pytrees outside incremental mode, so
+# their argnums donate nothing there.
 @functools.partial(
     jax.jit,
     static_argnames=("n_industries", "config", "sim_length",
-                     "eigen_batch_hint"),
-    donate_argnums=(0, 1, 2, 3, 4),
+                     "eigen_batch_hint", "eigen_sweeps"),
+    donate_argnums=(0, 1, 2, 3, 4, 7, 8, 9),
 )
-def _fused_init_step(ret, cap, styles, industry, valid, sim_covs, *,
-                     n_industries, config, sim_length, eigen_batch_hint):
+def _fused_init_step(ret, cap, styles, industry, valid, sim_covs,
+                     eig_draws, eig_R, eig_p, eig_n, *,
+                     n_industries, config, sim_length, eigen_batch_hint,
+                     eigen_sweeps=None):
     m = RiskModel(ret, cap, styles, industry, valid,
                   n_industries=n_industries, config=config)
+    eig_carry = None if eig_R is None else (eig_R, eig_p, eig_n)
     return m._run_carried(sim_covs, sim_length,
-                          eigen_batch_hint=eigen_batch_hint)
+                          eigen_batch_hint=eigen_batch_hint,
+                          eig_draws=eig_draws, eig_carry=eig_carry,
+                          eigen_sweeps=eigen_sweeps)
 
 
-# carries are donated too (argnums 6-8): XLA retires the old state's buffers
-# straight into the new state's.  sim_covs (argnum 5) is NOT donated — the
-# host keeps the reference and threads it unchanged into every next update.
+# carries are donated too (argnums 6-8, and the eigen moments 11-13): XLA
+# retires the old state's buffers straight into the new state's.  sim_covs
+# (argnum 5) and eig_draws (argnum 10) are NOT donated — the host keeps the
+# reference and threads it unchanged into every next update.
 # ``t_count`` (== T, the slab length) is a DEVICE operand, not static: its
 # only job is to make the scan trip counts dynamic so XLA cannot inline a
 # one-date loop body into the surrounding program (see
@@ -632,18 +811,22 @@ def _fused_init_step(ret, cap, styles, industry, valid, sim_covs, *,
 @functools.partial(
     jax.jit,
     static_argnames=("n_industries", "config", "sim_length",
-                     "eigen_batch_hint"),
-    donate_argnums=(0, 1, 2, 3, 4, 6, 7, 8),
+                     "eigen_batch_hint", "eigen_sweeps"),
+    donate_argnums=(0, 1, 2, 3, 4, 6, 7, 8, 11, 12, 13),
 )
 def _fused_update_step(ret, cap, styles, industry, valid, sim_covs,
-                       nw_carry, vr_num, vr_den, t_count, *,
-                       n_industries, config, sim_length, eigen_batch_hint):
+                       nw_carry, vr_num, vr_den, t_count,
+                       eig_draws, eig_R, eig_p, eig_n, *,
+                       n_industries, config, sim_length, eigen_batch_hint,
+                       eigen_sweeps=None):
     m = RiskModel(ret, cap, styles, industry, valid,
                   n_industries=n_industries, config=config)
+    eig_carry = None if eig_R is None else (eig_R, eig_p, eig_n)
     return m._run_carried(sim_covs, sim_length,
                           nw_carry=nw_carry, vr_carry=(vr_num, vr_den),
                           eigen_batch_hint=eigen_batch_hint,
-                          dyn_length=t_count)
+                          dyn_length=t_count, eig_draws=eig_draws,
+                          eig_carry=eig_carry, eigen_sweeps=eigen_sweeps)
 
 
 def _serve_degraded(vr_cov, eigen_valid, quarantined, last_good, staleness,
@@ -683,33 +866,39 @@ def _serve_degraded(vr_cov, eigen_valid, quarantined, last_good, staleness,
 # the guarded serving step: guards, the carried four stages with quarantined
 # dates excised, and the degraded-mode serving scan — still ONE compiled
 # program (the steady-state serving loop stays at <= 1 compile).  Donation
-# adds the guard-state operands (9-13); sim_covs (5), pre_reasons (14) and
-# heal_mask (15) stay host-owned.
+# adds the guard-state operands (9-13) and the eigen moments (18-20);
+# sim_covs (5), pre_reasons (14), heal_mask (15) and eig_draws (17) stay
+# host-owned.  Quarantined dates consume NO eigen draw column (the same
+# skip_mask that excises them from the EWMA carries), so the eigen carry
+# after (good, BAD, good) equals the carry after (good, good) bitwise.
 @functools.partial(
     jax.jit,
     static_argnames=("n_industries", "config", "sim_length",
-                     "eigen_batch_hint"),
-    donate_argnums=(0, 1, 2, 3, 4, 6, 7, 8, 9, 10, 11, 12, 13),
+                     "eigen_batch_hint", "eigen_sweeps"),
+    donate_argnums=(0, 1, 2, 3, 4, 6, 7, 8, 9, 10, 11, 12, 13, 18, 19, 20),
 )
 def _fused_update_guarded_step(ret, cap, styles, industry, valid, sim_covs,
                                nw_carry, vr_num, vr_den, last_good, staleness,
                                q_count, ring, ring_pos, pre_reasons, heal_mask,
-                               t_count, *, n_industries, config, sim_length,
-                               eigen_batch_hint):
+                               t_count, eig_draws, eig_R, eig_p, eig_n, *,
+                               n_industries, config, sim_length,
+                               eigen_batch_hint, eigen_sweeps=None):
     quarantined, reasons, ring, ring_pos = guard_slab(
         ret, cap, valid, ring, ring_pos, config.quarantine,
         pre_reasons=pre_reasons, heal_mask=heal_mask)
     m = RiskModel(ret, cap, styles, industry, valid,
                   n_industries=n_industries, config=config)
-    outputs, nw_carry_out, vr_carry_out = m._run_carried(
+    eig_carry = None if eig_R is None else (eig_R, eig_p, eig_n)
+    outputs, nw_carry_out, vr_carry_out, eig_carry_out = m._run_carried(
         sim_covs, sim_length,
         nw_carry=nw_carry, vr_carry=(vr_num, vr_den),
         eigen_batch_hint=eigen_batch_hint, dyn_length=t_count,
-        skip_mask=quarantined)
+        skip_mask=quarantined, eig_draws=eig_draws, eig_carry=eig_carry,
+        eigen_sweeps=eigen_sweeps)
     last_good, staleness, served, stale_series = _serve_degraded(
         outputs.vr_cov, outputs.eigen_valid, quarantined, last_good,
         staleness, t_count)
     q_count = q_count + jnp.sum(quarantined.astype(jnp.int32))
     report = GuardReport(quarantined, reasons, stale_series, served)
     return (outputs, report, nw_carry_out, vr_carry_out,
-            (last_good, staleness, q_count, ring, ring_pos))
+            (last_good, staleness, q_count, ring, ring_pos), eig_carry_out)

@@ -246,7 +246,10 @@ def jacobi_eigh_tpu(A: jax.Array, sweeps: int | None = None,
     slot order would silently mispair the per-direction biases.
     """
     B, n, _ = A.shape
-    assert n % 2 == 0, "pallas path requires even n"
+    if n % 2 != 0:
+        raise ValueError(
+            f"pallas path requires even n (Brent-Luk adjacent pairing), "
+            f"got n={n}; odd-n callers use mfm_tpu.ops.eigh.jacobi_eigh")
     dtype = A.dtype
     if sweeps is None:
         sweeps = _sweeps_for(n, dtype)
@@ -316,7 +319,11 @@ def jacobi_eigh_weighted_diag_tpu(A: jax.Array, d0: jax.Array,
     pass-overhead share of the kernel (``tools/kernel_ab.py``).
     """
     B, n, _ = A.shape
-    assert n % 2 == 0, "pallas path requires even n"
+    if n % 2 != 0:
+        raise ValueError(
+            f"pallas path requires even n (Brent-Luk adjacent pairing), "
+            f"got n={n}; odd-n callers use the XLA dispatch "
+            f"(mfm_tpu.ops.eigh.batched_eigh_weighted_diag)")
     assert d0.shape == (B, n), (d0.shape, (B, n))  # one weight vector per matrix
     if v_compose2 and not vt_rows:
         # the composed update builds vt in the rows layout; reducing it with

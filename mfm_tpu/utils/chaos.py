@@ -205,7 +205,7 @@ class FaultPlan:
                      # outlier_slab | universe_slab | flaky_store |
                      # query_kill | query_poison | query_overflow |
                      # query_swap | query_steady | scenario_kill |
-                     # scenario_poison | trace_kill
+                     # scenario_poison | trace_kill | eigen_kill
     seed: int = 0
     params: tuple = ()   # ((key, value), ...) — hashable, printable
 
@@ -261,4 +261,10 @@ def plan_suite(seed: int = 0) -> tuple:
         # and an untouched (bitwise) checkpoint (obs/trace.py)
         FaultPlan("trace-kill-mid-flush", "trace_kill", s + 18,
                   (("point", "trace.after_tmp"),)),
+        # incremental eigen (config.eigen_incremental): SIGKILL while the
+        # eigen-carry checkpoint (eig_R/eig_p/eig_n + frozen draws) is
+        # being saved — the prior generation must stay bitwise intact and
+        # the replay must land on the fault-free carry
+        FaultPlan("eigen-kill-mid-update", "eigen_kill", s + 19,
+                  (("point", "save_artifact.after_tmp"),)),
     )

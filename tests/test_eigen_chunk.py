@@ -94,6 +94,37 @@ def test_auto_chunk_matches_full_batch():
         assert jnp.array_equal(ok, outs[0][1])
 
 
+def test_auto_chunk_uses_mc_dtype_itemsize(monkeypatch):
+    # under eigen_mc_dtype the streamed G transient is assembled in the MC
+    # dtype, so "auto" must size the chunk from ITS itemsize (2 for bf16),
+    # not the compute dtype's — bf16 halves the per-date footprint, doubling
+    # the chunk.  Pin the headroom so the resolution is deterministic:
+    # budget = min(64MiB // 4, host cap) = 16MiB; per-date transient at
+    # M=64, K=32 is 64*32*32*itemsize*workspace_factor -> 1MiB (f32) /
+    # 0.5MiB (bf16), and T=64 dates overflow the budget either way.
+    from mfm_tpu.models import eigen as eigen_mod
+
+    monkeypatch.setattr(eigen_mod, "_memory_headroom_bytes",
+                        lambda backend: 64 * 1024 ** 2)
+    T, M = 64, 64
+    panels = (jnp.zeros((T, 4)), jnp.ones((T, 4)), jnp.zeros((T, 4, 3)),
+              jnp.zeros((T, 4), int), jnp.ones((T, 4), bool))
+    chunks = {}
+    for mc_dtype in (None, "bfloat16"):
+        cfg = RiskModelConfig(eigen_chunk="auto", eigen_n_sims=M,
+                              eigen_mc_dtype=mc_dtype)
+        rm = RiskModel(*panels, n_industries=28, config=cfg)  # K = 32
+        assert rm.K == 32
+        chunks[mc_dtype] = rm._resolve_eigen_chunk(M, itemsize=4)
+    assert chunks[None] == 16
+    assert chunks["bfloat16"] == 32
+    # the explicit-int and full-batch settings must ignore the MC dtype
+    cfg = RiskModelConfig(eigen_chunk=7, eigen_n_sims=M,
+                          eigen_mc_dtype="bfloat16")
+    rm = RiskModel(*panels, n_industries=28, config=cfg)
+    assert rm._resolve_eigen_chunk(M, itemsize=4) == 7
+
+
 def test_eigen_chunk_config_validation():
     for bad in (0, -3, True, 1.5, "sometimes"):
         with pytest.raises((ValueError, TypeError)):

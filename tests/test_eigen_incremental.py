@@ -1,0 +1,268 @@
+"""Incremental + mixed-precision eigen adjustment through the public
+RiskModel API (``config.eigen_incremental`` / ``config.eigen_mc_dtype``).
+
+Contracts pinned here:
+
+- **Bitwise suffix**: under ``eigen_incremental=True`` the daily serving
+  loop (init on a prefix + per-date / slab updates) reproduces the
+  full-history run BITWISE — outputs and the ``(eig_R, eig_p, eig_n)``
+  carry both, via ``assert_array_equal``, never a tolerance.  The contract
+  holds for the jitted production entry points (the only paths a serving
+  process runs); eager stage-by-stage replays may differ in fusion order
+  and are out of scope.
+- **Compile-once serving**: the steady-state one-date update reuses one
+  compiled signature — ``sim_length`` is a host-side mirror (aux data),
+  not a traced operand, so the growing history never retraces the step.
+- **Quarantine excision**: a quarantined date consumes no draw column and
+  leaves the eigen carry untouched, so (good, BAD, good) lands on the
+  same carry and post-BAD outputs as (good, good).
+- **Checkpoint round trip**: the eigen carry and the frozen draw tensor
+  (including bf16 draws, which numpy's npz cannot represent natively)
+  survive ``save_risk_state``/``load_risk_state`` bitwise.
+- **bf16 statistical parity**: the bfloat16 Monte-Carlo path is a
+  different random realization, so its gate is the USE4 eigenfactor bias
+  stat staying within the frozen budget in tools/parity_budget.json
+  (entry ``eigen_mc_bf16``), not bitwise equality.
+"""
+
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mfm_tpu.config import QuarantinePolicy, RiskModelConfig
+from mfm_tpu.data.artifacts import load_risk_state, save_risk_state
+from mfm_tpu.models.bias import eigenfactor_bias_stat
+from mfm_tpu.models.eigen import draw_bucket, simulated_eigen_draws
+from mfm_tpu.models.risk_model import RiskModel
+from mfm_tpu.utils.contracts import assert_max_compiles
+
+T, N, P, Q = 14, 24, 3, 2
+K = 1 + P + Q
+CFG = RiskModelConfig(eigen_n_sims=8, eigen_incremental=True)
+GCFG = RiskModelConfig(eigen_n_sims=8, eigen_incremental=True,
+                       quarantine=QuarantinePolicy(enabled=True))
+
+_BUDGET_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "parity_budget.json")
+
+
+def _panels(seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        (rng.standard_normal((T, N)) * 0.02).astype(np.float32),
+        rng.uniform(1.0, 5.0, (T, N)).astype(np.float32),
+        rng.standard_normal((T, N, Q)).astype(np.float32),
+        rng.integers(0, P, (T, N)).astype(np.int32),
+        rng.random((T, N)) > 0.1,
+    )
+
+
+def _model(panels, sl=slice(None), cfg=CFG):
+    # fresh owned arrays per call: the fused steps donate their inputs
+    return RiskModel(*(jnp.array(np.asarray(p)[sl]) for p in panels),
+                     n_industries=P, config=cfg)
+
+
+def _copy(state):
+    return jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), state)
+
+
+def _eig_carries(state):
+    return jax.tree_util.tree_leaves(
+        (state.nw_carry, state.vr_num, state.vr_den,
+         state.eig_R, state.eig_p, state.eig_n))
+
+
+def _assert_outputs_equal(got, want, msg):
+    for i, name in enumerate(want._fields):
+        np.testing.assert_array_equal(np.asarray(got[i]),
+                                      np.asarray(want[i]),
+                                      err_msg=f"{msg}: {name}")
+
+
+def _assert_carries_equal(a, b, msg):
+    for x, y in zip(_eig_carries(a), _eig_carries(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=msg)
+
+
+@pytest.fixture(scope="module")
+def panels():
+    return _panels()
+
+
+@pytest.fixture(scope="module")
+def full(panels):
+    return _model(panels).init_state()
+
+
+# T0 = 5 sits inside the t <= K invalid region (K = 6); 9 is a plain
+# mid-history cut; 13 forces the one-date (duplicated-lane) update path
+@pytest.mark.parametrize("T0", [5, 9, 13])
+def test_incremental_update_is_bitwise_suffix_of_full_run(panels, full, T0):
+    full_out, full_state = full
+    assert full_state.sim_covs is None          # incremental carries moments,
+    assert full_state.eig_R is not None         # not materialized sim covs
+    out0, st = _model(panels, slice(0, T0)).init_state()
+    _assert_outputs_equal(
+        out0, jax.tree_util.tree_map(lambda x: x[:T0], full_out),
+        f"T0={T0} prefix")
+
+    st_seq = _copy(st)
+    o, st_seq = _model(panels, slice(T0, T0 + 1)).update(st_seq)
+    rows = [o]
+    with assert_max_compiles(1, what="incremental daily update loop"):
+        for t in range(T0 + 1, T):
+            o, st_seq = _model(panels, slice(t, t + 1)).update(st_seq)
+            rows.append(o)
+    got = type(full_out)(*[
+        np.concatenate([np.asarray(r[i]) for r in rows], axis=0)
+        for i in range(len(full_out))])
+    _assert_outputs_equal(
+        got, jax.tree_util.tree_map(lambda x: x[T0:], full_out),
+        f"T0={T0} sequential suffix")
+
+    # the whole remainder as ONE slab
+    o_slab, st_slab = _model(panels, slice(T0, T)).update(st)
+    _assert_outputs_equal(
+        o_slab, jax.tree_util.tree_map(lambda x: x[T0:], full_out),
+        f"T0={T0} slab suffix")
+
+    _assert_carries_equal(st_seq, st_slab, f"T0={T0} seq-vs-slab eig carry")
+    _assert_carries_equal(st_slab, full_state,
+                          f"T0={T0} slab-vs-full eig carry")
+    # the host mirror tracks the consumed history length
+    assert st_seq.sim_length == T == full_state.sim_length
+
+
+def test_incremental_guarded_excision_is_bitwise(panels):
+    """(good, BAD, good) == (good, good) on the eigen carry and every
+    post-BAD output: the quarantined date consumes no draw column."""
+    T0 = 8
+
+    def gmodel(sl, override=None):
+        ps = [np.asarray(p)[sl] for p in panels]
+        if override is not None:
+            ps[0] = override
+        return _model(ps, cfg=GCFG)
+
+    _, stA = gmodel(slice(0, T0)).init_state()
+    stB = _copy(stA)
+    oA1, _, stA = gmodel(slice(T0, T0 + 1)).update_guarded(stA)
+    oB1, _, stB = gmodel(slice(T0, T0 + 1)).update_guarded(stB)
+
+    # path B serves a poisoned date in between
+    bad_ret = np.full((1, N), np.nan, np.float32)
+    _, repB, stB = gmodel(slice(T0, T0 + 1), bad_ret).update_guarded(stB)
+    assert bool(np.asarray(repB.quarantined)[0])
+
+    oA2, _, stA = gmodel(slice(T0 + 1, T0 + 2)).update_guarded(stA)
+    oB2, _, stB = gmodel(slice(T0 + 1, T0 + 2)).update_guarded(stB)
+
+    for f in ("eig_R", "eig_p", "eig_n"):
+        np.testing.assert_array_equal(np.asarray(getattr(stA, f)),
+                                      np.asarray(getattr(stB, f)),
+                                      err_msg=f"excision: {f}")
+    _assert_outputs_equal(oB2, oA2, "post-quarantine output")
+    # the mirror is an upper bound (counts the served, quarantined date)
+    assert stB.sim_length == stA.sim_length + 1
+
+
+@pytest.mark.parametrize("mc_dtype", [None, "bfloat16"])
+def test_incremental_state_npz_roundtrip_is_bitwise(panels, tmp_path,
+                                                    mc_dtype):
+    """The eigen carry AND the frozen draw tensor survive the checkpoint
+    bitwise — including bf16 draws, which npz stores as a uint16
+    bit-pattern view plus the dtype name in the meta."""
+    cfg = RiskModelConfig(eigen_n_sims=8, eigen_incremental=True,
+                          eigen_mc_dtype=mc_dtype)
+    T0 = 9
+    _, st = _model(panels, slice(0, T0), cfg=cfg).init_state()
+    if mc_dtype:
+        assert st.eig_draws.dtype == jnp.dtype(mc_dtype)
+    p = str(tmp_path / "state.npz")
+    save_risk_state(p, _copy(st), meta={"note": "inc"})
+    loaded, meta = load_risk_state(p)
+    assert meta["kind"] == "risk_state"
+    assert loaded.stamp == st.stamp
+    assert loaded.sim_length == st.sim_length
+    assert loaded.sim_covs is None and st.sim_covs is None
+    assert loaded.eig_draws.dtype == st.eig_draws.dtype
+    np.testing.assert_array_equal(np.asarray(loaded.eig_draws),
+                                  np.asarray(st.eig_draws))
+    _assert_carries_equal(loaded, st, "roundtrip eig carry")
+
+    o_mem, st_mem = _model(panels, slice(T0, T), cfg=cfg).update(st)
+    o_disk, st_disk = _model(panels, slice(T0, T), cfg=cfg).update(loaded)
+    _assert_outputs_equal(o_disk, o_mem, "disk-vs-memory update")
+    _assert_carries_equal(st_disk, st_mem, "disk-vs-memory eig carry")
+
+
+def test_draw_bucket_prefix_stability():
+    """A bucket rollover extends the draw tensor without rewriting the
+    consumed prefix — the property the bitwise-suffix contract stands on."""
+    assert draw_bucket(1) == 64 and draw_bucket(64) == 64
+    assert draw_bucket(65) == 128 and draw_bucket(1390) == 2048
+    key = jax.random.key(0)
+    d64 = simulated_eigen_draws(key, K, 64, 8, dtype=jnp.float32)
+    d128 = simulated_eigen_draws(key, K, 128, 8, dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(d128[..., :64]),
+                                  np.asarray(d64))
+    # and the bf16 tensor holds the same property in its own realization
+    b64 = simulated_eigen_draws(key, K, 64, 8, dtype=jnp.float32,
+                                mc_dtype="bfloat16")
+    b128 = simulated_eigen_draws(key, K, 128, 8, dtype=jnp.float32,
+                                 mc_dtype="bfloat16")
+    assert b64.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(b128[..., :64]),
+                                  np.asarray(b64))
+
+
+def test_bf16_parity_within_budget():
+    """The bfloat16 Monte-Carlo path must keep the USE4 eigenfactor bias
+    stat within the frozen budget (tools/parity_budget.json:
+    ``eigen_mc_bf16``) at the budget's own documented shape and seed."""
+    with open(_BUDGET_PATH) as fh:
+        entry = json.load(fh)["eigen_mc_bf16"]
+    shp = entry["shape"]
+    Tb, Nb = shp["T"], shp["N"]
+    Pb, Qb, Mb = shp["n_industries"], shp["n_styles"], shp["n_sims"]
+    rng = np.random.default_rng(entry["seed"])
+    panels = (
+        (rng.standard_normal((Tb, Nb)) * 0.02).astype(np.float32),
+        rng.uniform(1.0, 5.0, (Tb, Nb)).astype(np.float32),
+        rng.standard_normal((Tb, Nb, Qb)).astype(np.float32),
+        rng.integers(0, Pb, (Tb, Nb)).astype(np.int32),
+        rng.uniform(size=(Tb, Nb)) > 0.05,
+    )
+    stats = {}
+    for mc in (None, "bfloat16"):
+        cfg = RiskModelConfig(eigen_n_sims=Mb, eigen_sim_length=Tb,
+                              eigen_mc_dtype=mc)
+        out = RiskModel(*(jnp.array(p) for p in panels),
+                        n_industries=Pb, config=cfg).run()
+        stats[mc] = np.asarray(eigenfactor_bias_stat(
+            out.eigen_cov, out.eigen_valid, out.factor_ret))
+    delta = np.max(np.abs(np.abs(stats["bfloat16"] - 1.0)
+                          - np.abs(stats[None] - 1.0)))
+    assert delta <= entry["bias_abs_delta"], (
+        f"bf16 bias-stat delta {delta:.4f} exceeds the frozen budget "
+        f"{entry['bias_abs_delta']} — the mixed-precision path regressed")
+
+
+def test_incremental_config_and_injection_validation(panels):
+    with pytest.raises(ValueError, match="bfloat16"):
+        RiskModelConfig(eigen_mc_dtype="float16")
+    # pinned sim_length contradicts the growing-panel semantics
+    with pytest.raises(ValueError, match="eigen_incremental"):
+        RiskModelConfig(eigen_incremental=True, eigen_sim_length=48)
+    # injected randomness would break the bitwise-suffix contract
+    with pytest.raises(ValueError, match="injected key/sim_covs"):
+        _model(panels).init_state(key=jax.random.key(3))
+    with pytest.raises(ValueError, match="injected key/sim_covs"):
+        _model(panels).init_state(
+            sim_covs=jnp.zeros((8, K, K), jnp.float32))

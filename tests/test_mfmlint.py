@@ -137,6 +137,46 @@ def test_r2_fori_loop_bounds(tmp_path):
     assert all(v.qualname == "bad" for v in res.new)
 
 
+def test_r2_eigen_carry_date_step_shape(tmp_path):
+    """Fixture shaped like the incremental eigen date step
+    (models/eigen.py::eigen_risk_adjust_incremental): a fori_loop that
+    consumes one draw column per date from a carried (R, p, n) triple.
+    The hazards R2 exists for — an s64 loop bound from bare Python ints
+    and an unpinned arange over the chunk axis — must be flagged in the
+    carry-step shape, while the production-shaped form stays clean."""
+    res = _lint(tmp_path, {"mod.py": """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def bad_carry(covs, draws, R, p, n):
+            def date_step(t, carry):
+                R, p, n = carry
+                x = jax.lax.dynamic_index_in_dim(draws, n, axis=-1,
+                                                 keepdims=False)
+                return R + x[..., None] * x[..., None, :], p + x, n + 1
+            order = jnp.arange(covs.shape[0])      # R2: unpinned iota
+            R, p, n = jax.lax.fori_loop(0, 8 * 4, date_step, (R, p, n))
+            return R, p, n, order
+
+        @jax.jit
+        def good_carry(covs, draws, R, p, n):
+            def date_step(t, carry):
+                R, p, n = carry
+                x = jax.lax.dynamic_index_in_dim(draws, n, axis=-1,
+                                                 keepdims=False)
+                return R + x[..., None] * x[..., None, :], p + x, n + 1
+            order = jnp.arange(covs.shape[0], dtype=jnp.int32)
+            hi = jnp.int32(covs.shape[0])
+            R, p, n = jax.lax.fori_loop(jnp.int32(0), hi, date_step,
+                                        (R, p, n))
+            return R, p, n, order
+    """})
+    assert all(v.rule == "R2" for v in res.new)
+    assert res.new, "R2 missed the s64 hazards in the carry-step shape"
+    assert all(v.qualname.startswith("bad_carry") for v in res.new)
+
+
 def test_r3_config_update_placement_and_duplicates(tmp_path):
     res = _lint(tmp_path, {
         "mfm_tpu/deep/worker.py": """
@@ -216,6 +256,33 @@ def test_r5_unforced_timing_span_in_tools(tmp_path):
     res = _lint(tmp_path, files)
     assert [(v.rule, v.qualname) for v in res.new] == [("R5", "unforced")]
     assert "bench_like" in res.new[0].file
+
+
+def test_r5_eigen_sweep_cell_timing(tmp_path):
+    """Fixture shaped like a tools/profile_eigen.py sweep cell: a wall
+    measured around a jitted eigen-stage call.  An unforced span (the jit
+    call dispatches and returns before the work runs) must be flagged;
+    the production shape — forcing through a host conversion before
+    reading the clock — must stay clean."""
+    res = _lint(tmp_path, {"tools/sweep_like.py": """
+        import time
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        def unforced_cell(stage, covs, valid, sim_covs):
+            t0 = time.perf_counter()
+            out = jax.jit(stage)(covs, valid, sim_covs)  # R5: dispatch only
+            return time.perf_counter() - t0, out
+
+        def forced_cell(stage, covs, valid, sim_covs):
+            t0 = time.perf_counter()
+            out = float(np.asarray(jnp.nansum(jax.jit(stage)(
+                covs, valid, sim_covs))))
+            return time.perf_counter() - t0, out
+    """})
+    assert [(v.rule, v.qualname) for v in res.new] == \
+        [("R5", "unforced_cell")]
 
 
 def test_r6_partition_spec_axes(tmp_path):

@@ -42,6 +42,16 @@ python bench.py --config alpha   "${plat[@]}" | tail -1 > "$out/config5_alpha.js
 python bench.py --config query   "${plat[@]}" | tail -1 > "$out/config6_query.json"
 python bench.py --config scenario "${plat[@]}" | tail -1 > "$out/config7_scenario.json"
 
+# eigen-stage evidence sweep (tools/profile_eigen.py --json): the
+# chunk x batch_hint x dtype grid with XLA cost analysis per cell — the
+# committed EIGEN_SWEEP_r*.json files are snapshots of this output, and a
+# dispatch change in ops/eigh.py should cite a cell from a fresh run
+python tools/profile_eigen.py --json "$out/eigen_sweep.json" \
+  --t 256 --sims 50 --chunks 64,128,none --batch-hints auto,init \
+  --dtypes f32,bf16 \
+  || { echo "eigen sweep failed — kernel-path evidence incomplete" >&2
+       exit 1; }
+
 # perf-regression sentinel: gate the fresh records against the committed
 # BENCH_r*.json trajectory (tools/perfgate.py; per-metric tolerance bands,
 # same-backend baselines only).  A regression fails the sweep — slower
@@ -58,9 +68,11 @@ done
 # recovery, dead-letter quarantine, shed ordering, breaker-on-corrupt-swap,
 # the <=1-compile-per-bucket steady state, scenario-manifest crash
 # atomicity, per-lane poison isolation, and trace-flush crash atomicity —
-# a SIGKILL mid trace.json flush must tear neither trace nor checkpoint)
+# a SIGKILL mid trace.json flush must tear neither trace nor checkpoint),
+# plus the incremental-eigen carry: a SIGKILL mid eigen-carry checkpoint
+# save must leave the prior state bitwise-intact and doctor-green
 python tools/faultinject.py --plans \
-  query-kill-mid-batch,query-poison-slab,query-overflow-storm,query-ckpt-swap,query-steady-state,scenario-kill-mid-batch,scenario-poison-spec,trace-kill-mid-flush \
+  query-kill-mid-batch,query-poison-slab,query-overflow-storm,query-ckpt-swap,query-steady-state,scenario-kill-mid-batch,scenario-poison-spec,trace-kill-mid-flush,eigen-kill-mid-update \
   || { echo "query/scenario/trace chaos plans failed — config6/7 numbers are not evidence" >&2
        exit 1; }
 

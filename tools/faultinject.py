@@ -29,6 +29,11 @@ recovery contracts the production loop promises (docs/SERVING.md):
   loads clean and replays bitwise, no torn ``run_manifest.json`` is left
   behind, and the next healthy run writes a manifest ``mfm-tpu doctor``
   accepts.
+- **Crash mid eigen-carry save** (eigen-kill-mid-update): the incremental
+  eigen state (``config.eigen_incremental``: prefix moments + frozen
+  draws) rides the same fenced npz — SIGKILL after the tmp write leaves
+  the prior generation byte-identical, the reloaded eigen carry bitwise,
+  the replay bitwise the fault-free run, and the directory doctor-green.
 - **Steady state**: after warmup, the per-date guarded serving loop stays
   within ONE jit compile (``assert_max_compiles``).
 - **Query-service faults** (query-*): the request side of the stack
@@ -108,9 +113,12 @@ def _carries(state):
 
     # copy=True: on CPU the numpy conversion can alias the device buffer,
     # and these snapshots must outlive the donating update calls that
-    # recycle it
+    # recycle it.  The eigen-carry leaves are None outside
+    # config.eigen_incremental and flatten to nothing, so non-incremental
+    # plans see the same three carries as before
     return [np.array(x, copy=True) for x in jax.tree_util.tree_leaves(
-        (state.nw_carry, state.vr_num, state.vr_den))]
+        (state.nw_carry, state.vr_num, state.vr_den,
+         state.eig_R, state.eig_p, state.eig_n))]
 
 
 def _outputs_by_date(res):
@@ -296,6 +304,104 @@ def run_kill(plan, base: Baseline, root: str) -> dict:
                               base.slab_dates[1], plan.name)
         healed = True
     return {"killed_at": point, "pointer": ptr, "pointer_healed": healed}
+
+
+def run_eigen_kill(plan, base: Baseline, root: str) -> dict:
+    """eigen-kill-mid-update: SIGKILL between the checkpoint's tmp write and
+    its rename while the state carries the INCREMENTAL eigen leaves
+    (config.eigen_incremental=True: eig_R/eig_p/eig_n prefix moments + the
+    frozen draw tensor).  The carry rides the same fenced npz as every
+    other leaf, so the crash must leave the prior generation byte-identical
+    on disk, the fenced load must hand back the same eigen carry bitwise,
+    the replay must land on the fault-free outputs AND eigen carry bitwise,
+    and a post-crash CLI update must leave a doctor-green directory."""
+    import dataclasses
+
+    from mfm_tpu.data.artifacts import load_risk_state
+
+    point = plan.param("point")
+    d = os.path.join(root, plan.name)
+    os.makedirs(d)
+    icfg = dataclasses.replace(base.cfg, risk=dataclasses.replace(
+        base.cfg.risk, eigen_sim_length=None, eigen_incremental=True))
+    path = _init_checkpoint(d, base.hist, icfg)
+    state0, _ = load_risk_state(path)
+    if state0.eig_R is None or state0.eig_draws is None:
+        raise AssertionError(f"{plan.name}: history checkpoint carries no "
+                             "eigen carry — eigen_incremental did not engage")
+    eig0 = [np.array(x, copy=True) for x in
+            (state0.eig_R, state0.eig_p, state0.eig_n)]
+
+    # fault-free reference for slab 0, then rewind to the history snapshot
+    snap = _snapshot(d, "hist")
+    ref = _append(path, base.slabs[0], icfg)
+    ref_outputs = _outputs_by_date(ref)
+    ref_carries = _carries(ref.state)
+    _restore(d, snap)
+    with open(path, "rb") as fh:
+        pre_bytes = fh.read()
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo_root}
+
+    def _update_cmd(slab_csv, table):
+        table.to_csv(slab_csv, index=False)
+        return [sys.executable, "-m", "mfm_tpu.cli", "risk",
+                "--barra", slab_csv, "--update", path, "--quarantine",
+                "--eigen-sims", str(EIGEN_SIMS), "--eigen-incremental",
+                "--out", os.path.join(d, "tables")]
+
+    cmd = _update_cmd(os.path.join(d, "slab0.csv"), base.slabs[0])
+    proc = subprocess.run(cmd, env={**env, "MFM_CHAOS_KILL": point},
+                          capture_output=True, text=True, timeout=600)
+    if proc.returncode != -signal.SIGKILL:
+        raise AssertionError(
+            f"{plan.name}: expected the subprocess to die by SIGKILL at "
+            f"{point}, got rc={proc.returncode}\n{proc.stderr[-2000:]}")
+
+    # prior generation byte-identical on disk — the tmp write touched
+    # nothing but its own tmp file
+    with open(path, "rb") as fh:
+        post_bytes = fh.read()
+    if post_bytes != pre_bytes:
+        raise AssertionError(f"{plan.name}: the checkpoint bytes changed "
+                             "under a write that never renamed")
+    state, meta = load_risk_state(path)  # fenced: must load clean
+    if meta["last_date"] != str(base.hist["date"].max()):
+        raise AssertionError(f"{plan.name}: checkpoint advanced past a "
+                             "write that never completed")
+    for got, want, name in zip((state.eig_R, state.eig_p, state.eig_n),
+                               eig0, ("eig_R", "eig_p", "eig_n")):
+        if np.asarray(got).tobytes() != want.tobytes():
+            raise AssertionError(f"{plan.name}: reloaded eigen carry leaf "
+                                 f"{name} is not bitwise the pre-crash one")
+
+    # replay: bitwise the fault-free run, eigen carry included (_carries
+    # picks up the eig leaves under eigen_incremental)
+    res = _append(path, base.slabs[0], icfg)
+    _assert_outputs_equal(_outputs_by_date(res), ref_outputs,
+                          base.slab_dates[0], plan.name)
+    _assert_carries_equal(_carries(res.state), ref_carries, plan.name)
+
+    # the next slab through the real CLI must succeed and leave a
+    # doctor-green directory (manifest + fenced checkpoint)
+    cmd2 = _update_cmd(os.path.join(d, "slab1.csv"), base.slabs[1])
+    proc2 = subprocess.run(cmd2, env=env, capture_output=True, text=True,
+                           timeout=600)
+    if proc2.returncode != 0:
+        raise AssertionError(f"{plan.name}: post-crash update failed "
+                             f"rc={proc2.returncode}\n{proc2.stderr[-2000:]}")
+    _, meta2 = load_risk_state(path)
+    if meta2["last_date"] != base.slab_dates[1][-1]:
+        raise AssertionError(f"{plan.name}: post-crash CLI update did not "
+                             "carry the appended dates")
+    doc = subprocess.run([sys.executable, "-m", "mfm_tpu.cli", "doctor", d],
+                         env=env, capture_output=True, text=True, timeout=600)
+    if doc.returncode != 0:
+        raise AssertionError(f"{plan.name}: doctor rejects the post-crash "
+                             f"state\n{doc.stdout[-2000:]}")
+    return {"killed_at": point, "prior_state": "byte-identical",
+            "replay": "bitwise", "doctor": "green"}
 
 
 def run_kill_manifest(plan, base: Baseline, root: str) -> dict:
@@ -1040,7 +1146,7 @@ RUNNERS = {"truncate": run_byte_fault, "corrupt": run_byte_fault,
            "query_steady": run_query_steady,
            "scenario_kill": run_scenario_kill,
            "scenario_poison": run_scenario_poison,
-           "trace_kill": run_trace_kill}
+           "trace_kill": run_trace_kill, "eigen_kill": run_eigen_kill}
 
 
 def main(argv=None) -> int:

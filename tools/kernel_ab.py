@@ -45,32 +45,70 @@ def t3(fn, *a):
 
 
 # --- weighted kernel V layout A/B (the eigen stage's dominant cost) ---
+on_tpu = jax.default_backend() == "tpu"
 K, B, sweeps = 42, 1390 * 100, 4
+if not on_tpu:
+    B = 1390  # CPU records the XLA side + interpret parity only (below)
 X = jax.random.normal(jax.random.key(0), (B, 64, K), jnp.float32)
 A = jnp.einsum("bnk,bnl->bkl", X, X) / 64
 d0 = jnp.abs(jax.random.normal(jax.random.key(1), (B, K), jnp.float32))
 
-for vt, comp in ((False, False), (True, False), (True, True)):
-    f = jax.jit(lambda A, d0, vt=vt, comp=comp: sum(map(jnp.sum,
-        jacobi_eigh_weighted_diag_tpu(A, d0, sweeps=sweeps, vt_rows=vt,
-                                      v_compose2=comp))))
-    print(f"weighted kernel vt_rows={vt} v_compose2={comp}: "
-          f"{t3(f, A, d0):.4f} s", flush=True)
+if on_tpu:
+    for vt, comp in ((False, False), (True, False), (True, True)):
+        f = jax.jit(lambda A, d0, vt=vt, comp=comp: sum(map(jnp.sum,
+            jacobi_eigh_weighted_diag_tpu(A, d0, sweeps=sweeps, vt_rows=vt,
+                                          v_compose2=comp))))
+        print(f"weighted kernel vt_rows={vt} v_compose2={comp}: "
+              f"{t3(f, A, d0):.4f} s", flush=True)
+
+# --- Pallas kernel vs XLA dispatch for the same weighted-diag consumer ---
+# This is the dispatch decision ops/eigh.py::batched_eigh_weighted_diag
+# makes per backend; record both sides wherever this script runs.  On CPU
+# the Pallas kernel only exists in interpret mode (orders of magnitude
+# slower — record it for the parity evidence, never as a timing), so the
+# CPU A/B times the XLA path against the pure-JAX Brent-Luk Jacobi batch,
+# the same algorithm the Pallas kernel implements.
+from mfm_tpu.ops.eigh import batched_eigh_weighted_diag  # noqa: E402
+
+ab_B = B if on_tpu else 1390  # CPU: one date block keeps the A/B minutes-free
+Aab, dab = A[:ab_B], d0[:ab_B]
+fx = jax.jit(lambda A, d0: sum(map(jnp.sum, batched_eigh_weighted_diag(
+    A, d0, sweeps=sweeps))))
+print(f"weighted diag XLA dispatch  (B={ab_B}): {t3(fx, Aab, dab):.4f} s",
+      flush=True)
+if on_tpu:
+    fp = jax.jit(lambda A, d0: sum(map(jnp.sum, batched_eigh_weighted_diag(
+        A, d0, sweeps=sweeps, prefer_pallas=True))))
+    print(f"weighted diag Pallas kernel (B={ab_B}): {t3(fp, Aab, dab):.4f} s",
+          flush=True)
+else:
+    few = slice(0, 8)  # interpret mode: parity evidence only
+    wx, hx = batched_eigh_weighted_diag(Aab[few], dab[few], sweeps=sweeps)
+    wi, hi = jacobi_eigh_weighted_diag_tpu(Aab[few], dab[few], sweeps=sweeps,
+                                           interpret=True)
+    order = jnp.argsort(wi, axis=-1)
+    wi = jnp.take_along_axis(wi, order, axis=-1)
+    hi = jnp.take_along_axis(hi, order, axis=-1)
+    rel = max(float(jnp.max(jnp.abs(a - b)) / (jnp.max(jnp.abs(a)) + 1e-30))
+              for a, b in ((wx, wi), (hx, hi)))
+    print(f"weighted diag Pallas interpret-mode parity vs XLA: "
+          f"max_rel={rel:.3e} (timing not meaningful off-TPU)", flush=True)
 
 # hardware equality gate for v_compose2 (interpret-mode pins don't bind
 # Mosaic's schedule): the fused restack must match the two-pass variant on
 # THIS backend before it may become the default
-small = slice(0, 1390)  # one date-block is plenty for an equality verdict
-f2 = jax.jit(lambda A, d0, comp: jacobi_eigh_weighted_diag_tpu(
-    A, d0, sweeps=sweeps, vt_rows=True, v_compose2=comp),
-    static_argnums=2)
-ref_out = f2(A[small], d0[small], False)
-new_out = f2(A[small], d0[small], True)
-worst = max(float(jnp.max(jnp.abs(r - n)) / (jnp.max(jnp.abs(r)) + 1e-30))
-            for r, n in zip(ref_out, new_out))
-print(f"v_compose2 hardware equality vs two-pass: max_rel={worst:.3e} "
-      f"({'OK (promotable)' if worst < 1e-5 else 'MISMATCH — do not promote'})",
-      flush=True)
+if on_tpu:
+    small = slice(0, 1390)  # one date-block is plenty for an equality verdict
+    f2 = jax.jit(lambda A, d0, comp: jacobi_eigh_weighted_diag_tpu(
+        A, d0, sweeps=sweeps, vt_rows=True, v_compose2=comp),
+        static_argnums=2)
+    ref_out = f2(A[small], d0[small], False)
+    new_out = f2(A[small], d0[small], True)
+    worst = max(float(jnp.max(jnp.abs(r - n)) / (jnp.max(jnp.abs(r)) + 1e-30))
+                for r, n in zip(ref_out, new_out))
+    print(f"v_compose2 hardware equality vs two-pass: max_rel={worst:.3e} "
+          f"({'OK (promotable)' if worst < 1e-5 else 'MISMATCH'})",
+          flush=True)
 
 # --- Newey-West: serial scan vs associative (sequence-parallel) ---
 # single-chip A/B: the associative form's O(log T) depth trades more total

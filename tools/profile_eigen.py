@@ -1,63 +1,218 @@
-"""Split the eigen stage's wall into its internal parts on the current backend."""
+"""Profile the eigen stage: stage-split timings and a reproducible
+chunk x batch_hint x dtype sweep.
+
+Two modes:
+
+  python tools/profile_eigen.py
+      the original ad-hoc stage split (f0 eigh / G assembly / simulated
+      eigh / full stage) at the CSI300 shape on the current backend.
+
+  python tools/profile_eigen.py --json EIGEN_SWEEP.json \
+      --t 256 --sims 40 --chunks 32,64,none --batch-hints auto \
+      --dtypes f32,bf16
+      sweep the full eigen stage over date-chunk sizes, solver
+      batch_hints and Monte-Carlo dtypes; each cell records the measured
+      wall, the compiled program's cost analysis
+      (mfm_tpu.obs.profile.compiled_cost: flops / bytes accessed) and the
+      derived GFLOP/s, into a JSON document bench_all.sh checks in as
+      EIGEN_SWEEP_r*.json.  The sweep is the evidence base for dispatch
+      changes in ops/eigh.py — a claim like "sweep-count overshoot" or
+      "chunk X beats chunk Y" should cite a sweep cell, not a hunch.
+
+The per-cell record is self-describing (shape, dtype, backend, sweeps),
+so sweeps from different hosts/backends are comparable side by side.
+"""
+import argparse
+import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
 
-from mfm_tpu.models.eigen import simulated_eigen_covs, sim_sweeps_for
-from mfm_tpu.ops.eigh import batched_eigh, batched_eigh_weighted_diag, _sweeps_for
-
-T, N, K, M = 1390, 300, 42, 100
-dtype = jnp.float32
-key = jax.random.key(0)
-X = jax.random.normal(key, (T, 200, K), dtype)
-covs = jnp.einsum("tnk,tnl->tkl", X, X) / 200
-valid = jnp.ones((T,), bool)
-sim_covs = simulated_eigen_covs(jax.random.key(1), K, T, M, dtype)
-sweeps = sim_sweeps_for(K, dtype, T)
-print("sim sweeps:", sweeps, "full:", _sweeps_for(K, dtype))
+from mfm_tpu.models.eigen import (
+    eigen_risk_adjust_by_time,
+    sim_sweeps_for,
+    simulated_eigen_covs,
+)
+from mfm_tpu.ops.eigh import _sweeps_for, batched_eigh, batched_eigh_weighted_diag
 
 
-# bench.py owns the tunnel-aware timing helpers (block_until_ready does not
-# block on this TPU tunnel; timings must force a scalar host transfer)
-from bench import _force as force, _time3 as t3  # noqa: E402
+def _force(x):
+    return float(np.asarray(jnp.nansum(x)))
 
 
-@jax.jit
-def f0_eigh(c):
-    D0, U0 = batched_eigh(c)
-    return jnp.sum(D0) + jnp.sum(U0)
+def _t3(fn, *a, repeats=3):
+    _force(fn(*a))  # compile + warm
+    ts = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        _force(fn(*a))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
 
 
-@jax.jit
-def g_form(c, sc):
-    D0, U0 = batched_eigh(c)
-    s = jnp.sqrt(jnp.maximum(D0, 0.0))
-    G = s[:, None, :, None] * sc[None] * s[:, None, None, :]
-    return jnp.sum(G)
+def _panel(T, K, dtype):
+    X = jax.random.normal(jax.random.key(0), (T, 200, K), dtype)
+    covs = jnp.einsum("tnk,tnl->tkl", X, X) / 200
+    return covs, jnp.ones((T,), bool)
 
 
-@jax.jit
-def sim_eigh(c, sc):
-    # the production consumer shape: fused (Dm, Dm_hat), no W materialized
-    D0, U0 = batched_eigh(c)
-    s = jnp.sqrt(jnp.maximum(D0, 0.0))
-    G = s[:, None, :, None] * sc[None] * s[:, None, None, :]
-    Dm, Dm_hat = batched_eigh_weighted_diag(G, D0[:, None, :], sweeps=sweeps)
-    return jnp.sum(Dm) + jnp.sum(Dm_hat)
+def stage_split(args):
+    """The original ad-hoc breakdown, kept as the default mode."""
+    T, K, M = args.t, args.k, args.sims
+    dtype = jnp.float32
+    covs, valid = _panel(T, K, dtype)
+    sim_covs = simulated_eigen_covs(jax.random.key(1), K, T, M, dtype)
+    sweeps = sim_sweeps_for(K, dtype, T)
+    print("sim sweeps:", sweeps, "full:", _sweeps_for(K, dtype))
+
+    @jax.jit
+    def f0_eigh(c):
+        D0, U0 = batched_eigh(c)
+        return jnp.sum(D0) + jnp.sum(U0)
+
+    @jax.jit
+    def g_form(c, sc):
+        D0, U0 = batched_eigh(c)
+        s = jnp.sqrt(jnp.maximum(D0, 0.0))
+        G = s[:, None, :, None] * sc[None] * s[:, None, None, :]
+        return jnp.sum(G)
+
+    @jax.jit
+    def sim_eigh(c, sc):
+        D0, U0 = batched_eigh(c)
+        s = jnp.sqrt(jnp.maximum(D0, 0.0))
+        G = s[:, None, :, None] * sc[None] * s[:, None, None, :]
+        Dm, Dm_hat = batched_eigh_weighted_diag(G, D0[:, None, :],
+                                                sweeps=sweeps)
+        return jnp.sum(Dm) + jnp.sum(Dm_hat)
+
+    @jax.jit
+    def full(c, v, sc):
+        out, ok = eigen_risk_adjust_by_time(c, v, sc, sim_length=T)
+        return jnp.sum(jnp.where(jnp.isfinite(out), out, 0.0))
+
+    print("f0_eigh        :", round(_t3(f0_eigh, covs), 4))
+    print("  +G_form      :", round(_t3(g_form, covs, sim_covs), 4))
+    print("  +sim_eigh    :", round(_t3(sim_eigh, covs, sim_covs), 4))
+    print("full stage     :", round(_t3(full, covs, valid, sim_covs), 4))
 
 
-@jax.jit
-def full(c, v, sc):
-    from mfm_tpu.models.eigen import eigen_risk_adjust_by_time
-    out, ok = eigen_risk_adjust_by_time(c, v, sc, sim_length=T)
-    return jnp.sum(jnp.where(jnp.isfinite(out), out, 0.0))
+def _parse_chunks(spec, T):
+    out = []
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if tok in ("none", "full"):
+            out.append(None)
+        else:
+            out.append(min(int(tok), T))
+    # dedup preserving order (min() above can collapse entries)
+    seen, uniq = set(), []
+    for c in out:
+        if c not in seen:
+            seen.add(c)
+            uniq.append(c)
+    return uniq
 
 
-print("f0_eigh        :", round(t3(f0_eigh, covs), 4))
-print("  +G_form      :", round(t3(g_form, covs, sim_covs), 4))
-print("  +sim_eigh    :", round(t3(sim_eigh, covs, sim_covs), 4))
-print("full stage     :", round(t3(full, covs, valid, sim_covs), 4))
+def _parse_hints(spec, T, M):
+    out = []
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if tok == "auto":
+            out.append(None)          # let the chunked stream derive c*M
+        elif tok == "init":
+            out.append(T * M)         # the init-pinned dispatch hint
+        else:
+            out.append(int(tok))
+    return out
+
+
+_DTYPES = {"f32": None, "bf16": "bfloat16"}
+
+
+def sweep(args):
+    T, K, M = args.t, args.k, args.sims
+    dtype = jnp.float32
+    covs, valid = _panel(T, K, dtype)
+    sweeps = sim_sweeps_for(K, dtype, T)
+    from mfm_tpu.obs.profile import compiled_cost
+
+    cells = []
+    for dkey in args.dtypes.split(","):
+        mc_dtype = _DTYPES[dkey.strip()]
+        sim_covs = simulated_eigen_covs(jax.random.key(1), K, T, M, dtype,
+                                        mc_dtype=mc_dtype)
+        for chunk in _parse_chunks(args.chunks, T):
+            for hint in _parse_hints(args.batch_hints, T, M):
+                def stage(c, v, sc, *, _chunk=chunk, _hint=hint, _md=mc_dtype):
+                    out, ok = eigen_risk_adjust_by_time(
+                        c, v, sc, sim_length=T, sim_sweeps=sweeps,
+                        chunk=_chunk, batch_hint=_hint, mc_dtype=_md)
+                    return jnp.sum(jnp.where(jnp.isfinite(out), out, 0.0))
+
+                jitted = jax.jit(stage)
+                wall = _t3(jitted, covs, valid, sim_covs,
+                           repeats=args.repeats)
+                cost = compiled_cost(stage, covs, valid, sim_covs) or {}
+                flops = cost.get("flops")
+                cell = {
+                    "chunk": chunk,
+                    "batch_hint": hint,
+                    "mc_dtype": mc_dtype or "float32",
+                    "wall_s": round(wall, 5),
+                    "flops": flops,
+                    "bytes_accessed": cost.get("bytes_accessed"),
+                    "gflops_per_s": (round(flops / wall / 1e9, 2)
+                                     if flops else None),
+                }
+                cells.append(cell)
+                print(json.dumps(cell), flush=True)
+
+    doc = {
+        "tool": "profile_eigen",
+        "shape": {"T": T, "K": K, "n_sims": M},
+        "sim_sweeps": sweeps,
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0].device_kind),
+        "jax": jax.__version__,
+        "repeats": args.repeats,
+        "cells": cells,
+    }
+    with open(args.json, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"wrote {len(cells)} cells -> {args.json}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--json", default=None, metavar="OUT.json",
+                   help="run the chunk x batch_hint x dtype sweep and write "
+                        "this JSON document (default: ad-hoc stage split)")
+    p.add_argument("--t", type=int, default=1390, help="dates (default CSI300)")
+    p.add_argument("--k", type=int, default=42, help="factors")
+    p.add_argument("--sims", type=int, default=100, help="Monte-Carlo sims")
+    p.add_argument("--chunks", default="64,256,none",
+                   help="comma list of date-chunk sizes; 'none' = full batch")
+    p.add_argument("--batch-hints", default="auto,init",
+                   help="comma list of solver batch hints; 'auto' = the "
+                        "chunked stream's own c*M, 'init' = the init-pinned "
+                        "T*M dispatch hint")
+    p.add_argument("--dtypes", default="f32",
+                   help="comma list from {f32, bf16}: Monte-Carlo dtype")
+    p.add_argument("--repeats", type=int, default=3)
+    args = p.parse_args(argv)
+    if args.json:
+        sweep(args)
+    else:
+        stage_split(args)
+
+
+if __name__ == "__main__":
+    main()
