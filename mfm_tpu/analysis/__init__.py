@@ -1,0 +1,34 @@
+"""mfm_tpu.analysis — IR-level static analysis of the jit entrypoints.
+
+mfmlint (``mfm_tpu/lint.py``) enforces the JAX doctrine at the *source*
+level; this package enforces it one layer down, where the two worst bugs
+this repo has shipped actually lived: the donated-aliased-buffer corruption
+PR 4 caught at runtime, and the s64-under-SPMD retraces PR 1 fixed.  Both
+are visible statically in the lowered StableHLO / compiled executable —
+``mfm-tpu audit`` lowers every registered entrypoint across a small config
+matrix (CPU backend, lowering + compilation only, nothing ever executes)
+and runs five passes over the artifacts:
+
+- A1 ``aliasing``    donation-aliasing proof (registry vs lowered donation
+                     flags; compiled alias map must be donated-only)
+- A2 ``ir``          dtype-leak audit (f64/i64 tensor types, host callbacks)
+- A3 ``collectives`` per-entrypoint collective audit under the doctrine mesh
+- A4 ``surface``     recompile-surface enumeration (bucket-ladder cache keys)
+- A5 ``budgets``     static memory budgets vs tools/audit_budgets.json
+
+Entry points: :data:`mfm_tpu.analysis.registry.REGISTRY` (the declarative
+inventory), :func:`mfm_tpu.analysis.run.run_audit` (the in-process API used
+by tests and the CLI), ``tools/mfmaudit.py`` / ``mfm-tpu audit`` (the
+gates).  See docs/AUDIT.md for the pass catalog and workflows.
+"""
+
+from mfm_tpu.analysis.registry import (  # noqa: F401
+    AUDIT_MATRIX,
+    Cell,
+    Entrypoint,
+    Finding,
+    NON_ENTRYPOINT_JITS,
+    REGISTRY,
+    registry_by_name,
+)
+from mfm_tpu.analysis.run import run_audit  # noqa: F401

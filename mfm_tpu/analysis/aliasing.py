@@ -1,0 +1,155 @@
+"""A1 — the donation-aliasing proof.
+
+PR 4's worst bug class: a donated operand whose buffer XLA retires into an
+output while the host still holds (and later reads) the reference — or the
+dual, an operand the caller contract says is host-retained that the
+compiled executable aliases anyway.  Both were caught at RUNTIME by guards;
+this pass catches them at lowering time, pre-merge:
+
+1. **Contract vs jit** — the registry's ``donate=`` tuple (the caller
+   contract serving code is written against) must match the jit's own
+   donation set as the lowering reports it (``lowered.args_info``).  A
+   mismatch in either direction is an error: a contract that promises
+   donation the jit doesn't declare re-creates the PR 4 setup (the host
+   thinks the buffer is gone, the jit thinks it's shared — or vice versa).
+
+2. **Alias map ⊆ donated** — every entry of the compiled executable's
+   ``input_output_alias`` map must point at a donated operand.  XLA only
+   aliases declared donors, so a violation here means the artifact and the
+   declaration disagree — tampering, a miscompile, or a registry rot; all
+   of them gate.
+
+3. **Donated-but-unaliased** operands are *info*, not errors: donation is
+   an upper bound, and a donated operand with no same-shape/dtype output
+   (the bool ``valid`` panel, the s32 ``industry`` panel) legitimately
+   donates nothing.  The finding keeps the evidence trail so a donation
+   that silently STOPS aliasing (a layout regression that doubles peak
+   memory) is visible in the committed snapshot diff.
+
+The checks are pure functions over (declared set, lowered flags, parsed
+alias map) so the PR 4 reconstruction fixtures in tests/test_audit.py can
+drive them with synthetic inputs — no compile needed to prove the pass
+fails when it must.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+
+from mfm_tpu.analysis.registry import Finding, flat_donated
+
+#: one entry of the compiled-HLO header's alias map:
+#:   input_output_alias={ {1}: (0, {}, may-alias), {13}: (6, {}, must-alias) }
+#: output index tuple (possibly nested, e.g. {1, 0}) -> (param, param_index,
+#: kind).  We key on the PARAM number — which operand's buffer is reused.
+_ALIAS_ENTRY = re.compile(
+    r"\{\s*([0-9,\s]*)\}\s*:\s*\(\s*(\d+)\s*,\s*\{[0-9,\s]*\}\s*,\s*"
+    r"(may-alias|must-alias)\s*\)")
+
+
+def parse_input_output_alias(compiled_text: str) -> list:
+    """Extract ``input_output_alias`` entries from compiled-HLO text.
+
+    Returns ``[{"output": "1", "param": 0, "kind": "may-alias"}, ...]``;
+    an executable with no alias map yields ``[]``.  Pure text -> data, so
+    fixtures can feed synthetic headers.
+    """
+    start = compiled_text.find("input_output_alias={")
+    if start < 0:
+        return []
+    # the map nests braces ({output_index}: (param, {param_index}, kind)),
+    # so walk a brace counter instead of trusting a non-greedy regex
+    i = start + len("input_output_alias=")
+    depth = 0
+    end = i
+    for end in range(i, min(len(compiled_text), i + 100_000)):
+        c = compiled_text[end]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                break
+    body = compiled_text[i + 1:end]
+    out = []
+    for out_idx, param, kind in _ALIAS_ENTRY.findall(body):
+        out.append({"output": out_idx.replace(" ", ""),
+                    "param": int(param), "kind": kind})
+    return out
+
+
+def donated_operand_flags(lowered) -> list:
+    """Per-FLATTENED-operand donation flags, in compiled parameter order,
+    straight from the lowering (``args_info`` reflects the jit's declared
+    ``donate_argnums`` after static-arg binding — the ground truth the
+    registry contract is checked against)."""
+    args, kwargs = lowered.args_info
+    leaves = (jax.tree_util.tree_leaves(args)
+              + jax.tree_util.tree_leaves(kwargs))
+    return [bool(a.donated) for a in leaves]
+
+
+def check_aliasing(ep_name: str, cell_name: str, declared: set,
+                   lowered_flags: list, alias_entries: list) -> list:
+    """The pure A1 verdicts for one cell.
+
+    Args:
+      declared: flattened operand indices the REGISTRY says are donated
+        (:func:`mfm_tpu.analysis.registry.flat_donated`).
+      lowered_flags: per-operand donation booleans from the lowering
+        (:func:`donated_operand_flags`).
+      alias_entries: parsed compiled alias map
+        (:func:`parse_input_output_alias`).
+    """
+    findings = []
+    actual = {i for i, d in enumerate(lowered_flags) if d}
+    if declared != actual:
+        over = sorted(declared - actual)
+        under = sorted(actual - declared)
+        detail = []
+        if over:
+            detail.append(f"contract donates operands {over} the jit does "
+                          f"not (host will drop buffers the program shares)")
+        if under:
+            detail.append(f"jit donates operands {under} the contract "
+                          f"retains (host reads a retired buffer — the "
+                          f"PR 4 corruption class)")
+        findings.append(Finding(
+            "A1", "error", ep_name, cell_name, "donation-contract-mismatch",
+            "; ".join(detail)))
+    aliased = set()
+    for e in alias_entries:
+        aliased.add(e["param"])
+        if e["param"] >= len(lowered_flags) or not lowered_flags[e["param"]]:
+            findings.append(Finding(
+                "A1", "error", ep_name, cell_name, "nondonated-alias",
+                f"compiled alias map reuses operand {e['param']} "
+                f"(output {{{e['output']}}}, {e['kind']}) which is NOT "
+                f"donated — executable and declaration disagree"))
+    unaliased = sorted(actual - aliased)
+    if unaliased:
+        findings.append(Finding(
+            "A1", "info", ep_name, cell_name, "donated-unaliased",
+            f"donated operands {unaliased} established no alias (no "
+            f"compatible output buffer) — donation is inert there"))
+    return findings
+
+
+def run_pass(artifacts: dict) -> list:
+    """A1 over every compiled primary cell.
+
+    ``artifacts`` maps ``(ep, cell) -> {"lowered", "compiled_text", ...}``
+    (built once by :mod:`mfm_tpu.analysis.run` and shared across passes).
+    """
+    findings = []
+    for (ep, cell), art in artifacts.items():
+        if cell.role != "primary" or "compiled_text" not in art:
+            continue
+        findings.extend(check_aliasing(
+            ep.name, cell.name,
+            flat_donated(ep, cell),
+            donated_operand_flags(art["lowered"]),
+            parse_input_output_alias(art["compiled_text"])))
+    return findings

@@ -1,0 +1,151 @@
+"""A5 — static memory budgets per entrypoint x config cell.
+
+``compiled.memory_analysis()`` prices a program without running it:
+argument/output/alias/temp bytes and the generated-code size.  Freezing
+those numbers per registered cell in ``tools/audit_budgets.json`` turns
+"this change doubled the guarded step's temp memory" from a TPU-day
+surprise into a pre-merge diff — the perfgate idea (tolerance bands over a
+committed trajectory) applied to STATIC cost instead of measured wall
+clock, and the mfmlint-baseline workflow (committed JSON, stale-entry
+detection, an explicit regeneration flow) applied to its lifecycle.
+
+Gate semantics per cell:
+
+- ``temp_bytes`` and ``workspace_bytes`` (argument + output + temp -
+  alias) regress when they exceed budget * (1 + tolerance) — an absolute
+  floor (:data:`FLOOR_BYTES`) keeps KB-scale cells from crying wolf over
+  allocator jitter;
+- a measurement WAY below budget (< budget * (1 - tolerance), beyond the
+  floor) is a *warn*: the budget is stale and should be re-frozen so the
+  next regression is measured from the real baseline, not a forgotten one
+  (``mfm-tpu audit --write-budgets``);
+- a registered cell with no budget entry is an error pointing at the
+  regeneration flow; a budget entry with no registered cell is a STALE
+  error (same contract as mfmlint's stale baseline entries).
+
+Budget identity: the numbers measure the AUDIT_MATRIX shapes on the pinned
+jaxlib — regenerate when either moves, never to paper over a regression.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from mfm_tpu.analysis.registry import Finding
+
+BUDGETS_SCHEMA = "mfmaudit-budgets/1"
+DEFAULT_TOLERANCE = 0.25
+#: differences under this many bytes never gate — sub-64KiB cells (the
+#: query/guard kernels) see allocator-granularity jitter across jaxlib
+#: builds that is not a regression signal
+FLOOR_BYTES = 64 * 1024
+
+#: the measured metrics a budget freezes, in gate order
+METRICS = ("temp_bytes", "workspace_bytes")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_BUDGETS_PATH = os.path.join(_REPO, "tools", "audit_budgets.json")
+
+
+def measure_cell(mem: dict) -> dict:
+    """Reduce obs.profile.compiled_memory_of output to the budgeted
+    metrics.  ``workspace_bytes`` is the executable's whole static
+    footprint net of donation reuse — the number that decides whether a
+    cell fits on a core."""
+    temp = int(mem.get("temp_bytes") or 0)
+    work = (int(mem.get("argument_bytes") or 0)
+            + int(mem.get("output_bytes") or 0) + temp
+            - int(mem.get("alias_bytes") or 0))
+    return {"temp_bytes": temp, "workspace_bytes": work}
+
+
+def load_budgets(path: str = DEFAULT_BUDGETS_PATH) -> dict:
+    """The committed budget file, or an empty skeleton when absent (every
+    registered cell then reports ``unbudgeted``)."""
+    if not os.path.exists(path):
+        return {"schema": BUDGETS_SCHEMA, "tolerance": DEFAULT_TOLERANCE,
+                "cells": {}}
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if doc.get("schema") != BUDGETS_SCHEMA:
+        raise ValueError(f"unsupported budget schema {doc.get('schema')!r} "
+                         f"in {path} (want {BUDGETS_SCHEMA})")
+    return doc
+
+
+def write_budgets(measured: dict, path: str = DEFAULT_BUDGETS_PATH,
+                  tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """Freeze ``measured`` (cell key -> metric dict) as the new budget
+    file.  Atomic tmp -> fsync -> rename, same as every other committed
+    snapshot in this repo — a SIGKILL mid-write must not tear the gate."""
+    doc = {"schema": BUDGETS_SCHEMA, "tolerance": tolerance,
+           "cells": {k: dict(v) for k, v in sorted(measured.items())}}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    dirfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+    try:
+        os.fsync(dirfd)
+    finally:
+        os.close(dirfd)
+    return doc
+
+
+def check_budgets(measured: dict, budgets: dict) -> list:
+    """The pure A5 verdicts: ``measured`` maps ``"ep/cell"`` ->
+    metric dict, ``budgets`` is the loaded budget doc."""
+    tol = float(budgets.get("tolerance", DEFAULT_TOLERANCE))
+    cells = budgets.get("cells", {})
+    findings = []
+    for key in sorted(measured):
+        ep_name, _, cell_name = key.partition("/")
+        got = measured[key]
+        want = cells.get(key)
+        if want is None:
+            findings.append(Finding(
+                "A5", "error", ep_name, cell_name, "unbudgeted",
+                f"no committed budget for {key} — freeze one with "
+                f"`mfm-tpu audit --write-budgets` and commit "
+                f"tools/audit_budgets.json"))
+            continue
+        for metric in METRICS:
+            cur = int(got.get(metric) or 0)
+            ref = int(want.get(metric) or 0)
+            if cur > ref * (1 + tol) and cur - ref > FLOOR_BYTES:
+                findings.append(Finding(
+                    "A5", "error", ep_name, cell_name, f"over-{metric}",
+                    f"{key} {metric} {cur} exceeds budget {ref} by "
+                    f"{cur - ref} bytes (> {tol:.0%} band) — a static "
+                    f"memory regression"))
+            elif ref * (1 - tol) > cur and ref - cur > FLOOR_BYTES:
+                findings.append(Finding(
+                    "A5", "warn", ep_name, cell_name, f"stale-{metric}",
+                    f"{key} {metric} {cur} is far under budget {ref} — "
+                    f"re-freeze so the band measures from reality"))
+    for key in sorted(set(cells) - set(measured)):
+        ep_name, _, cell_name = key.partition("/")
+        findings.append(Finding(
+            "A5", "error", ep_name, cell_name, "stale-budget",
+            f"budget entry {key} matches no registered cell — remove it "
+            f"or restore the registration (same contract as mfmlint's "
+            f"stale baseline entries)"))
+    return findings
+
+
+def run_pass(artifacts: dict, budgets_path: str = DEFAULT_BUDGETS_PATH):
+    """A5 over every compiled primary cell.  Returns ``(findings,
+    measured)`` — the measurements ride into the audit report and the
+    ``--write-budgets`` flow."""
+    measured = {}
+    for (ep, cell), art in artifacts.items():
+        if cell.role != "primary" or "memory" not in art:
+            continue
+        measured[f"{ep.name}/{cell.name}"] = measure_cell(art["memory"])
+    findings = check_budgets(measured, load_budgets(budgets_path))
+    return findings, measured

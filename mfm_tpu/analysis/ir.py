@@ -1,0 +1,87 @@
+"""A2 — the dtype-leak audit over lowered StableHLO.
+
+PR 1's s64-under-SPMD retraces and the x64-vs-production split
+(tests run golden parity in f64; serving runs f32) make "no 64-bit tensor
+reaches a lowered serving program" a doctrine claim.  mfmlint approximates
+it at the source level (R2's np scalars, R6's bare ints); this pass proves
+it on the artifact: the audit lowers every registered cell under
+``jax.experimental.disable_x64`` (the production numerics mode) and walks
+the StableHLO module's TENSOR TYPES — ``tensor<64x48xf64>``,
+``tensor<i64>``, ``tensor<3xui64>`` — which is the only honest place to
+look, because the raw text is full of harmless ``: i64`` ATTRIBUTE types
+(dimension numbers, iota dims) that a naive grep would flag.
+
+Also flagged: host callbacks (``stablehlo.custom_call`` targeting the
+python callback trampolines).  A host round-trip inside a serving
+entrypoint breaks both the latency contract and AOT portability — nothing
+in the registry is allowed one.
+
+Pure text -> findings, so fixtures drive it with synthetic modules.
+"""
+
+from __future__ import annotations
+
+import re
+
+from mfm_tpu.analysis.registry import Finding
+
+#: every tensor type in a StableHLO module, e.g. tensor<64x48xf32>,
+#: tensor<i1>, tensor<4x9x9xf64>; dynamic dims (?) and scalars included
+_TENSOR = re.compile(r"tensor<([0-9x?]*)((?:[a-z][a-z0-9]*)|)>")
+
+#: 64-bit element types that must never appear in a production lowering
+_WIDE = {"f64", "i64", "ui64", "si64", "c128"}
+
+#: custom_call targets that are host round-trips (python callbacks in
+#: their jaxlib spellings), matched as substrings of the target name
+_CALLBACK_MARKERS = ("python_cpu_callback", "python_gpu_callback",
+                     "xla_python_callback", "CallbackTrampoline")
+
+
+def module_tensor_dtypes(stablehlo_text: str) -> set:
+    """Element dtypes of every ``tensor<...>`` type in the module text."""
+    out = set()
+    for _dims, elt in _TENSOR.findall(stablehlo_text):
+        if elt:
+            out.add(elt)
+    # complex element types nest (<tensor<2xcomplex<f64>>) past the regex
+    if "complex<f64>" in stablehlo_text:
+        out.add("c128")
+    return out
+
+
+def host_callbacks(stablehlo_text: str) -> list:
+    """call_target_name values of host-callback custom_calls."""
+    targets = re.findall(r'call_target_name\s*=\s*"([^"]+)"', stablehlo_text)
+    return [t for t in targets
+            if any(m in t for m in _CALLBACK_MARKERS)]
+
+
+def scan_module(ep_name: str, cell_name: str, stablehlo_text: str) -> list:
+    """The pure A2 verdicts for one lowered module."""
+    findings = []
+    wide = sorted(module_tensor_dtypes(stablehlo_text) & _WIDE)
+    if wide:
+        findings.append(Finding(
+            "A2", "error", ep_name, cell_name, "wide-dtype",
+            f"lowered module contains {wide} tensor types under the "
+            f"production f32 mode — a 64-bit leak (PR 1's retrace class "
+            f"when it is an index dtype, a 2x memory bill when it is data)"))
+    cbs = host_callbacks(stablehlo_text)
+    if cbs:
+        findings.append(Finding(
+            "A2", "error", ep_name, cell_name, "host-callback",
+            f"lowered module calls back into the host ({sorted(set(cbs))}) "
+            f"— serving entrypoints must be AOT-pure"))
+    return findings
+
+
+def run_pass(artifacts: dict) -> list:
+    """A2 over every lowered cell (primary AND mesh — a leak the
+    partitioner introduces only under SPMD still gates)."""
+    findings = []
+    for (ep, cell), art in artifacts.items():
+        if "stablehlo" not in art:
+            continue
+        findings.extend(scan_module(ep.name, cell.name, art["stablehlo"]))
+    return findings

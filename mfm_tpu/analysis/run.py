@@ -1,0 +1,391 @@
+"""The audit driver: build cells, lower/compile once, run A1–A5.
+
+Everything here is device-FREE: entrypoints are lowered from abstract
+``ShapeDtypeStruct`` avals and compiled for the CPU backend; nothing is
+ever executed and no operand buffer is ever materialized.  The whole
+matrix fits comfortably inside tier-1's 120 s budget because the
+AUDIT_MATRIX shapes are tiny and each (entrypoint, cell) is lowered and
+compiled exactly once, with every pass reading from the shared artifact
+cache.
+
+Numerics mode: the audit runs under ``jax.experimental.disable_x64`` —
+the production f32 serving mode — regardless of the caller's global x64
+setting (the test suite runs golden parity in x64; auditing THAT mode
+would flag every program as a 64-bit leak and measure the wrong budgets).
+
+Baseline contract (same as mfmlint): a committed JSON list of
+``{"key", "note"}`` suppresses known findings by exact key; suppressed
+keys that no longer fire are STALE and fail ``--strict`` so the baseline
+can only shrink.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import os
+import sys
+import time
+
+from mfm_tpu.analysis import aliasing, budgets, collectives, ir, surface
+from mfm_tpu.analysis.registry import AUDIT_MATRIX, Finding, registry
+
+AUDIT_SCHEMA = "mfmaudit/1"
+
+PASS_IDS = ("A1", "A2", "A3", "A4", "A5")
+
+
+@dataclasses.dataclass
+class AuditReport:
+    findings: list
+    baselined: list
+    stale_baseline: list
+    measured: dict            # "ep/cell" -> budget metrics
+    cells: dict               # "ep/cell" -> cell evidence
+    matrix: dict
+    passes: tuple
+    wall_s: float
+
+    @property
+    def errors(self) -> list:
+        return [f for f in self.findings if f.severity == "error"]
+
+    @property
+    def strict_clean(self) -> bool:
+        return not self.errors and not self.stale_baseline
+
+    def to_dict(self) -> dict:
+        import jax
+
+        return {
+            "schema": AUDIT_SCHEMA,
+            "jax": jax.__version__,
+            "matrix": dict(self.matrix),
+            "passes": list(self.passes),
+            "cells": self.cells,
+            "measured": {k: dict(v) for k, v in sorted(self.measured.items())},
+            "findings": [f.to_dict() for f in self.findings],
+            "baselined": [f.to_dict() for f in self.baselined],
+            "stale_baseline": list(self.stale_baseline),
+            "summary": {
+                "errors": len(self.errors),
+                "warnings": sum(1 for f in self.findings
+                                if f.severity == "warn"),
+                "info": sum(1 for f in self.findings
+                            if f.severity == "info"),
+                "cells": len(self.cells),
+            },
+            "strict_clean": self.strict_clean,
+            "wall_s": round(self.wall_s, 2),
+        }
+
+
+def _build_artifacts(entrypoints, cells_by_ep, compile_cells: bool) -> dict:
+    """Lower (and for primary/mesh cells compile) every cell once.  The
+    artifact dict is the single shared evidence store every pass reads."""
+    from mfm_tpu.obs.profile import compiled_memory_of
+
+    artifacts = {}
+    for ep in entrypoints:
+        for cell in cells_by_ep[ep]:
+            if cell.role == "ladder":
+                continue   # surface pass works on avals alone
+            art = {}
+            artifacts[(ep, cell)] = art
+            if cell.role == "mesh" and not cell.args:
+                continue   # declared but unbuildable (too few devices)
+            lowered = ep.fn.lower(*cell.args, **cell.kwargs)
+            art["lowered"] = lowered
+            art["stablehlo"] = lowered.as_text()
+            if compile_cells:
+                compiled = lowered.compile()
+                art["compiled"] = compiled
+                art["compiled_text"] = compiled.as_text()
+                if cell.role == "primary":
+                    art["memory"] = compiled_memory_of(compiled)
+    return artifacts
+
+
+def report_digest(doc: dict) -> str:
+    """Content hash of a report payload, excluding the embedded hash
+    itself.  ``mfm-tpu doctor --audit`` recomputes this over the committed
+    AUDIT_r*.json — a hand-edited snapshot (strict_clean flipped to true,
+    findings deleted) no longer matches and the doctor refuses it."""
+    body = {k: v for k, v in doc.items() if k != "sha256"}
+    blob = json.dumps(body, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+def write_report(doc: dict, path: str) -> dict:
+    """Seal ``doc`` with its digest and write it atomically (tmp -> fsync
+    -> rename -> dir fsync), same as every committed snapshot here — a
+    SIGKILL mid-write must not tear the artifact the doctor verifies."""
+    doc = dict(doc)
+    doc["sha256"] = report_digest(doc)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    dirfd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                    os.O_RDONLY)
+    try:
+        os.fsync(dirfd)
+    finally:
+        os.close(dirfd)
+    return doc
+
+
+def load_audit_baseline(path: str | None) -> list:
+    if not path:
+        return []
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as fh:
+        entries = json.load(fh)
+    for e in entries:
+        if "key" not in e or "note" not in e:
+            raise ValueError(f"audit baseline entry missing key/note: {e}")
+    return entries
+
+
+def run_audit(passes=PASS_IDS, baseline: list | None = None,
+              budgets_path: str | None = None) -> AuditReport:
+    """Lower + inspect the whole registry.  Pure analysis: no entrypoint
+    executes, no file is written (the CLI owns report/budget IO)."""
+    import warnings
+
+    from jax.experimental import disable_x64
+
+    passes = tuple(passes)
+    unknown = set(passes) - set(PASS_IDS)
+    if unknown:
+        raise ValueError(f"unknown audit passes {sorted(unknown)}")
+    t0 = time.perf_counter()
+    findings: list = []
+    with disable_x64(), warnings.catch_warnings():
+        # the lowering emits "Some donated buffers were not usable" for
+        # legitimately inert donations — that is exactly what A1 reports
+        # as structured `donated-unaliased` findings instead
+        warnings.filterwarnings("ignore", message=".*[Dd]onat.*",
+                                category=UserWarning)
+        entrypoints = registry()
+        cells_by_ep = {ep: ep.cells() for ep in entrypoints}
+        need_compile = bool({"A1", "A3", "A5"} & set(passes))
+        artifacts = _build_artifacts(entrypoints, cells_by_ep, need_compile)
+
+        if "A1" in passes:
+            findings += aliasing.run_pass(artifacts)
+        if "A2" in passes:
+            findings += ir.run_pass(artifacts)
+        if "A3" in passes:
+            findings += collectives.run_pass(artifacts)
+        if "A4" in passes:
+            findings += surface.run_pass(entrypoints, cells_by_ep)
+        measured: dict = {}
+        if "A5" in passes:
+            b_findings, measured = budgets.run_pass(
+                artifacts, budgets_path or budgets.DEFAULT_BUDGETS_PATH)
+            findings += b_findings
+
+    # evidence summary per cell (rides into AUDIT_r*.json)
+    cells = {}
+    for (ep, cell), art in artifacts.items():
+        entry = {"role": cell.role, "lowered": "stablehlo" in art,
+                 "compiled": "compiled_text" in art}
+        if cell.mesh:
+            entry["mesh"] = list(cell.mesh)
+        if "collectives" in art:
+            entry["collectives"] = art["collectives"]
+        if "stablehlo" in art:
+            entry["tensor_dtypes"] = sorted(
+                ir.module_tensor_dtypes(art["stablehlo"]))
+        cells[f"{ep.name}/{cell.name}"] = entry
+
+    baseline = baseline or []
+    keys = {e["key"] for e in baseline}
+    fired = {f.key() for f in findings if f.key() in keys}
+    kept = [f for f in findings if f.key() not in keys]
+    suppressed = [f for f in findings if f.key() in keys]
+    stale = sorted(keys - fired)
+    return AuditReport(
+        findings=kept, baselined=suppressed, stale_baseline=stale,
+        measured=measured, cells=cells, matrix=AUDIT_MATRIX, passes=passes,
+        wall_s=time.perf_counter() - t0)
+
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(_REPO, "tools", "mfmaudit_baseline.json")
+
+
+def latest_snapshot_path(root: str = _REPO) -> str | None:
+    """The newest committed ``AUDIT_r*.json`` (same naming ladder as the
+    perfgate's ``BENCH_r*.json`` trajectory), or None."""
+    import glob
+
+    found = sorted(glob.glob(os.path.join(root, "AUDIT_r*.json")))
+    return found[-1] if found else None
+
+
+def verify_snapshot(path: str, budgets_path: str | None = None):
+    """`mfm-tpu doctor --audit`: is the committed audit snapshot intact,
+    strict-clean, and still describing THIS tree?
+
+    Returns ``(problems, warnings, doc)``; ``doc`` is None when the file
+    is torn/unreadable.  Checks, in order: parseability (a torn write is
+    a problem, not a crash), schema, the seal digest (hand-editing the
+    snapshot — flipping ``strict_clean``, deleting findings — breaks it),
+    strict-cleanliness of the recorded run, measurement agreement with
+    the committed budget file, and cell-coverage agreement with the LIVE
+    registry (an entrypoint added since the snapshot means the snapshot
+    vouches for a tree that no longer exists).
+    """
+    problems: list = []
+    warns: list = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except OSError as err:
+        return [f"snapshot unreadable: {err}"], warns, None
+    except json.JSONDecodeError as err:
+        return [f"snapshot torn or not JSON: {err}"], warns, None
+    if not isinstance(doc, dict) or doc.get("schema") != AUDIT_SCHEMA:
+        got = doc.get("schema") if isinstance(doc, dict) else type(doc)
+        problems.append(f"unsupported snapshot schema {got!r} "
+                        f"(want {AUDIT_SCHEMA})")
+        return problems, warns, doc
+    sha = doc.get("sha256")
+    if not isinstance(sha, str):
+        problems.append("snapshot carries no seal (sha256) — regenerate "
+                        "with `mfm-tpu audit --json`")
+    elif report_digest(doc) != sha:
+        problems.append("seal digest mismatch — the snapshot was edited "
+                        "after it was sealed (or the write tore)")
+    if not doc.get("strict_clean", False):
+        problems.append("snapshot records a run that was NOT strict-clean "
+                        "— the audited tree had gating findings")
+
+    from mfm_tpu.analysis import budgets as budgets_mod
+
+    budgets = budgets_mod.load_budgets(
+        budgets_path or budgets_mod.DEFAULT_BUDGETS_PATH)
+    snap = {k: {m: int(v) for m, v in d.items()}
+            for k, d in (doc.get("measured") or {}).items()}
+    live = {k: {m: int(v) for m, v in d.items()}
+            for k, d in (budgets.get("cells") or {}).items()}
+    if snap != live:
+        drift = sorted(k for k in set(snap) | set(live)
+                       if snap.get(k) != live.get(k))
+        problems.append(
+            f"snapshot measurements disagree with tools/audit_budgets.json "
+            f"at {drift} — one of the two is stale; re-run "
+            f"`mfm-tpu audit --write-budgets --json AUDIT_r*.json`")
+
+    try:
+        from mfm_tpu.analysis.registry import registry
+
+        expected = {f"{ep.name}/{cell.name}"
+                    for ep in registry() for cell in ep.cells()
+                    if cell.role != "ladder"}
+    except Exception as err:   # registry must never crash the doctor
+        warns.append(f"could not rebuild the live registry for the "
+                     f"drift check: {err}")
+    else:
+        got = set(doc.get("cells") or {})
+        if got != expected:
+            problems.append(
+                f"snapshot covers cells {sorted(got ^ expected)} "
+                f"differently than the live registry — the snapshot "
+                f"vouches for a different tree; regenerate it")
+
+    import jax
+
+    if doc.get("jax") != jax.__version__:
+        warns.append(f"snapshot was sealed under jax {doc.get('jax')}, "
+                     f"running {jax.__version__} — re-audit before "
+                     f"trusting the budget numbers")
+    return problems, warns, doc
+
+
+def main(argv=None) -> int:
+    """Shared CLI body behind ``python tools/mfmaudit.py`` and
+    ``mfm-tpu audit`` (the tools shim additionally pins the CPU backend
+    and the 8-way virtual device split before jax loads)."""
+    from mfm_tpu.analysis import budgets as budgets_mod
+
+    ap = argparse.ArgumentParser(
+        prog="mfmaudit",
+        description="IR-level static analysis of every jit entrypoint "
+                    "(passes A1-A5; see docs/AUDIT.md)")
+    ap.add_argument("--passes", default=",".join(PASS_IDS),
+                    help="comma-separated subset of passes to run")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON of suppressed findings "
+                         "('none' disables)")
+    ap.add_argument("--budgets", default=None,
+                    help="budget file for A5 (default: "
+                         "tools/audit_budgets.json)")
+    ap.add_argument("--write-budgets", action="store_true",
+                    help="freeze the measured A5 numbers as the new "
+                         "budget file instead of gating against them")
+    ap.add_argument("--json", dest="json_out", default=None, metavar="FILE",
+                    help="write the sealed report JSON to FILE "
+                         "('-' for stdout)")
+    ap.add_argument("--strict", action="store_true",
+                    help="also fail on stale baseline entries")
+    args = ap.parse_args(argv)
+
+    passes = tuple(p.strip() for p in args.passes.split(",") if p.strip())
+    bl_path = None if args.baseline.lower() == "none" else (
+        args.baseline if os.path.isabs(args.baseline)
+        else os.path.join(_REPO, args.baseline))
+    budgets_path = args.budgets or budgets_mod.DEFAULT_BUDGETS_PATH
+
+    rep = run_audit(passes=passes, baseline=load_audit_baseline(bl_path),
+                    budgets_path=budgets_path)
+
+    if args.write_budgets:
+        if not rep.measured:
+            print("mfmaudit: --write-budgets needs pass A5 in --passes",
+                  file=sys.stderr)
+            return 2
+        budgets_mod.write_budgets(rep.measured, budgets_path)
+        # re-gate A5 against the file just frozen: the pre-freeze
+        # unbudgeted/over findings are the reason the user regenerated
+        rep.findings = (
+            [f for f in rep.findings if f.pass_id != "A5"]
+            + budgets_mod.check_budgets(
+                rep.measured, budgets_mod.load_budgets(budgets_path)))
+        print(f"mfmaudit: froze {len(rep.measured)} cell budget(s) -> "
+              f"{budgets_path}")
+
+    doc = rep.to_dict()
+    if args.json_out == "-":
+        doc["sha256"] = report_digest(doc)
+        print(json.dumps(doc, indent=1, sort_keys=True))
+    else:
+        if args.json_out:
+            write_report(doc, args.json_out)
+            print(f"mfmaudit: wrote sealed report -> {args.json_out}")
+        for f in rep.findings:
+            print(f"{f.pass_id} {f.severity:5s} {f.entrypoint}/{f.cell} "
+                  f"[{f.code}] {f.message}")
+        for key in rep.stale_baseline:
+            print(f"STALE baseline entry: {key} — the finding no longer "
+                  f"fires; remove it")
+        s = doc["summary"]
+        print(f"mfmaudit: {s['errors']} error(s), {s['warnings']} "
+              f"warning(s), {s['info']} info over {s['cells']} cell(s), "
+              f"{len(rep.baselined)} baselined, "
+              f"{len(rep.stale_baseline)} stale baseline entr(ies) "
+              f"[{doc['wall_s']:.1f}s]")
+    if rep.errors:
+        return 1
+    if args.strict and rep.stale_baseline:
+        return 1
+    return 0

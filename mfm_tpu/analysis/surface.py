@@ -1,0 +1,134 @@
+"""A4 — recompile-surface enumeration over the declared bucket ladders.
+
+The serving subsystems all make the same steady-state promise: host-side
+padding quantizes batch shapes onto a SMALL declared ladder (query/scenario
+geometric 8*4^i buckets, eigen power-of-two >= 64 draw buckets), so the jit
+cache holds exactly one entry per bucket and the hot loop never retraces.
+Until now that was a runtime counter assertion (assert_max_compiles); this
+pass makes it a provable static property:
+
+- every registered ladder cell's **jit cache key** — the flattened
+  (shape, dtype) signature of its operands plus the repr of its static
+  arguments — is computed WITHOUT lowering anything;
+- the number of DISTINCT keys must equal the number of declared buckets:
+  fewer means two rungs collide (the ladder lies about its arity), more
+  means something besides the bucketed axis moved — the classic instance
+  being an index operand whose dtype drifts (np.arange's platform-default
+  i64 against the pad path's pinned i32), PR 1's s64 retrace trap, which
+  now fails here before it can ship;
+- within a ladder, every cell must agree on the **dtype signature** and on
+  the **static signature** — only shapes may move between rungs;
+- every declared bucket must be a fixed point of the PRODUCTION bucket
+  function (``bucket_for(b) == b``, ``draw_bucket(b) == b``), so the
+  registry cannot drift from the code it vouches for (the registry
+  builders assert this at declaration time; the pass re-checks it here so
+  a hand-built fixture cannot dodge it).
+
+Everything is pure over avals — the cheapest pass in the audit.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from mfm_tpu.analysis.registry import Cell, Finding
+
+#: ladder name -> the production bucket function it must agree with
+def _ladder_fn(name: str):
+    if name == "eigen":
+        from mfm_tpu.models.eigen import draw_bucket
+
+        return draw_bucket
+    if name in ("query", "scenario"):
+        # the scenario engine reuses serve.query's geometric ladder
+        from mfm_tpu.serve.query import bucket_for
+
+        return bucket_for
+    return None
+
+
+def cache_key(cell: Cell) -> tuple:
+    """The audit's model of the jit cache key for one cell: flattened
+    operand (shape, dtype) pairs + the static signature.  Two cells with
+    equal keys hit the same compiled executable; anything that makes the
+    keys differ is a retrace."""
+    shapes = []
+    for pos, arg in enumerate(cell.args):
+        if pos in cell.static_argnums:
+            continue
+        for leaf in jax.tree_util.tree_leaves(arg):
+            shapes.append((tuple(leaf.shape), str(leaf.dtype)))
+    statics = tuple(sorted((k, repr(v)) for k, v in cell.kwargs.items()))
+    statics += tuple(repr(cell.args[p]) for p in cell.static_argnums)
+    return (tuple(shapes), statics)
+
+
+def dtype_signature(cell: Cell) -> tuple:
+    """The shape-free half of the key: operand dtypes in order."""
+    shapes, _ = cache_key(cell)
+    return tuple(dt for _shape, dt in shapes)
+
+
+def check_ladder(ep_name: str, ladder: str, cells: list) -> list:
+    """The pure A4 verdicts for one entrypoint's ladder cells."""
+    findings = []
+    declared = [c.bucket for c in cells]
+    if len(set(declared)) != len(declared):
+        findings.append(Finding(
+            "A4", "error", ep_name, ladder, "duplicate-bucket",
+            f"declared ladder repeats buckets: {declared}"))
+    keys = {}
+    for c in cells:
+        keys.setdefault(cache_key(c), []).append(c.name)
+    if len(keys) != len(cells):
+        collided = [names for names in keys.values() if len(names) > 1]
+        findings.append(Finding(
+            "A4", "error", ep_name, ladder, "bucket-key-collision",
+            f"{len(cells)} declared buckets produce only {len(keys)} "
+            f"distinct jit cache keys — colliding rungs: {collided}"))
+    sigs = {}
+    for c in cells:
+        sigs.setdefault(dtype_signature(c), []).append(c.name)
+    if len(sigs) > 1:
+        findings.append(Finding(
+            "A4", "error", ep_name, ladder, "ladder-dtype-drift",
+            f"operand dtypes differ across ladder rungs "
+            f"{ {str(k): v for k, v in sigs.items()} } — only shapes may "
+            f"move between buckets (an i64 index rung is PR 1's s64 "
+            f"retrace trap)"))
+    statics = {cache_key(c)[1] for c in cells}
+    if len(statics) > 1:
+        findings.append(Finding(
+            "A4", "error", ep_name, ladder, "ladder-static-drift",
+            f"static arguments differ across ladder rungs — each change "
+            f"is a whole extra compile per bucket ({len(statics)} static "
+            f"signatures over {len(cells)} rungs)"))
+    fn = _ladder_fn(ladder)
+    if fn is not None:
+        broken = [b for b in declared if b is None or fn(b) != b]
+        if broken:
+            findings.append(Finding(
+                "A4", "error", ep_name, ladder, "bucket-not-fixed-point",
+                f"declared buckets {broken} are not fixed points of the "
+                f"production ladder function — the registry has drifted "
+                f"from the code"))
+    return findings
+
+
+def run_pass(entrypoints, cells_by_ep: dict) -> list:
+    """A4 over every registered ladder.  ``cells_by_ep`` maps Entrypoint ->
+    its built cells (shared with the other passes so the builders run
+    once)."""
+    findings = []
+    for ep in entrypoints:
+        if ep.ladder is None:
+            continue
+        ladder_cells = [c for c in cells_by_ep[ep] if c.role == "ladder"]
+        if not ladder_cells:
+            findings.append(Finding(
+                "A4", "error", ep.name, ep.ladder, "empty-ladder",
+                "entrypoint declares a bucket ladder but registers no "
+                "ladder cells"))
+            continue
+        findings.extend(check_ladder(ep.name, ep.ladder, ladder_cells))
+    return findings

@@ -1253,7 +1253,13 @@ def _doctor(args):
         _file_sha256, _stamp_from_json, load_artifact, read_pointer,
     )
 
-    if os.path.isdir(args.path):
+    if args.path is None:
+        if not getattr(args, "audit", None):
+            raise SystemExit("doctor: PATH is required unless --audit is "
+                             "given (the static-audit snapshot check "
+                             "needs no serving artifacts)")
+        paths = []
+    elif os.path.isdir(args.path):
         paths = sorted(glob.glob(os.path.join(args.path, "*.npz")))
         if not paths:
             raise SystemExit(f"{args.path}: no .npz artifacts to audit")
@@ -1336,10 +1342,12 @@ def _doctor(args):
     # the newest run manifest, when one sits beside the artifacts: schema,
     # health field, and stamp-vs-checkpoint identity (a mismatch means the
     # directory mixes artifacts from different runs)
-    man_dir = (args.path if os.path.isdir(args.path)
-               else os.path.dirname(args.path) or ".")
-    mpath = os.path.join(man_dir, "run_manifest.json")
-    if os.path.exists(mpath):
+    man_dir = None
+    if args.path is not None:
+        man_dir = (args.path if os.path.isdir(args.path)
+                   else os.path.dirname(args.path) or ".")
+    mpath = os.path.join(man_dir, "run_manifest.json") if man_dir else ""
+    if man_dir is not None and os.path.exists(mpath):
         from mfm_tpu.obs.manifest import ManifestError, read_run_manifest
 
         rec = {"file": mpath, "kind": "run_manifest", "status": "ok",
@@ -1375,7 +1383,7 @@ def _doctor(args):
     # a breaker left open at shutdown means the query service exited
     # while rejecting traffic, which is a failed serve run even if every
     # request got a well-formed response
-    if getattr(args, "serve", False):
+    if getattr(args, "serve", False) and man_dir is not None:
         from mfm_tpu.obs.manifest import ManifestError, read_run_manifest
 
         spath = os.path.join(man_dir, SERVE_MANIFEST_NAME)
@@ -1434,7 +1442,7 @@ def _doctor(args):
     # the recorded one, or inconsistent counts all mean the last stress
     # run cannot be trusted (tools/faultinject.py's scenario plans drive
     # this exact check after a mid-write SIGKILL)
-    if getattr(args, "scenarios", False):
+    if getattr(args, "scenarios", False) and man_dir is not None:
         from mfm_tpu.scenario.manifest import (
             ScenarioManifestError, audit_scenario_manifest,
             scenario_manifest_path_for,
@@ -1466,6 +1474,37 @@ def _doctor(args):
                         "scenario manifest carries no root trace_id — "
                         "this run cannot be joined to its trace "
                         "(pre-tracing build, or tracing disabled)")
+                if rec["problems"]:
+                    rec["status"] = "unhealthy"
+    # --audit: verify the committed static-audit snapshot (AUDIT_r*.json)
+    # — torn writes, broken seals, non-clean runs, and staleness against
+    # the live registry/budget file all fail, same contract as the
+    # artifact records above
+    if getattr(args, "audit", None):
+        from mfm_tpu.analysis.run import latest_snapshot_path, verify_snapshot
+
+        apath = args.audit
+        if apath == "latest":
+            apath = latest_snapshot_path()
+        rec = {"file": apath, "kind": "audit_snapshot", "status": "ok",
+               "problems": [], "warnings": []}
+        records.append(rec)
+        if apath is None:
+            rec["file"] = "AUDIT_r*.json"
+            rec["status"] = "missing"
+            rec["problems"].append(
+                "no committed AUDIT_r*.json snapshot — run "
+                "`mfm-tpu audit --json AUDIT_r01.json` and commit it")
+        else:
+            problems, warns, doc = verify_snapshot(apath)
+            rec["problems"].extend(problems)
+            rec["warnings"].extend(warns)
+            if doc is None:
+                rec["status"] = "corrupt"
+            else:
+                if isinstance(doc, dict):
+                    rec["strict_clean"] = doc.get("strict_clean")
+                    rec["summary"] = doc.get("summary")
                 if rec["problems"]:
                     rec["status"] = "unhealthy"
     unhealthy = sum(r["status"] != "ok" for r in records)
@@ -1813,6 +1852,30 @@ def _lint_cmd(args):
     if args.json:
         lint_argv.append("--json")
     raise SystemExit(lint_main(lint_argv))
+
+
+def _audit_cmd(args):
+    # device-free IR audit (mfm_tpu/analysis/): lowers and compiles every
+    # registered entrypoint on whatever backend is pinned, executes
+    # nothing.  Mesh cells need 8 devices; on a smaller host they skip
+    # with a warning — `python tools/mfmaudit.py` pins the 8-way virtual
+    # CPU split before jax loads and is the form CI gates on.
+    from mfm_tpu.analysis.run import main as audit_main
+
+    audit_argv = []
+    if args.passes:
+        audit_argv += ["--passes", args.passes]
+    if args.baseline:
+        audit_argv += ["--baseline", args.baseline]
+    if args.budgets:
+        audit_argv += ["--budgets", args.budgets]
+    if args.write_budgets:
+        audit_argv.append("--write-budgets")
+    if args.json:
+        audit_argv += ["--json", args.json]
+    if args.strict:
+        audit_argv.append("--strict")
+    raise SystemExit(audit_main(audit_argv))
 
 
 def main(argv=None):
@@ -2273,15 +2336,41 @@ def main(argv=None):
                     help="machine-readable output")
     ln.set_defaults(fn=_lint_cmd)
 
+    au = sub.add_parser(
+        "audit",
+        help="IR-level static audit of every jit entrypoint: donation-"
+             "aliasing proof, wide-dtype/callback scan, collective audit, "
+             "recompile-surface enumeration, and static memory budgets "
+             "(passes A1-A5, docs/AUDIT.md); device-free — nothing runs")
+    au.add_argument("--passes", default=None,
+                    help="comma-separated subset of A1,A2,A3,A4,A5 "
+                         "(default: all)")
+    au.add_argument("--baseline", default=None,
+                    help="baseline JSON of suppressed findings ('none' "
+                         "disables; default: tools/mfmaudit_baseline.json)")
+    au.add_argument("--budgets", default=None,
+                    help="A5 budget file (default: "
+                         "tools/audit_budgets.json)")
+    au.add_argument("--write-budgets", action="store_true",
+                    help="freeze the measured memory numbers as the new "
+                         "budget file instead of gating against them")
+    au.add_argument("--json", default=None, metavar="FILE",
+                    help="write the sealed report JSON to FILE "
+                         "('-' for stdout)")
+    au.add_argument("--strict", action="store_true",
+                    help="also fail on stale baseline entries")
+    au.set_defaults(fn=_audit_cmd)
+
     dr = sub.add_parser(
         "doctor",
         help="audit serving artifacts: payload checksums, fencing "
              "generations vs latest.json, risk-state schema/stamp, and "
              "the run manifest beside them (schema/stamp-match/health; "
              "exit 1 on any problem; docs/SERVING.md)")
-    dr.add_argument("path",
+    dr.add_argument("path", nargs="?", default=None,
                     help=".npz artifact or a directory of them (e.g. a "
-                         "pipeline OUT dir or checkpoint dir)")
+                         "pipeline OUT dir or checkpoint dir); optional "
+                         "when only --audit is asked for")
     dr.add_argument("--force", action="store_true",
                     help="audit past a stale-generation refusal (reported "
                          "as a warning instead of a failure)")
@@ -2295,6 +2384,14 @@ def main(argv=None):
                          "artifacts: exit non-zero on a torn manifest, a "
                          "spec-hash mismatch, or inconsistent counts; warn "
                          "on rejected scenarios")
+    dr.add_argument("--audit", nargs="?", const="latest", default=None,
+                    metavar="FILE",
+                    help="also verify the committed static-audit snapshot "
+                         "(newest AUDIT_r*.json, or FILE): schema, seal "
+                         "digest (tamper detection), strict-cleanliness, "
+                         "and staleness vs the live registry and budget "
+                         "file; exit non-zero on a torn or tampered "
+                         "snapshot")
     dr.set_defaults(fn=_doctor)
 
     sv = sub.add_parser(

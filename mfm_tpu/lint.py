@@ -355,6 +355,12 @@ class Linter:
         self.traced: set[str] = set()
         self.jax_touch: set[str] = set()
         self.donating: dict[str, tuple] = {}   # qualname -> donated positions
+        #: qualnames that are jit/pjit COMPILATION UNITS (decorated defs or
+        #: jit(fn) call-form targets) — a strict subset of the traced roots,
+        #: which also include vmap/scan/... function arguments.  The audit
+        #: registry's completeness test (mfm_tpu/analysis/registry.py) keys
+        #: off this set: every jit root must be registered or allowlisted.
+        self.jit_roots: set[str] = set()
         self.mesh_axes: set[str] = {"date", "stock"}
         self.violations: list[Violation] = []
 
@@ -506,6 +512,7 @@ class Linter:
             for dec in getattr(node, "decorator_list", []):
                 if self._is_jit_expr(mod, dec):
                     roots.add(qual)
+                    self.jit_roots.add(qual)
                 elif isinstance(dec, ast.Call):
                     dchain = _attr_chain(dec.func) or []
                     is_partial = (
@@ -517,11 +524,13 @@ class Linter:
                     if is_partial and dec.args and \
                             self._is_jit_expr(mod, dec.args[0]):
                         roots.add(qual)
+                        self.jit_roots.add(qual)
                         pos = self._donate_positions(dec)
                         if pos:
                             self.donating[qual] = pos
                     elif self._is_jit_expr(mod, dec.func):
                         roots.add(qual)
+                        self.jit_roots.add(qual)
                         pos = self._donate_positions(dec)
                         if pos:
                             self.donating[qual] = pos
@@ -552,6 +561,7 @@ class Linter:
                     elif isinstance(a0, (ast.Name, ast.Attribute)):
                         tgt_funcs = self._resolve_call(info, a0)
                     roots.update(tgt_funcs)
+                    self.jit_roots.update(tgt_funcs)
                     pos = self._donate_positions(n)
                     for t in tgt_funcs:
                         if pos:
@@ -570,6 +580,25 @@ class Linter:
                             or n.func.id in mod.lax_names):
                         self.jax_touch.add(qual)
                         break
+
+        # module-level jit(fn) bindings (``guard_jit = jax.jit(guard, ...)``)
+        # are compilation units too: the def carries no decorator, so the
+        # call form at module scope is the only evidence
+        for mod in self.modules.values():
+            for n in _own_nodes(mod.tree):
+                if not (isinstance(n, ast.Call)
+                        and self._is_jit_expr(mod, n.func) and n.args):
+                    continue
+                a0 = n.args[0]
+                if not isinstance(a0, ast.Name):
+                    continue  # attribute/lambda at module scope: none yet
+                tgts = self._resolve_in_module(mod, a0.id)
+                roots.update(tgts)
+                self.jit_roots.update(tgts)
+                pos = self._donate_positions(n)
+                for t in tgts:
+                    if pos:
+                        self.donating[t] = pos
 
         # traced: forward closure from roots over call edges.  Host-only
         # serving modules (breaker/admission-queue/IO — _R7_HOST_ONLY_MODULES)

@@ -29,8 +29,6 @@ from __future__ import annotations
 import math
 import os
 
-import numpy as np
-
 import jax
 import jax.extend as jex
 import jax.numpy as jnp
@@ -38,36 +36,36 @@ from jax.interpreters import mlir
 
 
 def _brent_luk_perms(n: int):
-    """(initial basis b0, per-round fixed permutation pi), both (n,) int."""
+    """(initial basis b0, per-round fixed permutation pi), both length-n
+    python int lists.  The planner runs at trace time on the concrete
+    static size, so it stays pure python: its indices become device
+    constants only at the ``jnp.asarray(..., jnp.int32)`` boundary in the
+    callers, never as platform-default-width host arrays."""
     assert n % 2 == 0
-    idx = np.arange(n)
     # f: interleave so that circle-method pairs (i, n-1-i) become adjacent
-    f = np.empty(n, np.int64)
-    f[0::2] = idx[: n // 2]
-    f[1::2] = idx[::-1][: n // 2]
+    f = [0] * n
+    f[0::2] = range(n // 2)
+    f[1::2] = range(n - 1, n // 2 - 1, -1)
     # g: circle-method rotation L' = [L[0], L[-1], L[1], ..., L[-2]]
-    g = np.empty(n, np.int64)
-    g[0] = 0
-    g[1] = n - 1
-    g[2:] = idx[1:-1]
-    f_inv = np.argsort(f)
-    pi = f_inv[g[f]]  # position map of (f^-1 . g . f)
+    g = [0, n - 1] + list(range(1, n - 1))
+    f_inv = sorted(range(n), key=f.__getitem__)  # inverse permutation of f
+    pi = [f_inv[g[fi]] for fi in f]  # position map of (f^-1 . g . f)
     return f, pi
 
 
 def _check_perm_schedule(n):  # exercised by tests/test_eigh.py
     b0, pi = _brent_luk_perms(n)
-    basis = b0.copy()
+    basis = list(b0)
     seen = set()
     for _ in range(n - 1):
         for i in range(n // 2):
             a, b = basis[2 * i], basis[2 * i + 1]
             seen.add((min(a, b), max(a, b)))
-        basis = basis[pi]
+        basis = [basis[p] for p in pi]
     assert len(seen) == n * (n - 1) // 2, len(seen)
     # pi has order n-1: whole sweeps return the basis to b0 — the Pallas
     # kernel's output emission order (eigh_pallas._make_kernel) relies on it
-    assert (basis == b0).all()
+    assert basis == b0
 
 
 def _sweeps_for(n: int, dtype) -> int:
@@ -95,9 +93,9 @@ def jacobi_eigh(A: jax.Array, sweeps: int | None = None,
         A = pad.at[..., n0, n0].set(lb)
     n = A.shape[-1]
 
-    b0_np, pi_np = _brent_luk_perms(n)
-    b0 = jnp.asarray(b0_np)
-    pi = jnp.asarray(pi_np)
+    b0_list, pi_list = _brent_luk_perms(n)
+    b0 = jnp.asarray(b0_list, jnp.int32)
+    pi = jnp.asarray(pi_list, jnp.int32)
     if sweeps is None:
         sweeps = _sweeps_for(n, dtype)
 

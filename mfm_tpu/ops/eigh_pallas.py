@@ -35,8 +35,7 @@ LANES = 128
 
 
 def _make_kernel(n: int, sweeps: int, dtype):
-    b0_np, pi_np = _brent_luk_perms(n)
-    b0, pi = b0_np.tolist(), pi_np.tolist()
+    b0, pi = _brent_luk_perms(n)  # python int lists, n is static
     h = n // 2
     tiny = float(np.finfo(np.float32).tiny * 100)
     # pi has order n-1 (asserted in _brent_luk_perms' dev check), so after
@@ -47,7 +46,7 @@ def _make_kernel(n: int, sweeps: int, dtype):
     # ascending D0) the eigenvalue tracking direction i lands at slot i,
     # which the caller's per-slot statistics rely on (models/eigen.py pairs
     # slot i with D0[i]).
-    inv = np.argsort(b0_np).tolist()
+    inv = sorted(range(n), key=b0.__getitem__)
 
     def perm_rows(x, perm):
         return jnp.stack([x[i] for i in perm], axis=0)

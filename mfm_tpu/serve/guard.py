@@ -171,6 +171,18 @@ def guard_slab(ret, cap, valid, ring, ring_pos, policy, pre_reasons=None,
     return (reasons != 0) & ~heal_mask, reasons, ring, ring_pos
 
 
+#: the standalone guard step: the same traced :func:`guard_slab`, compiled
+#: on its own — ops tooling pre-screens a slab (is this feed day servable?)
+#: without paying for a model update.  ``policy`` is jit-static exactly as
+#: in the fused guarded step; the ring state is donated through (argnums
+#: 3-4) because the screen advances it the same way the update does.  This
+#: is a registered audit entrypoint (mfm_tpu/analysis/registry.py
+#: "guard.step") — its donation/dtype/recompile contracts are proven
+#: statically by ``mfm-tpu audit``.
+guard_slab_jit = jax.jit(guard_slab, static_argnums=(5,),
+                         donate_argnums=(3, 4))
+
+
 def host_date_reasons(dates, last_date=None) -> "object":
     """Host-side pre-check: flag non-monotone / duplicate dates.
 

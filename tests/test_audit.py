@@ -1,0 +1,420 @@
+"""The IR-level static audit (mfm_tpu/analysis/), gated into tier-1.
+
+Four layers, mirroring tests/test_mfmlint.py:
+ 1. the real tree audits strict-clean against the committed budget file,
+    inside the 120 s device-free budget — which is what makes every pass
+    a pre-merge regression gate;
+ 2. pure-function fixtures pin each pass's semantics, including the two
+    historical incident reconstructions the audit exists for: PR 4's
+    donation/aliasing disagreement (both directions, plus an injected
+    non-donated alias in a synthetic executable header) and PR 1's s64
+    retrace trap (an i64 index rung on a declared bucket ladder);
+ 3. the registry-completeness contract: every jit root mfmlint's call
+    graph finds in the package is either a registered entrypoint or a
+    justified NON_ENTRYPOINT_JITS entry — a new jit cannot dodge the
+    audit silently;
+ 4. the committed AUDIT_r*.json snapshot verifies (seal digest, schema,
+    strict-cleanliness, staleness vs the live registry/budgets), and
+    ``mfm-tpu doctor --audit`` exits non-zero on a torn or tampered one.
+"""
+
+import functools
+import json
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mfm_tpu.analysis import aliasing, budgets, collectives, ir, surface
+from mfm_tpu.analysis.registry import (
+    NON_ENTRYPOINT_JITS,
+    Cell,
+    Finding,
+    registry,
+    registry_by_name,
+)
+from mfm_tpu.analysis.run import (
+    latest_snapshot_path,
+    main as audit_main,
+    report_digest,
+    run_audit,
+    verify_snapshot,
+)
+
+
+def _codes(findings):
+    return sorted(f.code for f in findings)
+
+
+def _sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# -- layer 2: A1, the donation-aliasing proof ---------------------------------
+
+def test_parse_input_output_alias_nested_braces():
+    # nested braces ({output}: (param, {param_index}, kind)) — the exact
+    # shape a non-greedy regex would truncate at the first '}'
+    header = ("HloModule jit_step, entry_computation_layout={...}, "
+              "input_output_alias={ {1}: (0, {}, may-alias), "
+              "{2, 0}: (13, {0}, must-alias) }, "
+              "frontend_attributes={fingerprint=\"x\"}")
+    entries = aliasing.parse_input_output_alias(header)
+    assert entries == [
+        {"output": "1", "param": 0, "kind": "may-alias"},
+        {"output": "2,0", "param": 13, "kind": "must-alias"},
+    ]
+    assert aliasing.parse_input_output_alias("HloModule no_alias") == []
+
+
+def test_a1_contract_mismatch_fires_both_directions():
+    # contract donates what the jit doesn't: the host drops a live buffer
+    f = aliasing.check_aliasing("ep", "c", {0, 1}, [True, False], [])
+    assert "donation-contract-mismatch" in _codes(f)
+    assert any("contract donates" in x.message for x in f)
+    # jit donates what the contract retains: the PR 4 corruption class
+    f = aliasing.check_aliasing("ep", "c", set(), [True], [])
+    assert "donation-contract-mismatch" in _codes(f)
+    assert any("PR 4" in x.message for x in f)
+    # agreement is clean (modulo the info-grade inert-donation note)
+    f = aliasing.check_aliasing("ep", "c", {0}, [True, False],
+                                [{"output": "0", "param": 0,
+                                  "kind": "may-alias"}])
+    assert not [x for x in f if x.severity == "error"]
+
+
+def test_a1_injected_nondonated_alias_gates():
+    # synthetic compiled header whose alias map reuses operand 1, which is
+    # NOT donated — executable and declaration disagree (tampering or
+    # registry rot); must be an error, not an info
+    header = "HloModule m, input_output_alias={ {0}: (1, {}, may-alias) }"
+    entries = aliasing.parse_input_output_alias(header)
+    f = aliasing.check_aliasing("ep", "c", {0}, [True, False], entries)
+    errs = [x for x in f if x.severity == "error"]
+    assert _codes(errs) == ["nondonated-alias"]
+
+
+def test_a1_pr4_reconstruction_on_a_real_jit():
+    """Recreate PR 4's bug shape end to end: a jit whose declared donation
+    disagrees with the caller contract must fail the pass, using the real
+    lowering/compile artifacts (not synthetic text)."""
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(x, y):
+        return x + y, y * 2.0
+
+    lowered = step.lower(_sds((8, 8), jnp.float32), _sds((8, 8), jnp.float32))
+    flags = aliasing.donated_operand_flags(lowered)
+    assert flags == [True, False]
+    entries = aliasing.parse_input_output_alias(lowered.compile().as_text())
+    assert any(e["param"] == 0 for e in entries), \
+        "compiled executable did not alias the donated operand"
+
+    # correct contract: no errors
+    ok = aliasing.check_aliasing("fx", "base", {0}, flags, entries)
+    assert not [x for x in ok if x.severity == "error"]
+    # the PR 4 setup: contract says y is donated too — host would drop it
+    bad = aliasing.check_aliasing("fx", "base", {0, 1}, flags, entries)
+    assert "donation-contract-mismatch" in _codes(
+        [x for x in bad if x.severity == "error"])
+    # the dual: contract retains x while the jit retires it
+    bad = aliasing.check_aliasing("fx", "base", set(), flags, entries)
+    assert "donation-contract-mismatch" in _codes(
+        [x for x in bad if x.severity == "error"])
+
+
+def test_a1_inert_donation_is_info_not_error():
+    f = aliasing.check_aliasing("ep", "c", {0}, [True], [])
+    assert [(x.severity, x.code) for x in f] == [("info", "donated-unaliased")]
+
+
+# -- layer 2: A2, the wide-dtype / host-callback audit ------------------------
+
+def test_a2_tensor_dtypes_ignore_attribute_i64():
+    # `dimension = 1 : i64` is an ATTRIBUTE type every StableHLO module
+    # carries; only tensor element types may gate
+    text = """
+    func.func public @main(%arg0: tensor<64x48xf32>) -> tensor<4xi32> {
+      %0 = stablehlo.iota dim = 0 : tensor<4xi32>
+      %1 = stablehlo.reduce(%arg0) {dimensions = array<i64: 1>} : tensor<?xf32>
+      %2 = stablehlo.constant dense<1> : tensor<i1>
+    }"""
+    assert ir.module_tensor_dtypes(text) == {"f32", "i32", "i1"}
+    assert ir.scan_module("ep", "c", text) == []
+
+
+def test_a2_wide_dtype_and_callback_gate():
+    text = """
+      %0 = stablehlo.convert %arg0 : (tensor<4xi32>) -> tensor<4xi64>
+      %1 = stablehlo.constant dense<0.0> : tensor<2x2xf64>
+      %2 = stablehlo.custom_call @xla_python_cpu_callback(%arg1)
+           {call_target_name = "xla_python_cpu_callback"} : tensor<4xf32>
+    """
+    f = ir.scan_module("ep", "c", text)
+    assert _codes(f) == ["host-callback", "wide-dtype"]
+    assert all(x.severity == "error" for x in f)
+    wide = next(x for x in f if x.code == "wide-dtype")
+    assert "f64" in wide.message and "i64" in wide.message
+
+
+def test_a2_nested_complex_f64_detected():
+    text = "%0 = fft %a : tensor<2xcomplex<f64>>"
+    assert "c128" in ir.module_tensor_dtypes(text)
+    assert _codes(ir.scan_module("ep", "c", text)) == ["wide-dtype"]
+
+
+# -- layer 2: A3, the collective audit ----------------------------------------
+
+def test_a3_panel_sized_and_disallowed_collectives_gate():
+    panel_bytes = 64 * 48 * 4
+    s = collectives.audit_hlo(
+        "%all-gather.1 = f32[64,48]{1,0} all-gather(f32[64,24]{1,0} %p0)")
+    f = collectives.check_collectives(
+        "ep", "mesh4x2", s, allow=frozenset({"all-reduce"}),
+        panel_bytes=panel_bytes, gather_budget=1024)
+    assert _codes(f) == ["collective-kind", "full-panel-collective",
+                         "gather-over-budget"]
+    # a bounded reduce inside the allowlist is clean
+    s = collectives.audit_hlo(
+        "%all-reduce.1 = f32[14,14]{1,0} all-reduce(f32[14,14]{1,0} %p1)")
+    f = collectives.check_collectives(
+        "ep", "mesh4x2", s, allow=frozenset({"all-reduce"}),
+        panel_bytes=panel_bytes, gather_budget=1024)
+    assert f == []
+
+
+# -- layer 2: A4, the recompile surface ---------------------------------------
+
+def _ladder_cells(idx_dtype=jnp.int32, buckets=(8, 32, 128), n=3):
+    return [Cell(f"bucket{b}",
+                 (_sds((b, 9), jnp.float32), _sds((b,), idx_dtype)),
+                 {"n": n}, role="ladder", bucket=b)
+            for b in buckets]
+
+
+def test_a4_clean_ladder_has_one_key_per_bucket():
+    cells = _ladder_cells()
+    assert surface.check_ladder("q", "query", cells) == []
+    assert len({surface.cache_key(c) for c in cells}) == len(cells)
+
+
+def test_a4_s64_retrace_trap_caught():
+    """PR 1's incident: one rung's index operand drifts to the platform
+    default i64 (np.arange vs the pad path's pinned i32) — same shapes,
+    different dtype signature, a whole extra compile per bucket."""
+    cells = _ladder_cells()[:2] + _ladder_cells(idx_dtype=jnp.int64,
+                                                buckets=(128,))
+    f = surface.check_ladder("q", "query", cells)
+    assert "ladder-dtype-drift" in _codes(f)
+    assert any("retrace" in x.message for x in f)
+
+
+def test_a4_duplicate_collision_static_and_fixed_point():
+    f = surface.check_ladder("q", "query",
+                             _ladder_cells(buckets=(8, 8)))
+    assert "duplicate-bucket" in _codes(f)
+    assert "bucket-key-collision" in _codes(f)
+
+    drift = _ladder_cells(buckets=(8,)) + _ladder_cells(buckets=(32,), n=4)
+    f = surface.check_ladder("q", "query", drift)
+    assert _codes(f) == ["ladder-static-drift"]
+
+    f = surface.check_ladder("q", "query", _ladder_cells(buckets=(8, 100)))
+    assert "bucket-not-fixed-point" in _codes(f)   # bucket_for(100) == 128
+
+
+def test_a4_registered_ladders_declare_the_production_buckets():
+    """The exact-arity contract on the real registry: query/scenario ride
+    bucket_for's 8*4^i ladder, eigen rides draw_bucket's pow2 >= 64 —
+    and every ladder's rungs map 1:1 onto distinct jit cache keys."""
+    expected = {"query": (8, 32, 128), "scenario": (8, 32, 128),
+                "eigen": (64, 128, 256)}
+    seen = set()
+    for ep in registry():
+        if ep.ladder is None:
+            continue
+        seen.add(ep.ladder)
+        rungs = [c for c in ep.cells() if c.role == "ladder"]
+        assert tuple(c.bucket for c in rungs) == expected[ep.ladder], ep.name
+        assert len({surface.cache_key(c) for c in rungs}) == len(rungs)
+        assert surface.check_ladder(ep.name, ep.ladder, rungs) == []
+    assert seen == set(expected)
+
+
+# -- layer 2: A5, the static memory budgets -----------------------------------
+
+def _budget_doc(cells):
+    return {"schema": budgets.BUDGETS_SCHEMA, "tolerance": 0.25,
+            "cells": cells}
+
+
+def test_a5_measure_cell_workspace_nets_out_donation():
+    mem = {"temp_bytes": 10, "argument_bytes": 100, "output_bytes": 50,
+           "alias_bytes": 40, "generated_code_size_in_bytes": 999}
+    assert budgets.measure_cell(mem) == {"temp_bytes": 10,
+                                         "workspace_bytes": 120}
+
+
+def test_a5_over_stale_unbudgeted_and_floor():
+    doc = _budget_doc({
+        "a/over": {"temp_bytes": 1_000_000, "workspace_bytes": 1_000_000},
+        "a/stale": {"temp_bytes": 4_000_000, "workspace_bytes": 4_000_000},
+        "a/tiny": {"temp_bytes": 1_000, "workspace_bytes": 1_000},
+        "a/gone": {"temp_bytes": 1, "workspace_bytes": 1},
+    })
+    measured = {
+        "a/over": {"temp_bytes": 2_000_000, "workspace_bytes": 1_000_000},
+        "a/stale": {"temp_bytes": 1_000_000, "workspace_bytes": 4_000_000},
+        # 5x over budget but under the 64 KiB floor: allocator jitter,
+        # not a regression
+        "a/tiny": {"temp_bytes": 5_000, "workspace_bytes": 5_000},
+        "a/new": {"temp_bytes": 1, "workspace_bytes": 1},
+    }
+    f = budgets.check_budgets(measured, doc)
+    got = {(x.code, x.severity) for x in f}
+    assert got == {("over-temp_bytes", "error"),
+                   ("stale-temp_bytes", "warn"),
+                   ("unbudgeted", "error"),
+                   ("stale-budget", "error")}
+
+
+def test_a5_committed_budgets_cover_exactly_the_primary_cells():
+    doc = budgets.load_budgets()
+    assert doc["schema"] == budgets.BUDGETS_SCHEMA
+    primary = {f"{ep.name}/{c.name}" for ep in registry()
+               for c in ep.cells() if c.role == "primary"}
+    assert set(doc["cells"]) == primary
+
+
+# -- layer 3: registry completeness -------------------------------------------
+
+def test_registry_covers_every_package_jit_root():
+    """mfmlint's call graph enumerates every jit/pjit compilation unit in
+    the package; each must be a registered audit entrypoint or carry a
+    reviewed justification in NON_ENTRYPOINT_JITS — and neither list may
+    go stale."""
+    from mfm_tpu.lint import REPO_ROOT, Linter, collect_files
+
+    lint = Linter()
+    for f in collect_files(["mfm_tpu"], REPO_ROOT):
+        lint.add_file(f, relto=REPO_ROOT)
+    lint.build()
+    roots = set(lint.jit_roots)
+    assert roots, "call graph found no jit roots — linter regression?"
+
+    registered = {ep.qualname for ep in registry()}
+    justified = set(NON_ENTRYPOINT_JITS)
+    assert not registered & justified, "a qualname cannot be both"
+    missing = roots - registered - justified
+    assert not missing, (
+        f"jit roots with neither an audit registration nor a justification:"
+        f" {sorted(missing)} — register them in mfm_tpu/analysis/registry.py"
+        f" or add a reviewed NON_ENTRYPOINT_JITS entry")
+    ghosts = (registered | justified) - roots
+    assert not ghosts, (
+        f"registry/justification entries that are no longer jit roots: "
+        f"{sorted(ghosts)} — remove the stale entries")
+
+
+def test_registry_by_name_and_donation_contracts():
+    ep = registry_by_name("risk.fused")
+    assert ep.donate == (0, 1, 2, 3, 4)
+    with pytest.raises(KeyError):
+        registry_by_name("no.such.entrypoint")
+
+
+# -- layer 1: the real tree ---------------------------------------------------
+
+def test_full_audit_is_strict_clean_device_free_and_fast():
+    assert jax.default_backend() == "cpu"   # lowering-only, by construction
+    rep = run_audit()
+    assert not rep.errors, "\n".join(f.message for f in rep.errors)
+    assert rep.strict_clean
+    assert rep.wall_s < 120, f"audit blew its device-free budget: {rep.wall_s}"
+    # measured cells match the committed budget file exactly
+    assert set(rep.measured) == set(budgets.load_budgets()["cells"])
+    # mesh evidence is present and inside the fused step's allowlist
+    mesh = rep.cells.get("risk.fused/mesh4x2")
+    assert mesh is not None and mesh["compiled"]
+    kinds = set(mesh["collectives"]["by_kind"])
+    assert kinds and kinds <= {"all-reduce", "all-gather"}
+    # production f32 mode: no wide dtype anywhere in the lowered evidence
+    for key, entry in rep.cells.items():
+        if "tensor_dtypes" in entry:
+            assert not ({"f64", "i64"} & set(entry["tensor_dtypes"])), key
+
+
+def test_audit_cli_surface_pass_only_is_cheap_and_clean():
+    assert audit_main(["--passes", "A4"]) == 0
+
+
+def test_audit_baseline_suppression_and_stale_detection():
+    fake = [{"key": "A4:ghost.ep:ladder:empty-ladder", "note": "test"}]
+    rep = run_audit(passes=("A4",), baseline=fake)
+    assert rep.stale_baseline == ["A4:ghost.ep:ladder:empty-ladder"]
+    assert not rep.strict_clean   # stale baseline fails --strict
+
+
+# -- layer 4: the committed snapshot and the doctor ---------------------------
+
+def test_committed_snapshot_verifies():
+    snap = latest_snapshot_path()
+    assert snap, "no committed AUDIT_r*.json"
+    problems, _warns, doc = verify_snapshot(snap)
+    assert problems == [], problems
+    assert doc["strict_clean"]
+
+
+def test_tampered_and_torn_snapshots_fail(tmp_path):
+    snap = latest_snapshot_path()
+    doc = json.load(open(snap, encoding="utf-8"))
+
+    # tamper: delete the findings but keep the old seal
+    forged = dict(doc, findings=[])
+    p = tmp_path / "forged.json"
+    p.write_text(json.dumps(forged))
+    problems, _, _ = verify_snapshot(str(p))
+    assert any("seal digest mismatch" in m for m in problems)
+
+    # re-sealing a forged summary is caught by the strict-clean check
+    lying = dict(doc, strict_clean=False)
+    lying["sha256"] = report_digest(lying)
+    p2 = tmp_path / "lying.json"
+    p2.write_text(json.dumps(lying))
+    problems, _, _ = verify_snapshot(str(p2))
+    assert any("NOT strict-clean" in m for m in problems)
+
+    # torn mid-write: unparseable, reported as a problem (not a crash)
+    p3 = tmp_path / "torn.json"
+    p3.write_text(json.dumps(doc)[: len(json.dumps(doc)) // 2])
+    problems, _, d = verify_snapshot(str(p3))
+    assert d is None and problems
+
+
+def test_doctor_audit_exit_codes(tmp_path, capsys):
+    from mfm_tpu.cli import main as cli_main
+
+    with pytest.raises(SystemExit) as e:
+        cli_main(["doctor", "--audit"])
+    assert e.value.code == 0
+    rec = json.loads(capsys.readouterr().out)["records"][0]
+    assert rec["kind"] == "audit_snapshot" and rec["status"] == "ok"
+
+    torn = tmp_path / "torn.json"
+    torn.write_text('{"schema": "mfmaudit/1", "cells": {')
+    with pytest.raises(SystemExit) as e:
+        cli_main(["doctor", "--audit", str(torn)])
+    assert e.value.code == 1
+    rec = json.loads(capsys.readouterr().out)["records"][0]
+    assert rec["status"] == "corrupt"
+
+    # doctor without a path and without --audit refuses with guidance
+    with pytest.raises(SystemExit) as e:
+        cli_main(["doctor"])
+    assert "PATH is required" in str(e.value)
+
+
+def test_findings_key_schema_is_stable():
+    f = Finding("A1", "error", "risk.fused", "base", "nondonated-alias", "m")
+    assert f.key() == "A1:risk.fused:base:nondonated-alias"
+    assert f.to_dict()["severity"] == "error"

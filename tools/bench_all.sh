@@ -26,6 +26,13 @@ python tools/mfmlint.py --strict \
   || { echo "mfmlint violations — fix or baseline before benching" >&2
        exit 1; }
 
+# ... and the IR-level proofs next to the AST-level ones: donation aliasing,
+# wide dtypes, collectives, recompile surface, memory budgets (device-free,
+# lowering only — runs fine before the backend probe below)
+JAX_PLATFORMS=cpu python tools/mfmaudit.py --strict \
+  || { echo "mfmaudit violations — fix, re-budget, or baseline before benching" >&2
+       exit 1; }
+
 # probe the backend ONCE here: each bench.py run would otherwise repeat its
 # own multi-attempt probe (~6.5 min per config against a dead tunnel);
 # a dead tunnel pins every config straight to the CPU fallback instead

@@ -1,30 +1,24 @@
-"""Audit the XLA collectives behind the sharded pipeline stages.
+#!/usr/bin/env python
+"""DEPRECATED shim — the collective audit moved into the analysis package.
 
-The mesh layout doctrine (``mfm_tpu/parallel/mesh.py``) makes concrete,
-checkable claims: the cross-sectional regression's stock-axis reductions
-become all-reduces (riding ICI), the rolling kernels' stock-only layout
-needs NO communication at all, and no stage ever moves a full (T, N) panel
-between devices.  One carve-out is explicit: XLA's eigh is not
-batch-partitionable, so the hoisted batched decompositions gather their
-tiny (T, K, K) normal/covariance batches — a bounded K^2-sized gather of
-doctrine-replicated small matrices, not panel movement.  This tool compiles
-each stage for real mesh shapes on the 8-virtual-device CPU backend and
-reports every collective op XLA inserted — kind, count, and operand size —
-so the doctrine is inspectable evidence instead of a docstring claim
-(SURVEY.md §2.4: the reference has no communication backend; this is ours).
+The communication-layout audit now lives in
+``mfm_tpu/analysis/collectives.py``, where it runs as pass A3 of the full
+static audit (``python tools/mfmaudit.py``, ``mfm-tpu audit``) over EVERY
+registered jit entrypoint instead of just the three pipeline stages this
+script covered.  This wrapper re-exports the public surface so existing
+imports (tests/test_collective_audit.py, external scripts) and the
+standalone report mode keep working; new code should import
+``mfm_tpu.analysis.collectives`` directly.
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python tools/collective_audit.py            # prints a JSON report
 
-Exit code is 0 iff the structural invariants hold (rolling: zero
-collectives; all stages: largest collective strictly smaller than the full
-panel).
+Exit code is 0 iff the structural invariants hold, exactly as before.
 """
 from __future__ import annotations
 
 import json
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -33,182 +27,18 @@ _FLAG = "--xla_force_host_platform_device_count=8"
 if _FLAG not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = f"{os.environ.get('XLA_FLAGS', '')} {_FLAG}".strip()
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")  # the env var is unreliable here
-
-import jax.numpy as jnp  # noqa: E402
-import numpy as np  # noqa: E402
-
-from mfm_tpu.config import RiskModelConfig  # noqa: E402
-from mfm_tpu.models.risk_model import RiskModel  # noqa: E402
-from mfm_tpu.ops.rolling import rolling_beta_hsigma  # noqa: E402
-from mfm_tpu.parallel.mesh import (  # noqa: E402
-    PIPELINE_SPECS,
-    make_mesh,
-    panel_sharding,
+from mfm_tpu.analysis.collectives import (  # noqa: E402,F401
+    audit_hlo,
+    build_report,
+    check_invariants,
+    compiled_text,
+    eigh_gather_budget,
 )
-
-# optimized-HLO collective ops and their result types — plain or variadic:
-#   %all-reduce.3 = f32[8,42]{1,0} all-reduce(...)
-#   %all-reduce.9 = (f32[16,5]{1,0}, f32[16,3]{1,0}) all-reduce(...)
-_COLLECTIVE = re.compile(
-    r"=\s*(\([^)]*\)|[a-z0-9]+\[[0-9,]*\]\S*)\s+"
-    r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
-    r"(-start|-done)?\("
-)
-_SHAPE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
-_DTYPE_BYTES = {"f64": 8, "f32": 4, "s64": 8, "u64": 8, "s32": 4, "u32": 4,
-                "pred": 1, "s8": 1, "u8": 1, "bf16": 2, "f16": 2}
-
-
-def _type_bytes(type_str: str) -> int:
-    """Total bytes across every array in a (possibly tuple) HLO result type."""
-    total = 0
-    for dtype, dims in _SHAPE.findall(type_str):
-        n = int(np.prod([int(d) for d in dims.split(",")])) if dims else 1
-        total += n * _DTYPE_BYTES.get(dtype, 4)
-    return total
-
-
-def audit_hlo(text: str) -> dict:
-    """Count collectives in optimized HLO and size their results."""
-    found = []
-    for type_str, kind, suffix in _COLLECTIVE.findall(text):
-        if suffix == "-done":  # async pair: count the -start only
-            continue
-        found.append({"kind": kind, "bytes": _type_bytes(type_str)})
-    by_kind: dict[str, int] = {}
-    for f in found:
-        by_kind[f["kind"]] = by_kind.get(f["kind"], 0) + 1
-    reduces = ("all-reduce", "reduce-scatter")
-    return {
-        "total": len(found),
-        "by_kind": by_kind,
-        "largest_bytes": max((f["bytes"] for f in found), default=0),
-        "largest_non_reduce_bytes": max(
-            (f["bytes"] for f in found if f["kind"] not in reduces),
-            default=0),
-        "non_reduce_kinds": sorted({f["kind"] for f in found
-                                    if f["kind"] not in reduces}),
-    }
-
-
-def check_invariants(regression: dict, full_pipeline: dict,
-                     rolling_beta: dict, *, panel_bytes: int,
-                     eigh_gather_budget: int) -> dict:
-    """Evaluate the mesh-layout doctrine on audited stage HLO.
-
-    Takes the :func:`audit_hlo` summaries of the three compiled stages and
-    returns the named structural invariants plus an overall ``ok``.  Pure
-    and importable: tests assert the doctrine in-process on whatever HLO
-    they compiled, no subprocess and no report plumbing.
-
-    One structural exception is carved out explicitly rather than hidden:
-    XLA's eigh (QDWH) is not batch-partitionable on this jaxlib, so the
-    hoisted batched pseudo-inverse/eigen decompositions gather their tiny
-    (T, K, K) matrix batches (plus QDWH's (2K, 2K) workspace) onto every
-    device.  That is a K^2-sized gather of replicated-by-doctrine small
-    matrices, NOT (T, N) panel movement — bound it by ``eigh_gather_budget``
-    and reject anything larger.
-    """
-    inv = {
-        "rolling_is_communication_free": rolling_beta["total"] == 0,
-        "no_full_panel_collective": all(
-            e["largest_bytes"] < max(panel_bytes, eigh_gather_budget)
-            for e in (regression, full_pipeline)),
-        # the regression stage communicates through reductions only, except
-        # the bounded all-gather feeding the batched eigh
-        "regression_is_reduce_only": (
-            set(regression["non_reduce_kinds"]) <= {"all-gather"}
-            and regression["largest_non_reduce_bytes"] <= eigh_gather_budget),
-    }
-    inv["ok"] = all(inv.values())
-    return inv
-
-
-def compiled_text(fn, mesh, arg_specs, *args) -> str:
-    shardings = [jax.NamedSharding(mesh, s) for s in arg_specs]
-    placed = [jax.device_put(a, s) for a, s in zip(args, shardings)]
-    return jax.jit(fn).lower(*placed).compile().as_text()
-
-
-def build_report(T=192, N=96, P=8, Q=4, meshes=((8, 1), (4, 2), (2, 4))):
-    # the audit is a structural check of the f32 production fast path; x64
-    # (the test suite's golden-parity mode) changes GSPMD's decisions —
-    # f64 batches are Pallas-ineligible and the partitioner inserts extra
-    # gathers — so pin it off for the duration of the build
-    from jax.experimental import disable_x64
-
-    with disable_x64():
-        return _build_report(T, N, P, Q, meshes)
-
-
-def _build_report(T, N, P, Q, meshes):
-    from jax.sharding import PartitionSpec as Sp
-
-    rng = np.random.default_rng(0)
-    ret = jnp.asarray(rng.normal(0, 0.02, (T, N)))
-    cap = jnp.asarray(rng.lognormal(10, 1, (T, N)))
-    styles = jnp.asarray(rng.normal(0, 1, (T, N, Q)))
-    industry = jnp.asarray(rng.integers(0, P, (T, N)))
-    valid = jnp.asarray(rng.random((T, N)) > 0.05)
-    mkt = jnp.asarray(rng.normal(0, 0.01, T))
-    cfg = RiskModelConfig(eigen_n_sims=4, eigen_sim_length=64)
-    K = 1 + P + Q
-    sim = jnp.asarray(rng.normal(size=(4, K, 64)))
-    d = sim - sim.mean(axis=-1, keepdims=True)
-    sim_covs = jnp.einsum("mkt,mlt->mkl", d, d) / 63.0
-
-    def regression(ret, cap, styles, industry, valid):
-        m = RiskModel(ret, cap, styles, industry, valid,
-                      n_industries=P, config=cfg)
-        return m.reg_by_time()[:2]
-
-    def full(ret, cap, styles, industry, valid, sim_covs):
-        m = RiskModel(ret, cap, styles, industry, valid,
-                      n_industries=P, config=cfg)
-        return m.run(sim_covs=sim_covs)
-
-    def rolling(ret, mkt):
-        return rolling_beta_hsigma(ret, mkt, window=64, half_life=16,
-                                   min_periods=8)
-
-    panel_bytes = int(ret.size * ret.dtype.itemsize)
-    report = {"shape": {"T": T, "N": N, "K": K},
-              "panel_bytes": panel_bytes, "meshes": {}}
-    ok = True
-    # the canonical cross-sectional layouts, by argument name (mesh.py)
-    xsec_specs = [PIPELINE_SPECS[k]
-                  for k in ("ret", "cap", "styles", "industry", "valid")]
-    for nd, ns in meshes:
-        mesh = make_mesh(nd, ns)
-        entry = {}
-        entry["regression"] = audit_hlo(compiled_text(
-            regression, mesh, xsec_specs,
-            ret, cap, styles, industry, valid))
-        entry["full_pipeline"] = audit_hlo(compiled_text(
-            full, mesh, xsec_specs + [PIPELINE_SPECS["sim_covs"]],
-            ret, cap, styles, industry, valid, sim_covs))
-        roll_spec = panel_sharding(mesh, rolling=True).spec
-        entry["rolling_beta"] = audit_hlo(compiled_text(
-            rolling, mesh, [roll_spec, Sp()], ret, mkt))
-
-        # doctrine invariants (see check_invariants for the eigh carve-out)
-        eigh_gather_budget = T * (2 * K) * (2 * K) * 8  # f64 upper bound
-        entry["eigh_gather_budget_bytes"] = eigh_gather_budget
-        inv = check_invariants(
-            entry["regression"], entry["full_pipeline"],
-            entry["rolling_beta"], panel_bytes=panel_bytes,
-            eigh_gather_budget=eigh_gather_budget)
-        entry.update((k, v) for k, v in inv.items() if k != "ok")
-        ok &= inv["ok"]
-        report["meshes"][f"{nd}x{ns}"] = entry
-    report["invariants_hold"] = ok
-    return report
-
 
 if __name__ == "__main__":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # the env var is unreliable here
     rep = build_report()
     print(json.dumps(rep, indent=1))
     sys.exit(0 if rep["invariants_hold"] else 1)

@@ -221,6 +221,10 @@ def main(argv=None):
 
     failures = []
     for fac, r in rep.iterrows():
+        # gate on plain host floats: the asarray/.item() round-trip is also
+        # the R5-visible proof that the timed span closes on materialized
+        # parity stats, not pending device work
+        r = {k: np.asarray(v, np.float64).item() for k, v in r.items()}
         if r["n_overlap"] == 0:
             failures.append(f"{fac}:no_overlap")
             continue

@@ -1178,6 +1178,12 @@ def main(argv=None) -> int:
     try:
         t0 = time.perf_counter()
         base = Baseline(root, args.seed)
+        # the carries are host copies by construction (_carries); summing
+        # their sizes through asarray is the R5-visible proof that the
+        # baseline span closes on materialized data, and sizes the state
+        # the plans replay from
+        carry_bytes = int(sum(np.asarray(c).nbytes
+                              for c in base.carries[-1]))
         baseline_s = time.perf_counter() - t0
         for plan in plans:
             t0 = time.perf_counter()
@@ -1211,7 +1217,8 @@ def main(argv=None) -> int:
 
     failed = [r["plan"] for r in results if r["status"] != "pass"]
     summary = {"plans": len(results), "failed": failed,
-               "baseline_wall_s": round(baseline_s, 3)}
+               "baseline_wall_s": round(baseline_s, 3),
+               "baseline_carry_bytes": carry_bytes}
     print(json.dumps(summary))
     if args.out:
         with open(args.out, "w") as fh:

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Executable wrapper for the IR-level static audit (mfm_tpu/analysis/).
+
+Usage:
+  python tools/mfmaudit.py [--strict] [--json FILE] [--passes A1,A3]
+                           [--baseline FILE] [--budgets FILE]
+                           [--write-budgets]
+
+Lowers every registered jit entrypoint across the declared config matrix
+and runs the five passes (A1 donation-aliasing proof, A2 wide-dtype /
+host-callback audit, A3 collective audit, A4 recompile-surface
+enumeration, A5 static memory budgets).  Nothing executes: the audit is
+device-free by construction, so this wrapper pins the CPU backend and a
+fixed 8-way host-device split BEFORE jax is imported — the same audit on
+a TPU host and in CI must lower the same programs.
+
+Kept as a thin shim so the same passes are importable
+(`mfm_tpu.analysis.run_audit` in tests, `mfm-tpu audit` on the CLI) and
+runnable standalone from tools/bench_all.sh next to mfmlint.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "jax" not in sys.modules:   # under pytest, conftest already pinned these
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _FLAG = "--xla_force_host_platform_device_count=8"
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = \
+            f"{os.environ.get('XLA_FLAGS', '')} {_FLAG}".strip()
+
+from mfm_tpu.analysis.run import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
