@@ -152,15 +152,35 @@ def _roofline(stage_seconds, models, measured=None):
     return out
 
 
-def bench_riskmodel():
+def _smoke_t():
+    """Optional history-length bound for --universe smoke runs.  The full
+    alla history (T=2500) at N=5000 is a multi-minute single-core run; CI
+    smokes set BENCH_SMOKE_T to bound it.  The override is baked into the
+    universe NAME (resolve_universe), so a bounded record can never
+    masquerade as the full-length workload."""
+    raw = os.environ.get("BENCH_SMOKE_T", "")
+    try:
+        return max(8, int(raw))
+    except ValueError:
+        return None
+
+
+def bench_riskmodel(universe="csi300", devices=None):
     import jax
     import jax.numpy as jnp
     from mfm_tpu.config import RiskModelConfig
+    from mfm_tpu.data.synthetic import resolve_universe
     from mfm_tpu.models.eigen import simulated_eigen_covs
     from mfm_tpu.models.risk_model import RiskModel
     from __graft_entry__ import _synthetic_risk_inputs
 
-    T, N, P, Q = 1390, 300, 31, 10
+    u = resolve_universe(universe, T=_smoke_t())
+    if u.name != "csi300" or (devices or 1) > 1:
+        # any non-flagship shape (or a mesh) takes the scaling path: fused
+        # e2e + eigen stage under the ('date','stock') mesh, no full
+        # observability battery — the record feeds the N x devices curve
+        return _bench_riskmodel_universe(u, devices or 1)
+    T, N, P, Q = u.T, u.N, u.P, u.Q
     K = 1 + P + Q
     M = 100
     args = _synthetic_risk_inputs(T, N, P, Q, dtype=jnp.float32, seed=0)
@@ -425,6 +445,14 @@ def bench_riskmodel():
     return {"metric": "csi300_riskmodel_e2e_wall",
             "value": round(_stage_s("fused_e2e"), 4),
             "unit": "s", "vs_baseline": round(cpu_s / tpu_s, 2),
+            # the universe axis (PR 11): every riskmodel record names its
+            # (N, T) workload so tools/perfgate.py can key baselines by
+            # (backend, universe_n) and an N=5000 wall never false-
+            # regresses against N=300 history
+            "universe": u.name, "universe_n": N, "universe_t": T,
+            "devices": 1,
+            "e2e_wall_s": round(_stage_s("fused_e2e"), 4),
+            "stocks_per_sec": round(N * T / tpu_s),
             # the denominator is the golden-NumPy serial proxy timed on
             # subsamples and extrapolated (statsmodels absent) — a LOWER
             # BOUND on the reference's own time, so the ratio is a bound,
@@ -472,6 +500,96 @@ def bench_riskmodel():
                            "stage-boundary materialization",
             "memory": mem_rec,
             "roofline": _roofline(stage_s, models, measured_cost)}
+
+
+def _bench_riskmodel_universe(u, devices):
+    """The --universe scaling path of config 1: fused risk-stack e2e and
+    the eigen stage under a ``('date','stock')`` mesh of ``devices``
+    devices (all on the embarrassingly-parallel 'date' axis).
+
+    Deliberately lighter than the flagship csi300 record — no per-stage
+    memory/roofline battery — because its job is the scaling curve: walls,
+    stocks/sec and eigen GFLOP/s at each (N, devices) cell
+    (MULTICHIP_r06.json).  Panels are ``pad_to_mesh``-padded (inert by the
+    masked design: valid pads False, data pads 0) and sharded with the
+    canonical cross-section layout; the math inside then follows the mesh
+    doctrine (stock axis gathered once per stage), so these walls time the
+    SAME program the bitwise parity tests in tests/test_sharding.py pin
+    against the single-device run."""
+    import jax
+    import jax.numpy as jnp
+    from mfm_tpu.config import RiskModelConfig
+    from mfm_tpu.models.eigen import sim_sweeps_for, simulated_eigen_covs
+    from mfm_tpu.models.risk_model import RiskModel
+    from mfm_tpu.parallel.mesh import (
+        make_mesh, pad_to_mesh, shard_panel, use_mesh)
+    from __graft_entry__ import _synthetic_risk_inputs
+
+    T, N, P, Q = u.T, u.N, u.P, u.Q
+    K = 1 + P + Q
+    M = 100
+    n_dev = max(1, int(devices))
+    avail = jax.device_count()
+    if n_dev > avail:
+        raise SystemExit(
+            f"--devices {n_dev} but only {avail} JAX devices are up; run "
+            "through bench.py --devices N (it sets XLA_FLAGS="
+            "--xla_force_host_platform_device_count before importing jax)")
+    args = _synthetic_risk_inputs(T, N, P, Q, dtype=jnp.float32, seed=0)
+    cfg = RiskModelConfig(eigen_n_sims=M, eigen_sim_length=T)
+    sim_covs = simulated_eigen_covs(jax.random.key(0), K, T, M, jnp.float32)
+
+    mesh = make_mesh(devices=jax.devices()[:n_dev])
+    padded = [pad_to_mesh(a, mesh) for a in args]
+
+    def _sum_finite(*xs):
+        return sum(jnp.sum(jnp.where(jnp.isfinite(x), x, 0.0)) for x in xs)
+
+    with use_mesh(mesh):
+        def fused_step():
+            # fresh sharded copies per call: run_fused donates its panels
+            # (jnp.array, not asarray — asarray aliases the committed
+            # buffer on a 1-device mesh and the donation deletes it)
+            fresh = shard_panel([jnp.array(a) for a in padded], mesh)
+            rm = RiskModel(*fresh, n_industries=P, config=cfg)
+            out = rm.run_fused(sim_covs=sim_covs, sim_length=T)
+            return _sum_finite(out.factor_ret, out.vr_cov) + jnp.sum(out.lamb)
+
+        e2e_s = _time3(fused_step)
+
+        # the eigen stage alone (the 18 s serial-LAPACK floor this mesh
+        # attacks): jitted with its real inputs as arguments, like the
+        # csi300 per-stage split
+        @jax.jit
+        def eig_f(ret, cap, styles, industry, valid, c, v, s):
+            m = RiskModel(ret, cap, styles, industry, valid,
+                          n_industries=P, config=cfg)
+            return _sum_finite(*m.eigen_risk_adj_by_time(
+                c, v, sim_covs=s, sim_length=T))
+
+        sharded = shard_panel([jnp.array(a) for a in padded], mesh)
+        rm0 = RiskModel(*sharded, n_industries=P, config=cfg)
+        factor_ret = rm0.reg_by_time()[0]
+        nw_cov, nw_valid = rm0.newey_west_by_time(factor_ret)
+        eig_s = _time3(eig_f, *sharded, nw_cov, nw_valid, sim_covs)
+
+    models = _riskmodel_stage_models(
+        T, N, P, Q, K, M, sweeps=sim_sweeps_for(K, jnp.float32, T))
+    return {"metric": "riskmodel_e2e_wall",
+            "value": round(e2e_s, 4), "unit": "s", "vs_baseline": None,
+            "universe": u.name, "universe_n": N, "universe_t": T,
+            "devices": n_dev,
+            "mesh": {"date": int(mesh.shape["date"]),
+                     "stock": int(mesh.shape["stock"])},
+            "padded_t": int(padded[0].shape[0]),
+            "e2e_wall_s": round(e2e_s, 4),
+            "stocks_per_sec": round(N * T / e2e_s),
+            "e2e_dates_per_sec": round(T / e2e_s),
+            "eigen_stage_wall_s": round(eig_s, 4),
+            "eigen_stage_gflops": round(models["eigen"]["gflop"] / eig_s, 1),
+            # virtual host devices share physical cores — wall-clock
+            # speedup is bounded by this, record it next to every cell
+            "host_cpu_count": os.cpu_count()}
 
 
 def bench_chunk_sweep():
@@ -624,7 +742,7 @@ def bench_factors():
             "unit": "s", "vs_baseline": None}
 
 
-def bench_alla():
+def bench_alla(universe="alla"):
     """Config 4, the REAL workload (VERDICT r3 weak #5): full 16-factor
     pipeline + post-processing + cross-sectional regression + covariance
     stack at all-A scale (5,000 stocks x 2,500 dates).
@@ -646,8 +764,10 @@ def bench_alla():
     from mfm_tpu.models.eigen import simulated_eigen_covs
     from mfm_tpu.models.risk_model import RiskModel
     from mfm_tpu.pipeline import BARRA_OUTPUT_STYLES
+    from mfm_tpu.data.synthetic import resolve_universe
 
-    T, N, P, Q, M = 2500, 5000, 31, 10, 100
+    u = resolve_universe(universe, T=_smoke_t())
+    T, N, P, Q, M = u.T, u.N, u.P, u.Q, 100
     K = 1 + P + Q
     data = synthetic_market_panel(T=T, N=N, n_industries=P, seed=1)
     fields = panel_to_engine_fields(data, jnp.float32)
@@ -689,10 +809,15 @@ def bench_alla():
                 + jnp.sum(out.lamb))
 
     risk_s = _time3(risk_fn, factors, fields["circ_mv"], industry, sim_covs)
+    e2e = fac_s + risk_s
     return {"metric": "alla_full_pipeline_wall",
-            "value": round(fac_s + risk_s, 4), "unit": "s",
+            "value": round(e2e, 4), "unit": "s",
             "vs_baseline": None,
-            "e2e_dates_per_sec": round(T / (fac_s + risk_s)),
+            "universe": u.name, "universe_n": N, "universe_t": T,
+            "devices": 1,
+            "e2e_wall_s": round(e2e, 4),
+            "stocks_per_sec": round(N * T / e2e),
+            "e2e_dates_per_sec": round(T / e2e),
             "stages": {"factors_post": round(fac_s, 4),
                        "risk_stack": round(risk_s, 4)}}
 
@@ -970,13 +1095,17 @@ def _probe_backend(attempts=None, timeout=None, extra_env=None):
     return None, err
 
 
-def _run_inner(config, platform, timeout):
+def _run_inner(config, platform, timeout, universe=None, devices=None):
     """Run one bench config in a subprocess; return (record|None, error|None).
     The subprocess prints the JSON record as its last stdout line."""
     cmd = [sys.executable, os.path.abspath(__file__), "--config", config,
            "--inner"]
     if platform:
         cmd += ["--platform", platform]
+    if universe is not None:
+        cmd += ["--universe", str(universe)]
+    if devices is not None:
+        cmd += ["--devices", str(devices)]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=timeout, cwd=REPO)
@@ -994,6 +1123,15 @@ def _run_inner(config, platform, timeout):
 
 
 def _inner_main(args):
+    if args.devices and args.devices > 1:
+        # must land before the FIRST jax import in this process — the
+        # virtual host-device count is read once at backend bring-up.
+        # An explicit count already in the env wins (conftest/CI pins).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{args.devices}").strip()
     if args.platform:
         os.environ["JAX_PLATFORMS"] = args.platform
         import jax
@@ -1005,7 +1143,21 @@ def _inner_main(args):
     # path (deserialize instead of compile) — the per-machine number
     # BASELINE.md documents next to the cold compile
     cache_dir = enable_persistent_compilation_cache()
-    rec = CONFIGS[args.config]()
+    import inspect
+    fn = CONFIGS[args.config]
+    params = inspect.signature(fn).parameters
+    kw = {}
+    if args.universe is not None:
+        if "universe" not in params:
+            raise SystemExit(
+                f"config {args.config!r} has no --universe axis")
+        kw["universe"] = args.universe
+    if args.devices is not None:
+        if "devices" not in params:
+            raise SystemExit(
+                f"config {args.config!r} has no --devices axis")
+        kw["devices"] = args.devices
+    rec = fn(**kw)
     if "compile_s" in rec:
         rec["compilation_cache"] = cache_dir
     import jax
@@ -1020,6 +1172,15 @@ def main():
                     help="run the bench in-process (no probe/retry harness)")
     ap.add_argument("--platform", default=None,
                     help="pin a JAX platform (e.g. cpu) before running")
+    ap.add_argument("--universe", default=None, metavar="U",
+                    help="workload universe for configs with a universe "
+                         "axis (riskmodel/alla): csi300, alla, or a stock "
+                         "count N (data/synthetic.py::resolve_universe)")
+    ap.add_argument("--devices", type=int, default=None, metavar="D",
+                    help="run the config on a D-device ('date','stock') "
+                         "mesh; on CPU hosts this sets XLA_FLAGS="
+                         "--xla_force_host_platform_device_count=D in the "
+                         "inner process (same pjit code path as TPU)")
     ap.add_argument("--timeout", type=float, default=2400.0,
                     help="per-attempt subprocess timeout, seconds")
     ap.add_argument("--profile-dir", default=None, metavar="DIR",
@@ -1058,7 +1219,8 @@ def main():
         attempts = ([None, "cpu"] if platform else ["cpu"])
     rec = None
     for plat in attempts:
-        rec, err = _run_inner(args.config, plat, args.timeout)
+        rec, err = _run_inner(args.config, plat, args.timeout,
+                              universe=args.universe, devices=args.devices)
         if rec is not None:
             break
         errors.append(f"{plat or 'default'}: {err}")

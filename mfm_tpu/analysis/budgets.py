@@ -139,12 +139,16 @@ def check_budgets(measured: dict, budgets: dict) -> list:
 
 
 def run_pass(artifacts: dict, budgets_path: str = DEFAULT_BUDGETS_PATH):
-    """A5 over every compiled primary cell.  Returns ``(findings,
-    measured)`` — the measurements ride into the audit report and the
-    ``--write-budgets`` flow."""
+    """A5 over every compiled primary AND mesh cell.  Mesh cells carry
+    budgets too (PR 11): a sharded program whose per-device argument or
+    temp bytes balloon would silently erase the memory win that motivates
+    sharding at all.  Ladder cells stay excluded — their contract is
+    arity (A4), and three near-identical bucket budgets would only add
+    noise.  Returns ``(findings, measured)`` — the measurements ride into
+    the audit report and the ``--write-budgets`` flow."""
     measured = {}
     for (ep, cell), art in artifacts.items():
-        if cell.role != "primary" or "memory" not in art:
+        if cell.role not in ("primary", "mesh") or "memory" not in art:
             continue
         measured[f"{ep.name}/{cell.name}"] = measure_cell(art["memory"])
     findings = check_budgets(measured, load_budgets(budgets_path))

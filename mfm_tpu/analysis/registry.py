@@ -245,20 +245,64 @@ def _risk_fused_cells():
     return cells
 
 
+#: positional panel names for the doctrine layout (PIPELINE_SPECS); any
+#: other argument of a mesh cell replicates
+_PANEL_NAMES = ("ret", "cap", "styles", "industry", "valid", "sim_covs")
+
+
+def _mesh_cells(args, statics, meshes=((2, 4),)):
+    """role='mesh' cells for a sharded entrypoint: the five panels (+
+    sim_covs) laid out by PIPELINE_SPECS, every other operand (carries,
+    guard leaves, host pre-verdicts) replicated — the layout the sharded
+    pipeline/serve paths put on the wire.  Skipped with a warn finding
+    when the process has too few devices (matches _risk_fused_cells)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    from mfm_tpu.parallel.mesh import PIPELINE_SPECS, make_mesh
+
+    cells = []
+    for nd, ns in meshes:
+        if jax.device_count() < nd * ns:
+            cells.append(Cell(f"mesh{nd}x{ns}", (), statics, role="mesh",
+                              mesh=(nd, ns)))
+            continue
+        mesh = make_mesh(nd, ns)
+
+        def shard(a, name):
+            if a is None:
+                return None
+            spec = PIPELINE_SPECS.get(name, PartitionSpec())
+            # carries are aval PYTREES (eval_shape output); leaves share
+            # the argument's layout (panels sharded, carries replicated)
+            return jax.tree_util.tree_map(
+                lambda leaf: jax.ShapeDtypeStruct(
+                    leaf.shape, leaf.dtype,
+                    sharding=NamedSharding(mesh, spec)),
+                a)
+
+        names = _PANEL_NAMES + (None,) * (len(args) - len(_PANEL_NAMES))
+        cells.append(Cell(
+            f"mesh{nd}x{ns}",
+            tuple(shard(a, n) for a, n in zip(args, names)),
+            statics, role="mesh", mesh=(nd, ns)))
+    return cells
+
+
 def _risk_init_cells():
     T, P, M, SIM_LEN = (AUDIT_MATRIX[k] for k in ("T", "P", "M", "SIM_LEN"))
-    base = Cell(
-        "base",
-        _panel_avals() + (_sim_covs_aval(), None, None, None, None),
-        dict(n_industries=P, config=_base_config(), sim_length=SIM_LEN,
-             eigen_batch_hint=T * M, eigen_sweeps=None))
+    statics = dict(n_industries=P, config=_base_config(), sim_length=SIM_LEN,
+                   eigen_batch_hint=T * M, eigen_sweeps=None)
+    args = _panel_avals() + (_sim_covs_aval(), None, None, None, None)
+    base = Cell("base", args, statics)
     draws, eig_r, eig_p, eig_n = _eigen_seed_avals()
     incr = Cell(
         "eigen-incremental",
         _panel_avals() + (None, draws, eig_r, eig_p, eig_n),
         dict(n_industries=P, config=_incremental_config(), sim_length=None,
              eigen_batch_hint=T * M, eigen_sweeps=_eigen_sweeps()))
-    return [base, incr]
+    # PR 11: the sharded-pipeline init (panels shard-local, carries born
+    # replicated) — the state path never pads, so the audit mesh divides
+    # the (T, N) matrix exactly
+    return [base, incr] + _mesh_cells(args, statics)
 
 
 def _risk_update_cells():
@@ -310,7 +354,9 @@ def _risk_update_guarded_cells():
             + guard + (pre, heal, t_count, None, None, None, None))
     statics = dict(n_industries=P, config=cfg, sim_length=SIM_LEN,
                    eigen_batch_hint=T * M, eigen_sweeps=None)
-    return [Cell("base", args, statics)]
+    # PR 11: the sharded guarded append (slab sharded, state replicated —
+    # append_risk_pipeline(mesh=...)'s exact wire layout)
+    return [Cell("base", args, statics)] + _mesh_cells(args, statics)
 
 
 _QUERY_BUCKETS = (8, 32, 128)    # bucket_for's 8 * 4^i ladder, first rungs
@@ -412,6 +458,7 @@ def _build_registry() -> tuple:
             fn=_rm._fused_init_step,
             donate=(0, 1, 2, 3, 4, 7, 8, 9),
             build_cells=_risk_init_cells,
+            collectives_allow=frozenset({"all-reduce", "all-gather"}),
             notes="fit + resumable carry (plain and incremental-eigen)"),
         Entrypoint(
             name="risk.update",
@@ -427,6 +474,7 @@ def _build_registry() -> tuple:
             fn=_rm._fused_update_guarded_step,
             donate=(0, 1, 2, 3, 4, 6, 7, 8, 9, 10, 11, 12, 13, 18, 19, 20),
             build_cells=_risk_update_guarded_cells,
+            collectives_allow=frozenset({"all-reduce", "all-gather"}),
             notes="guards + carried stages + degraded serving, one program"),
         Entrypoint(
             name="query.factor",

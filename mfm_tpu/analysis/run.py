@@ -103,7 +103,9 @@ def _build_artifacts(entrypoints, cells_by_ep, compile_cells: bool) -> dict:
                 compiled = lowered.compile()
                 art["compiled"] = compiled
                 art["compiled_text"] = compiled.as_text()
-                if cell.role == "primary":
+                if cell.role in ("primary", "mesh"):
+                    # mesh cells are budgeted too (A5): the per-device
+                    # footprint is the number sharding exists to shrink
                     art["memory"] = compiled_memory_of(compiled)
     return artifacts
 

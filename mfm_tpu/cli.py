@@ -215,6 +215,30 @@ def _write_manifest_beside(state_path: str, res, trace_id=None) -> dict:
     return health
 
 
+def _parse_mesh_arg(spec):
+    """``--mesh DxS`` -> a ('date','stock') device mesh over the first
+    D*S devices, or None.  The risk paths then compute sharded: panels
+    shard-local, state replicated (PR 11's scaling knob)."""
+    if not spec:
+        return None
+    import jax
+
+    from mfm_tpu.parallel.mesh import make_mesh
+
+    d, _, s = str(spec).lower().partition("x")
+    try:
+        nd, ns = int(d), int(s) if s else 1
+    except ValueError:
+        raise SystemExit(f"--mesh: want DATExSTOCK (e.g. 2x4), got {spec!r}")
+    need = nd * ns
+    if need > jax.device_count():
+        raise SystemExit(
+            f"--mesh {spec}: needs {need} devices but only "
+            f"{jax.device_count()} are up — on CPU set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={need} before launch")
+    return make_mesh(nd, ns, devices=jax.devices()[:need])
+
+
 def _risk(args):
     import numpy as np
     import pandas as pd
@@ -300,7 +324,8 @@ def _risk(args):
         with _profile_ctx(args.profile or args.jax_profile):
             try:
                 res = append_risk_pipeline(args.update, df, config=cfg,
-                                           force=args.force)
+                                           force=args.force,
+                                           mesh=_parse_mesh_arg(args.mesh))
             except (ValueError, ArtifactCorruptError,
                     ArtifactStaleError) as err:
                 raise SystemExit(f"--update: {err}") from err
@@ -338,7 +363,8 @@ def _risk(args):
     # the reported wall_s includes the profiler overhead when --profile is on
     with _profile_ctx(args.profile or args.jax_profile):
         res = run_risk_pipeline(arrays=arrays, config=cfg,
-                                with_state=bool(args.save_state))
+                                with_state=bool(args.save_state),
+                                mesh=_parse_mesh_arg(args.mesh))
     _write_result_tables(res, args.out, args.specific_risk)
     wall = time.perf_counter() - t0
     from mfm_tpu.obs.instrument import record_stage_seconds
@@ -727,7 +753,9 @@ def _pipeline_append_stage(args, barra, cfg, prev_barra):
     t0 = time.perf_counter()
     try:
         app = append_risk_pipeline(state_path, barra, config=cfg,
-                                   force=args.force)
+                                   force=args.force,
+                                   mesh=_parse_mesh_arg(
+                                       getattr(args, "mesh", None)))
     except (ValueError, ArtifactCorruptError, ArtifactStaleError) as err:
         raise SystemExit(f"--append: {err}") from err
     update_wall = time.perf_counter() - t0
@@ -2000,6 +2028,13 @@ def main(argv=None):
                         "OUT/portfolio_risk.json")
     r.add_argument("--portfolio-date", type=int, default=-1,
                    help="date index for --portfolio (default: last)")
+    r.add_argument("--mesh", default=None, metavar="DxS",
+                   help="compute on a DATExSTOCK device mesh (e.g. 2x4): "
+                        "panels are built shard-local and the risk stack "
+                        "runs pjit-sharded; with --update the slab must "
+                        "divide the mesh exactly.  On CPU bring up virtual "
+                        "devices with XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=N")
     r.add_argument("--quarantine", action="store_true",
                    help="guard appended dates (NaN density, universe "
                         "collapse, MAD outliers, bad caps, date order) and "
@@ -2087,6 +2122,10 @@ def main(argv=None):
                          "sw_industry_info_for_factors collections into this "
                          "PanelStore (main.py:144-155's Mongo save), "
                          "readable by `risk --barra-store`")
+    pl.add_argument("--mesh", default=None, metavar="DxS",
+                    help="run the --append update step on a DATExSTOCK "
+                         "device mesh (slab sharded, state replicated; "
+                         "bitwise the single-device update)")
     pl.add_argument("--nw-lags", type=int, default=2)
     pl.add_argument("--nw-half-life", type=float, default=252.0)
     pl.add_argument("--nw-method", choices=["scan", "associative"],

@@ -45,15 +45,83 @@ class BarraArrays:
         return ["country"] + list(map(str, self.industry_codes)) + list(self.style_names)
 
 
-def barra_frame_to_arrays(
+@dataclasses.dataclass
+class BarraCOO:
+    """Row-space (COO) form of a barra long table: the axes plus one entry
+    per surviving table row, WITHOUT the dense (T, N) panels.
+
+    This is the shard-local ingest representation: ``block`` densifies any
+    (date, stock) rectangle on demand, so a mesh run materializes each
+    device's block via ``jax.make_array_from_callback`` and the host never
+    allocates a full dense panel (at all-A scale the five f64 panels are
+    ~1.5 GB — the ingest cost the ISSUE-11 refactor removes).  Cells not
+    covered by any row — including mesh-padding cells past the real (T, N)
+    extent — densify to missing data (NaN / industry -1 / valid False),
+    which the model's masked design already treats as inert.
+    """
+
+    dates: np.ndarray           # (T,) sorted ascending
+    stocks: np.ndarray          # (N,) sorted ascending
+    industry_codes: np.ndarray  # (P,)
+    style_names: list
+    ti: np.ndarray              # (R,) int  date index per row
+    si: np.ndarray              # (R,) int  stock index per row
+    ret_v: np.ndarray           # (R,)
+    cap_v: np.ndarray           # (R,)
+    styles_v: np.ndarray        # (R, Q)
+    industry_v: np.ndarray      # (R,) int in [0, P), -1 for unknown codes
+
+    @property
+    def n_industries(self) -> int:
+        return len(self.industry_codes)
+
+    def factor_names(self) -> list:
+        return (["country"] + list(map(str, self.industry_codes))
+                + list(self.style_names))
+
+    def block(self, t0: int, t1: int, s0: int, s1: int,
+              dtype=np.float64) -> dict:
+        """Densify rows falling in ``[t0, t1) x [s0, s1)`` into local
+        ``(t1-t0, s1-s0)`` panels (keys: ret/cap/styles/industry/valid).
+        The rectangle may extend past (T, N) — the overhang is padding and
+        densifies to missing data."""
+        keep = (self.ti >= t0) & (self.ti < t1) \
+            & (self.si >= s0) & (self.si < s1)
+        ti, si = self.ti[keep] - t0, self.si[keep] - s0
+        t, n, q = t1 - t0, s1 - s0, len(self.style_names)
+        ret = np.full((t, n), np.nan, dtype)
+        cap = np.full((t, n), np.nan, dtype)
+        styles = np.full((t, n, q), np.nan, dtype)
+        industry = np.full((t, n), -1, np.int32)
+        valid = np.zeros((t, n), bool)
+        ret[ti, si] = self.ret_v[keep].astype(dtype)
+        cap[ti, si] = self.cap_v[keep].astype(dtype)
+        styles[ti, si] = self.styles_v[keep].astype(dtype)
+        industry[ti, si] = self.industry_v[keep]
+        valid[ti, si] = True
+        valid &= industry >= 0
+        return {"ret": ret, "cap": cap, "styles": styles,
+                "industry": industry, "valid": valid}
+
+    def to_arrays(self, dtype=np.float64) -> BarraArrays:
+        """The classic full densification (one block covering everything)."""
+        b = self.block(0, len(self.dates), 0, len(self.stocks), dtype)
+        return BarraArrays(
+            dates=self.dates, stocks=self.stocks, ret=b["ret"], cap=b["cap"],
+            styles=b["styles"], industry=b["industry"], valid=b["valid"],
+            industry_codes=self.industry_codes,
+            style_names=list(self.style_names),
+        )
+
+
+def barra_frame_to_coo(
     df,
     industry_codes: Sequence | None = None,
     style_names: Sequence[str] | None = None,
     drop_any_nan: bool = True,
-    dtype=np.float64,
     stocks: Sequence | None = None,
-) -> BarraArrays:
-    """Densify a barra-format long DataFrame.
+) -> BarraCOO:
+    """Long DataFrame -> :class:`BarraCOO` (row space, no dense panels).
 
     ``industry_codes`` fixes the one-hot column order (the reference reads it
     from ``industry_info.csv``, ``demo.py:32-35``); default: sorted unique
@@ -99,33 +167,40 @@ def barra_frame_to_arrays(
     t_idx = {d: i for i, d in enumerate(dates)}
     s_idx = {s: j for j, s in enumerate(stocks)}
     code_idx = {c: p for p, c in enumerate(industry_codes)}
-    T, N, Q = len(dates), len(stocks), len(style_names)
 
-    ti = df["date"].map(t_idx).to_numpy()
-    si = df["stocknames"].map(s_idx).to_numpy()
-
-    ret = np.full((T, N), np.nan, dtype)
-    cap = np.full((T, N), np.nan, dtype)
-    styles = np.full((T, N, Q), np.nan, dtype)
-    industry = np.full((T, N), -1, np.int32)
-    valid = np.zeros((T, N), bool)
-
-    ret[ti, si] = df["ret"].to_numpy(dtype)
-    cap[ti, si] = df["capital"].to_numpy(dtype)
-    for q, name in enumerate(style_names):
-        styles[ti, si, q] = df[name].to_numpy(dtype)
-    industry[ti, si] = df["industry"].map(code_idx).fillna(-1).to_numpy(np.int32)
-    valid[ti, si] = True
-    # rows whose industry code is not in the code list are invalid (the
-    # reference's one-hot against industry_info simply yields all-zero dummies
-    # there; we exclude them outright and document the difference)
-    valid &= industry >= 0
-
-    return BarraArrays(
-        dates=dates, stocks=stocks, ret=ret, cap=cap, styles=styles,
-        industry=industry, valid=valid,
-        industry_codes=industry_codes, style_names=list(style_names),
+    return BarraCOO(
+        dates=dates, stocks=stocks, industry_codes=industry_codes,
+        style_names=list(style_names),
+        ti=df["date"].map(t_idx).to_numpy(),
+        si=df["stocknames"].map(s_idx).to_numpy(),
+        ret_v=df["ret"].to_numpy(np.float64),
+        cap_v=df["capital"].to_numpy(np.float64),
+        styles_v=np.stack([df[n].to_numpy(np.float64)
+                           for n in style_names], axis=-1)
+        if style_names else np.zeros((len(df), 0)),
+        industry_v=df["industry"].map(code_idx).fillna(-1)
+        .to_numpy(np.int32),
     )
+
+
+def barra_frame_to_arrays(
+    df,
+    industry_codes: Sequence | None = None,
+    style_names: Sequence[str] | None = None,
+    drop_any_nan: bool = True,
+    dtype=np.float64,
+    stocks: Sequence | None = None,
+) -> BarraArrays:
+    """Densify a barra-format long DataFrame (single-host dense path).
+
+    The row-space step and the filling rules live in
+    :func:`barra_frame_to_coo` / :meth:`BarraCOO.block`, shared with the
+    shard-local mesh ingest — the two paths cannot drift.
+    """
+    return barra_frame_to_coo(
+        df, industry_codes=industry_codes, style_names=style_names,
+        drop_any_nan=drop_any_nan, stocks=stocks,
+    ).to_arrays(dtype)
 
 
 def load_barra_csv(path, industry_info_path=None, **kw) -> BarraArrays:

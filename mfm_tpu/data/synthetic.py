@@ -138,6 +138,62 @@ PANEL_META_KEYS = ("dates", "stocks", "industry", "index_close", "observed",
                    "end_date_code")
 
 
+class Universe:
+    """A named (T, N, P, Q) workload shape — the ``--universe`` knob.
+
+    ``csi300`` is the flagship CSI300-shaped panel every BENCH_r* record
+    before r06 was measured on; ``alla`` is the full A-share universe of
+    PAPER.md's Barra/USE4 pipeline (~5,000 names).  An integer spec gives
+    an N-stock universe with the CSI300 history length and the same USE4
+    factor structure (P=31 industries + Q=10 styles) so walls stay
+    comparable along the N axis alone.
+    """
+
+    __slots__ = ("name", "T", "N", "P", "Q")
+
+    def __init__(self, name, T, N, P, Q):
+        self.name, self.T, self.N, self.P, self.Q = name, T, N, P, Q
+
+    def __repr__(self):
+        return (f"Universe({self.name!r}, T={self.T}, N={self.N}, "
+                f"P={self.P}, Q={self.Q})")
+
+
+#: the named universes (T, N, P, Q).  csi300 matches bench.py's historical
+#: config-1 shapes; alla matches config-4 (bench_alla).
+UNIVERSES = {
+    "csi300": (1390, 300, 31, 10),
+    "alla": (2500, 5000, 31, 10),
+}
+
+
+def resolve_universe(spec, T: int | None = None) -> Universe:
+    """``'csi300' | 'alla' | N`` (int-like) -> :class:`Universe`.
+
+    ``T`` overrides the history length (e.g. a bounded smoke run at
+    N=5000); the override is recorded in the universe's name so a record
+    produced from it can never masquerade as the full-length workload.
+    """
+    if isinstance(spec, str) and spec in UNIVERSES:
+        t0, n, p, q = UNIVERSES[spec]
+        name = spec
+    else:
+        try:
+            n = int(spec)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"unknown universe {spec!r}: expected "
+                f"{sorted(UNIVERSES)} or an integer stock count") from None
+        if n <= 0:
+            raise ValueError(f"universe N must be positive, got {n}")
+        t0, (_, p, q) = UNIVERSES["csi300"][0], UNIVERSES["csi300"][1:]
+        name = f"n{n}"
+    t = t0 if T is None else int(T)
+    if t != t0:
+        name = f"{name}_t{t}"
+    return Universe(name, t, n, p, q)
+
+
 def panel_to_engine_fields(data: Dict, dtype) -> Dict:
     """The :class:`mfm_tpu.factors.engine.FactorEngine` field dict for a
     :func:`synthetic_market_panel` result: float fields cast to ``dtype``,

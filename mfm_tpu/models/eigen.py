@@ -360,27 +360,79 @@ def eigen_risk_adjust_by_time(
     md = None if mc_dtype is None else jnp.dtype(mc_dtype)
     sim_lo = None if md is None else sim_covs.astype(md)
 
-    def _sim_bias_v2(s_c, d0_c):
+    def _sim_bias_v2(s_c, d0_c, sc=None, sc_lo=None):
         """(c, K) sqrt-eigvals + eigvals -> (c, K) mean bias ratios v^2.
 
         The whole per-date Monte-Carlo for a slab of dates — the one body
-        both the full-batch and the chunked path run, so their per-date op
-        sequence (and hence their result) is identical by construction.
+        the full-batch, the chunked and the shard_map paths all run, so
+        their per-date op sequence (and hence their result) is identical
+        by construction.  ``sc``/``sc_lo`` default to the closed-over sim
+        covariances; the shard_map path passes them as explicit replicated
+        operands instead (shard_map bodies cannot close over traced
+        values).
         """
+        sc = sim_covs if sc is None else sc
         if md is None:
-            G = s_c[:, None, :, None] * sim_covs[None] * s_c[:, None, None, :]
+            G = s_c[:, None, :, None] * sc[None] * s_c[:, None, None, :]
         else:
             # mixed-precision assembly: the (c, K, K) outer-scale matrix is
             # one dot-general over the rounded scale factors, then a single
             # multiply forms the big (c, M, K, K) transient in mc_dtype —
             # cast up only at the eigh input
+            sc_lo = sim_lo if sc_lo is None else sc_lo
             s_lo = s_c.astype(md)
             S = jnp.einsum("ck,cl->ckl", s_lo, s_lo)
-            G = (S[:, None] * sim_lo[None]).astype(dtype)
+            G = (S[:, None] * sc_lo[None]).astype(dtype)
         return _bias_ratios(G, d0_c, dtype, prefer_pallas, sim_sweeps,
                             batch_hint)
 
-    if chunk is None or chunk >= T:
+    def _v2_slab(s_c, d0_c, sc, sc_lo):
+        """v2 over a (t, K) slab of dates, streaming by ``chunk`` when it
+        bites — the per-DEVICE body of the shard_map path below.  No mesh
+        pinning in here: inside shard_map every axis is manual/local."""
+        t = s_c.shape[0]
+        if chunk is None or chunk >= t:
+            return _sim_bias_v2(s_c, d0_c, sc, sc_lo)
+        pad = (-t) % chunk
+        s_p = jnp.pad(s_c, ((0, pad), (0, 0)))
+        d0_p = jnp.pad(d0_c, ((0, pad), (0, 0)))
+        n_chunks = (t + pad) // chunk
+        v2 = jax.lax.map(
+            lambda args: _sim_bias_v2(*args, sc, sc_lo),
+            (s_p.reshape(n_chunks, chunk, K),
+             d0_p.reshape(n_chunks, chunk, K)))
+        return v2.reshape(n_chunks * chunk, K)[:t]
+
+    from mfm_tpu.parallel.mesh import _ambient_mesh, replicate_under_mesh
+
+    mesh = _ambient_mesh()
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
+    if n_dev > 1:
+        # Device-parallel Monte-Carlo: shard the (T, M, K, K) eigh batch's
+        # date axis over the WHOLE mesh via shard_map — each device runs
+        # the per-date body on its contiguous date block, so every eigh
+        # stays device-local and the result is bitwise-equal to the
+        # single-device batch (the same slab-invariance argument as the
+        # chunk stream: identical per-date op sequence, solver dispatch
+        # pinned by batch_hint).  Padded dates carry s = 0 -> all-zero G ->
+        # every ratio hits the degenerate guard -> v2 = 1; cropped below.
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as _P
+
+        padT = (-T) % n_dev
+        s_p = jnp.pad(s, ((0, padT), (0, 0)))
+        d0_p = jnp.pad(D0, ((0, padT), (0, 0)))
+        date_spec = _P(tuple(mesh.axis_names))
+        rep = _P()
+        v2 = shard_map(
+            _v2_slab, mesh=mesh,
+            in_specs=(date_spec, date_spec, rep, rep),
+            out_specs=date_spec,
+            check_rep=False,
+        )(s_p, d0_p, sim_covs,
+          sim_covs if sim_lo is None else sim_lo)
+        v2 = replicate_under_mesh(v2[:T])
+    elif chunk is None or chunk >= T:
         v2 = _sim_bias_v2(s, D0)  # (T, K)
     else:
         # stream: pad T up to a chunk multiple (padded dates carry s = 0,
@@ -390,8 +442,6 @@ def eigen_risk_adjust_by_time(
         # ambient mesh — the serial stream gains nothing from sharding and
         # scan-stacked sharded outputs trip the s64/s32 partitioner bug
         # (see vol_regime.py).
-        from mfm_tpu.parallel.mesh import replicate_under_mesh
-
         pad = (-T) % chunk
         s_p = jnp.pad(s, ((0, pad), (0, 0)))
         d0_p = jnp.pad(D0, ((0, pad), (0, 0)))

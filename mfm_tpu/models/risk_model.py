@@ -49,6 +49,7 @@ from mfm_tpu.models.vol_regime import (
 )
 from mfm_tpu.models.bias import eigenfactor_bias_stat
 from mfm_tpu.ops.xreg import regress_panel
+from mfm_tpu.parallel.mesh import constrain_cross_section
 from mfm_tpu.serve.guard import GuardReport, guard_slab
 
 
@@ -193,6 +194,15 @@ class RiskModel:
             v = getattr(self, f)
             if isinstance(v, np.ndarray):
                 object.__setattr__(self, f, jnp.array(v))
+        # Under an ambient ('date','stock') mesh, gather the stock axis to
+        # the date-parallel layout ONCE here — every cross-sectional
+        # reduction downstream stays device-local, which is what makes the
+        # sharded run bitwise-equal to the single-device one (the mesh
+        # doctrine's bitwise rule, parallel/mesh.constrain_cross_section).
+        panels = constrain_cross_section(
+            self.ret, self.cap, self.styles, self.industry, self.valid)
+        for f, v in zip(("ret", "cap", "styles", "industry", "valid"), panels):
+            object.__setattr__(self, f, v)
         self.T, self.N = self.ret.shape
         self.Q = self.styles.shape[-1]
         self.K = 1 + self.n_industries + self.Q
@@ -883,6 +893,11 @@ def _fused_update_guarded_step(ret, cap, styles, industry, valid, sim_covs,
                                t_count, eig_draws, eig_R, eig_p, eig_n, *,
                                n_industries, config, sim_length,
                                eigen_batch_hint, eigen_sweeps=None):
+    # guard coverage counts reduce over the stock axis — gather it to the
+    # date-local layout first so the guarded verdicts (and therefore the
+    # excision masks) are bitwise-identical to the unsharded program
+    ret, cap, styles, industry, valid = constrain_cross_section(
+        ret, cap, styles, industry, valid)
     quarantined, reasons, ring, ring_pos = guard_slab(
         ret, cap, valid, ring, ring_pos, config.quarantine,
         pre_reasons=pre_reasons, heal_mask=heal_mask)

@@ -13,6 +13,7 @@ The TPU-native equivalents of the reference's two drivers:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
 from typing import Dict, Mapping
@@ -374,6 +375,93 @@ class RiskPipelineResult:
             benchmarks=benchmarks, staleness=staleness)
 
 
+class LazyBarraArrays:
+    """:class:`BarraArrays` facade over a :class:`BarraCOO`.
+
+    Metadata (dates/stocks/codes/names) is immediate; the first access to a
+    dense panel attribute densifies once and caches.  The sharded pipeline
+    path returns this so the RUN never builds a host-side dense panel —
+    only post-hoc analytics that genuinely need one (``portfolio_bias``,
+    the specific-risk cap groups) pay the densification, lazily.
+    """
+
+    _PANELS = ("ret", "cap", "styles", "industry", "valid")
+
+    def __init__(self, coo, dtype=np.float64):
+        self._coo, self._dtype, self._dense = coo, dtype, None
+        self.dates = coo.dates
+        self.stocks = coo.stocks
+        self.industry_codes = coo.industry_codes
+        self.style_names = list(coo.style_names)
+
+    @property
+    def n_industries(self) -> int:
+        return len(self.industry_codes)
+
+    def factor_names(self) -> list:
+        return self._coo.factor_names()
+
+    def __getattr__(self, name):
+        if name in LazyBarraArrays._PANELS:
+            if self._dense is None:
+                self._dense = self._coo.to_arrays(self._dtype)
+            return getattr(self._dense, name)
+        raise AttributeError(name)
+
+
+def _sharded_risk_panels(coo, mesh, dtype):
+    """Materialize the five risk-model panels DIRECTLY in their sharded
+    mesh layout: ``jax.make_array_from_callback`` asks for each device's
+    ``(date, stock)`` rectangle and :meth:`BarraCOO.block` densifies only
+    those rows — the host never holds a full (T, N) dense panel.
+
+    Global shapes are pre-padded to mesh-divisible sizes (the
+    ``pad_to_mesh`` doctrine); a padding cell is simply a rectangle no
+    table row falls in, so it densifies to missing data (NaN / valid
+    False) — inert by the model's masked design, no separate fill step.
+    Returns ``(panels, (T, N))`` with T, N the real (unpadded) extents.
+    """
+    from jax.sharding import NamedSharding
+
+    from mfm_tpu.parallel.mesh import PIPELINE_SPECS
+
+    T, N, Q = len(coo.dates), len(coo.stocks), len(coo.style_names)
+    nd, ns = mesh.shape["date"], mesh.shape["stock"]
+    Tp, Np = T + (-T) % nd, N + (-N) % ns
+    np_dtype = np.dtype(dtype)
+    cache = {}
+
+    def _block(t0, t1, s0, s1):
+        key = (t0, t1, s0, s1)
+        if key not in cache:
+            cache[key] = coo.block(t0, t1, s0, s1, dtype=np_dtype)
+        return cache[key]
+
+    def make(name, shape):
+        sharding = NamedSharding(mesh, PIPELINE_SPECS[name])
+
+        def cb(index):
+            t0, t1, _ = index[0].indices(shape[0])
+            s0, s1, _ = index[1].indices(shape[1])
+            return _block(t0, t1, s0, s1)[name]
+
+        return jax.make_array_from_callback(shape, sharding, cb)
+
+    panels = (make("ret", (Tp, Np)), make("cap", (Tp, Np)),
+              make("styles", (Tp, Np, Q)), make("industry", (Tp, Np)),
+              make("valid", (Tp, Np)))
+    return panels, (T, N)
+
+
+def _crop_outputs(out: RiskModelOutputs, T: int, N: int) -> RiskModelOutputs:
+    """Crop mesh-padded outputs back to the real (T, N) extents."""
+    return RiskModelOutputs(
+        factor_ret=out.factor_ret[:T], specific_ret=out.specific_ret[:T, :N],
+        r2=out.r2[:T], nw_cov=out.nw_cov[:T], nw_valid=out.nw_valid[:T],
+        eigen_cov=out.eigen_cov[:T], eigen_valid=out.eigen_valid[:T],
+        vr_cov=out.vr_cov[:T], lamb=out.lamb[:T])
+
+
 def run_risk_pipeline(
     barra_df=None,
     arrays: BarraArrays | None = None,
@@ -383,6 +471,7 @@ def run_risk_pipeline(
     sim_length: int | None = None,
     fused: bool = True,
     with_state: bool = False,
+    mesh=None,
 ) -> RiskPipelineResult:
     """Barra table -> full risk model (the ``demo.py`` path).
 
@@ -401,11 +490,24 @@ def run_risk_pipeline(
     ``with_state`` runs :meth:`RiskModel.init_state` instead (same fused
     math, also returns the final scan carries) and sets ``result.state`` —
     the checkpoint :func:`append_risk_pipeline` serves new dates from.
+
+    ``mesh`` (a ``('date','stock')`` mesh, :func:`mfm_tpu.parallel.mesh.
+    make_mesh`) runs the risk stack SHARDED: panel construction is
+    shard-local (each device densifies only its own block straight from
+    the long table's row space — no host-side full-panel densify) and the
+    fused program executes under the mesh with the bitwise stock-gather
+    doctrine.  Outputs are cropped back to the real (T, N); a state run
+    requires T divisible by the mesh date axis and N by its stock axis
+    (time/stock padding must never enter the resumable carries).
     """
     config = config or PipelineConfig()
+    dtype = jnp.float64 if config.dtype == "float64" else jnp.float32
+    if mesh is not None:
+        return _run_risk_pipeline_sharded(
+            barra_df, arrays, config, industry_codes, sim_covs, sim_length,
+            fused, with_state, mesh, dtype)
     if arrays is None:
         arrays = barra_frame_to_arrays(barra_df, industry_codes=industry_codes)
-    dtype = jnp.float64 if config.dtype == "float64" else jnp.float32
     # jnp.array (copying), not asarray: the panels are donated by the fused
     # init/update jits, and on CPU asarray can zero-copy alias the numpy
     # buffers — donating memory JAX does not own corrupts outputs.
@@ -424,6 +526,91 @@ def run_risk_pipeline(
     run = rm.run_fused if fused else rm.run
     out = run(sim_covs=sim_covs, sim_length=sim_length)
     return RiskPipelineResult(outputs=out, arrays=arrays, model=rm)
+
+
+def _run_risk_pipeline_sharded(barra_df, arrays, config, industry_codes,
+                               sim_covs, sim_length, fused, with_state,
+                               mesh, dtype):
+    """The ``mesh=`` body of :func:`run_risk_pipeline` (see its docstring).
+
+    The long table goes to row space (:func:`barra_frame_to_coo`) and each
+    device materializes its own panel block; a caller handing pre-densified
+    ``arrays`` still gets mesh execution (the panels are re-laid-out
+    per-shard), just not the ingest saving.
+    """
+    from mfm_tpu.data.barra import barra_frame_to_coo
+    from mfm_tpu.parallel.mesh import use_mesh
+
+    if arrays is None:
+        coo = barra_frame_to_coo(barra_df, industry_codes=industry_codes)
+        result_arrays = LazyBarraArrays(coo, np.dtype(dtype))
+    else:
+        # dense arrays already exist — wrap them in the same block protocol
+        # so one code path builds the sharded panels
+        coo = _DenseBlocks(arrays)
+        result_arrays = arrays
+
+    nd, ns = mesh.shape["date"], mesh.shape["stock"]
+    T, N = len(coo.dates), len(coo.stocks)
+    if with_state and (T % nd or N % ns):
+        raise ValueError(
+            f"a state (resumable-carry) run cannot be mesh-padded: T={T} "
+            f"must divide the date axis ({nd}) and N={N} the stock axis "
+            f"({ns}) — pick a compatible mesh (make_mesh(n_date=...)) or "
+            "run unsharded")
+    panels, (T, N) = _sharded_risk_panels(coo, mesh, dtype)
+    with use_mesh(mesh):
+        rm = RiskModel(
+            *panels, n_industries=coo.n_industries,
+            config=config.risk, factor_names=coo.factor_names(),
+        )
+        if with_state:
+            out, state = rm.init_state(
+                sim_covs=sim_covs, sim_length=sim_length,
+                last_date=date_stamp(coo.dates[-1]))
+            return RiskPipelineResult(outputs=_crop_outputs(out, T, N),
+                                      arrays=result_arrays, model=rm,
+                                      state=state)
+        run = rm.run_fused if fused else rm.run
+        out = run(sim_covs=sim_covs, sim_length=sim_length)
+    return RiskPipelineResult(outputs=_crop_outputs(out, T, N),
+                              arrays=result_arrays, model=rm)
+
+
+class _DenseBlocks:
+    """Adapter giving pre-densified :class:`BarraArrays` the
+    :meth:`BarraCOO.block` protocol (slice instead of densify), so
+    :func:`_sharded_risk_panels` serves both ingest forms."""
+
+    def __init__(self, arrays):
+        self._a = arrays
+        self.dates, self.stocks = arrays.dates, arrays.stocks
+        self.industry_codes = arrays.industry_codes
+        self.style_names = list(arrays.style_names)
+
+    @property
+    def n_industries(self):
+        return len(self.industry_codes)
+
+    def factor_names(self):
+        return self._a.factor_names()
+
+    def block(self, t0, t1, s0, s1, dtype=np.float64):
+        a = self._a
+        T, N = a.ret.shape
+        out = {}
+        for name, fill in (("ret", np.nan), ("cap", np.nan),
+                           ("styles", np.nan), ("industry", -1),
+                           ("valid", False)):
+            src = getattr(a, name)
+            shape = (t1 - t0, s1 - s0) + src.shape[2:]
+            dt = (dtype if src.dtype.kind == "f" else src.dtype)
+            blk = np.full(shape, fill, dt)
+            tt, ss = min(t1, T), min(s1, N)
+            if tt > t0 and ss > s0:
+                blk[:tt - t0, :ss - s0] = src[t0:tt, s0:ss]
+            out[name] = blk
+        return out
 
 
 def save_pipeline_state(path: str, result: RiskPipelineResult):
@@ -453,6 +640,7 @@ def append_risk_pipeline(
     barra_df,
     config: PipelineConfig | None = None,
     force: bool = False,
+    mesh=None,
 ) -> RiskPipelineResult:
     """Serve the new date(s) of a barra table from a saved checkpoint.
 
@@ -471,6 +659,13 @@ def append_risk_pipeline(
     carries and served the last healthy covariance, and ``result.report``
     carries the verdicts.  ``force`` overrides the checkpoint generation
     fencing (:func:`mfm_tpu.data.artifacts.load_risk_state`).
+
+    With ``mesh`` (a ``make_mesh`` ('date','stock') mesh), the slab panels
+    are sharded and the state replicated so the ONE update step computes on
+    the mesh — bitwise the single-device update (the cross-section is
+    gathered once per stage, so per-date math is identical).  The slab must
+    divide the mesh exactly: the update folds every row into the carries,
+    so a padded slab would corrupt them.
     """
     from mfm_tpu.data.artifacts import load_risk_state
     from mfm_tpu.serve.guard import host_date_reasons
@@ -499,10 +694,35 @@ def append_risk_pipeline(
     )
     dtype = jnp.float64 if config.dtype == "float64" else jnp.float32
     # copying conversion — the slab panels are donated (see run_risk_pipeline)
-    rm = RiskModel(
+    panels = (
         jnp.array(slab.ret, dtype), jnp.array(slab.cap, dtype),
         jnp.array(slab.styles, dtype), jnp.array(slab.industry),
-        jnp.array(slab.valid), n_industries=slab.n_industries,
+        jnp.array(slab.valid),
+    )
+    mesh_ctx = contextlib.nullcontext()
+    if mesh is not None:
+        from mfm_tpu.parallel.mesh import replicated, shard_panel, use_mesh
+
+        nd, ns = int(mesh.shape["date"]), int(mesh.shape["stock"])
+        Ts, Ns = len(slab.dates), len(slab.stocks)
+        if Ts % nd or Ns % ns:
+            raise ValueError(
+                f"sharded append: slab (T={Ts}, N={Ns}) must divide the "
+                f"({nd} date x {ns} stock) mesh exactly — the update folds "
+                "every row into the carries, so a padded slab would corrupt "
+                "them")
+        panels = shard_panel(panels, mesh)
+        state = jax.device_put(state, replicated(mesh))
+        mesh_ctx = use_mesh(mesh)
+    with mesh_ctx:
+        return _append_update_step(panels, slab, state, config, last)
+
+
+def _append_update_step(panels, slab, state, config, last):
+    from mfm_tpu.serve.guard import host_date_reasons
+
+    rm = RiskModel(
+        *panels, n_industries=slab.n_industries,
         config=config.risk, factor_names=slab.factor_names(),
     )
     if config.risk.quarantine.enabled:

@@ -205,7 +205,8 @@ class FaultPlan:
                      # outlier_slab | universe_slab | flaky_store |
                      # query_kill | query_poison | query_overflow |
                      # query_swap | query_steady | scenario_kill |
-                     # scenario_poison | trace_kill | eigen_kill
+                     # scenario_poison | trace_kill | eigen_kill |
+                     # shard_kill
     seed: int = 0
     params: tuple = ()   # ((key, value), ...) — hashable, printable
 
@@ -267,4 +268,11 @@ def plan_suite(seed: int = 0) -> tuple:
         # the replay must land on the fault-free carry
         FaultPlan("eigen-kill-mid-update", "eigen_kill", s + 19,
                   (("point", "save_artifact.after_tmp"),)),
+        # sharded serving (PR 11): SIGKILL between the checkpoint's tmp
+        # write and its rename while the append's ONE update step ran on
+        # a ('date','stock') device mesh — sharding must change nothing
+        # about the fence: the prior generation stays byte-identical on
+        # disk and the replay lands bitwise on the fault-free run
+        FaultPlan("shard-kill-mid-append", "shard_kill", s + 20,
+                  (("point", "save_artifact.after_tmp"), ("mesh", "2x2"))),
     )

@@ -278,12 +278,15 @@ def test_a5_over_stale_unbudgeted_and_floor():
                    ("stale-budget", "error")}
 
 
-def test_a5_committed_budgets_cover_exactly_the_primary_cells():
+def test_a5_committed_budgets_cover_exactly_the_budgeted_cells():
+    """Primary AND mesh cells carry budgets (ladder cells stay A4-only).
+    Mesh cells keep their name/role even when the process has too few
+    devices to compile them, so this set is environment-independent."""
     doc = budgets.load_budgets()
     assert doc["schema"] == budgets.BUDGETS_SCHEMA
-    primary = {f"{ep.name}/{c.name}" for ep in registry()
-               for c in ep.cells() if c.role == "primary"}
-    assert set(doc["cells"]) == primary
+    budgeted = {f"{ep.name}/{c.name}" for ep in registry()
+                for c in ep.cells() if c.role in ("primary", "mesh")}
+    assert set(doc["cells"]) == budgeted
 
 
 # -- layer 3: registry completeness -------------------------------------------

@@ -69,3 +69,27 @@ def test_roofline_unknown_chip_reports_null_fractions(bench, monkeypatch):
     out = bench._roofline({"eigen": 1.0}, {"eigen": models["eigen"]})
     assert out["eigen"]["frac_of_peak"] is None
     assert out["eigen"]["achieved_gflops"] > 0
+
+
+def test_resolve_universe_named_and_numeric():
+    """The --universe knob (PR 11): named universes pin the paper shapes,
+    int-like specs scale N with csi300's other dims, and a bounded-T smoke
+    run gets a _t<N> name suffix so its records can never masquerade as the
+    full-history wall in the perfgate trajectory."""
+    from mfm_tpu.data.synthetic import resolve_universe
+
+    u = resolve_universe("csi300")
+    assert (u.name, u.T, u.N, u.P, u.Q) == ("csi300", 1390, 300, 31, 10)
+    a = resolve_universe("alla")
+    assert (a.name, a.T, a.N) == ("alla", 2500, 5000)
+
+    n = resolve_universe("999")
+    assert (n.name, n.T, n.N, n.P, n.Q) == ("n999", 1390, 999, 31, 10)
+
+    s = resolve_universe("csi300", T=32)
+    assert s.name == "csi300_t32" and s.T == 32 and s.N == 300
+
+    with pytest.raises(ValueError):
+        resolve_universe("hk500")
+    with pytest.raises(ValueError):
+        resolve_universe("0")

@@ -193,7 +193,8 @@ def test_plan_suite_is_deterministic():
                                    "query_poison", "query_overflow",
                                    "query_swap", "query_steady",
                                    "scenario_kill", "scenario_poison",
-                                   "trace_kill", "eigen_kill"}
+                                   "trace_kill", "eigen_kill",
+                                   "shard_kill"}
     assert len({p.seed for p in a}) == len(a)
 
 

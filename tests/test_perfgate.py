@@ -147,3 +147,41 @@ def test_cli_exits_nonzero_on_injected_regression(tmp_path):
         [sys.executable, os.path.join(REPO, "tools", "perfgate.py"), cur,
          "--root", d], capture_output=True, text=True,
         timeout=120).returncode == 2
+
+
+def test_universe_n_backfill_and_explicit():
+    """(backend, universe_n) baseline keying (PR 11): explicit universe_n
+    wins; pre-PR-11 records backfill from the metric family; non-universe
+    records key to None and keep gating across universes."""
+    assert perfgate.universe_n(_risk_rec(10.0)) == 300
+    assert perfgate.universe_n(
+        {"metric": "riskmodel_e2e_wall", "value": 5.0}) == 300
+    assert perfgate.universe_n(
+        {"metric": "alla_full_pipeline_wall", "value": 50.0}) == 5000
+    assert perfgate.universe_n(
+        {"metric": "riskmodel_e2e_wall", "value": 5.0,
+         "universe_n": 5000}) == 5000
+    assert perfgate.universe_n(
+        {"metric": "portfolio_query_throughput", "value": 9000}) is None
+    assert perfgate.universe_n("junk") is None
+
+
+def test_gate_keys_baselines_by_universe(tmp_path):
+    """An N=5000 wall must never be held to the N=300 trajectory: same
+    backend, same metric namespace, different universe_n -> no baseline
+    (skip), not a 10x 'regression'."""
+    _write_traj(str(tmp_path), _risk_rec(10.0))  # csi300 -> universe_n 300
+    traj = perfgate.load_trajectory(str(tmp_path))
+
+    big = {"metric": "riskmodel_e2e_wall", "value": 100.0, "backend": "cpu",
+           "universe_n": 5000, "e2e_wall_s": 100.0}
+    verdict = perfgate.gate_record(big, traj)
+    assert verdict["universe_n"] == 5000
+    assert verdict["regressions"] == []
+    assert all(not c["regressed"] for c in verdict["checks"])
+    assert any("universe_n=5000" in s["reason"] for s in verdict["skipped"])
+
+    # same universe still gates: a 300-keyed record past the band fails
+    slow = _risk_rec(13.0, universe_n=300)
+    verdict2 = perfgate.gate_record(slow, traj)
+    assert [c["metric"] for c in verdict2["regressions"]] == ["e2e_wall_s"]

@@ -284,3 +284,262 @@ def test_portfolio_bias_sharded_matches_single_device():
 
     np.testing.assert_array_equal(np.asarray(ok), np.asarray(bok))
     np.testing.assert_allclose(got, base, rtol=1e-9, equal_nan=True)
+
+
+# ---------------------------------------------------------------------------
+# PR 11: universe scaling — the bitwise contracts behind the shard-local
+# panel/pjit risk stack.  Two regimes, deliberately distinguished:
+#
+# * the DIRECT entrypoints (run_fused on padded panels, update_guarded on a
+#   stock-padded slab with replicated state) are *bitwise* equal to the
+#   unsharded run at the same padded shapes — the cross-section is gathered
+#   once per stage (mesh doctrine), so per-date math is identical down to
+#   reduction order;
+# * the PIPELINE wrapper (run_risk_pipeline(mesh=...)) additionally changes
+#   whole-program fusion boundaries around the Newey-West scan, which on
+#   CPU perturbs nw_cov at the ulp level (~1e-16 abs in f64) and cascades —
+#   numerically irrelevant, but not bitwise; that path asserts allclose.
+#
+# Padded vs UNpadded is never bitwise on CPU either (array extent changes
+# XLA's SIMD tiling), so every bitwise comparison here holds shapes fixed
+# and varies only the sharding.
+# ---------------------------------------------------------------------------
+
+
+def _bitwise(tag, a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    assert a.dtype == b.dtype and a.shape == b.shape, tag
+    np.testing.assert_array_equal(a, b, err_msg=f"{tag} not bitwise")
+
+
+def test_pad_to_mesh_bool_valid_pads_false():
+    """Regression: a bool panel must pad with False (= never observed).
+    A True pad would admit phantom stocks/dates into every masked
+    cross-section reduction downstream."""
+    mesh = make_mesh(2, 4)
+    valid = jnp.ones((5, 6), bool)
+    padded = pad_to_mesh(valid, mesh)
+    assert padded.dtype == jnp.bool_
+    assert padded.shape == (6, 8)
+    p = np.asarray(padded)
+    assert p[:5, :6].all()
+    assert not p[5:, :].any() and not p[:, 6:].any()
+
+
+def _uneven_universe_inputs(T, N, P, Q, seed):
+    from __graft_entry__ import _synthetic_risk_inputs
+    return _synthetic_risk_inputs(T, N, P, Q, seed=seed)
+
+
+def test_run_fused_sharded_bitwise_uneven_n999():
+    """ISSUE-11 acceptance: run_fused on a 2x4 mesh at N=999 (uneven —
+    pad_to_mesh takes the stock axis to 1000) is BITWISE equal to the
+    unsharded run at the same padded shapes, across all nine outputs."""
+    from mfm_tpu.models.eigen import simulated_eigen_covs
+
+    T, N, P, Q = 24, 999, 5, 3
+    K = 1 + P + Q
+    cfg = RiskModelConfig(eigen_n_sims=8, eigen_sim_length=100)
+    args = _uneven_universe_inputs(T, N, P, Q, seed=9)
+    sim_covs = simulated_eigen_covs(jax.random.key(0), K, 100, 8,
+                                    jnp.float32)
+
+    def pipeline(ret, cap, styles, industry, valid, sc):
+        m = RiskModel(ret, cap, styles, industry, valid,
+                      n_industries=P, config=cfg)
+        return m.run_fused(sim_covs=sc)
+
+    mesh = make_mesh(2, 4)
+    pargs = tuple(pad_to_mesh(a, mesh) for a in args)
+    assert pargs[0].shape == (T, 1000)  # uneven stock axis padded
+
+    base = jax.jit(pipeline)(*pargs, sim_covs)
+    jax.block_until_ready(base)
+
+    sargs = shard_panel(pargs, mesh)
+    with use_mesh(mesh):
+        out = jax.jit(pipeline)(*sargs, sim_covs)
+        jax.block_until_ready(out)
+
+    for name, b, s in zip(base._fields, base, out):
+        _bitwise(f"run_fused.{name}", b, s)
+
+
+def test_update_guarded_sharded_bitwise_uneven_n999():
+    """The guarded daily update on a 2x4 mesh at N=999: stock axis padded
+    to 1000 (state paths never pad time — padded dates would fold into the
+    NW/VR carries), state replicated.  Outputs, guard report and all state
+    leaves bitwise-equal to the single-device update."""
+    from mfm_tpu.config import QuarantinePolicy
+    from mfm_tpu.parallel.mesh import replicated
+
+    T_HIST, SLAB, N, P, Q = 16, 4, 999, 5, 3
+    cfg = RiskModelConfig(eigen_n_sims=8, eigen_sim_length=100,
+                          quarantine=QuarantinePolicy(enabled=True))
+    mesh = make_mesh(2, 4)
+    full = _uneven_universe_inputs(T_HIST + SLAB, N, P, Q, seed=9)
+
+    def pad_stock(a):
+        w = [(0, 0)] * a.ndim
+        w[1] = (0, (-N) % 4)
+        return jnp.pad(a, w, constant_values=False if a.dtype == bool else 0)
+
+    fullp = tuple(pad_stock(a) for a in full)
+    hist = tuple(a[:T_HIST] for a in fullp)
+    slab = tuple(a[T_HIST:] for a in fullp)
+
+    def run_pair(sharded):
+        m = RiskModel(*tuple(jnp.array(a) for a in hist),
+                      n_industries=P, config=cfg)
+        _, state = m.init_state()
+        if sharded:
+            sm = shard_panel(slab, mesh)
+            state = jax.device_put(state, replicated(mesh))
+            with use_mesh(mesh):
+                m2 = RiskModel(*tuple(jnp.array(a) for a in sm),
+                               n_industries=P, config=cfg)
+                outs, report, new_state = m2.update_guarded(state)
+                jax.block_until_ready(outs)
+        else:
+            m2 = RiskModel(*tuple(jnp.array(a) for a in slab),
+                           n_industries=P, config=cfg)
+            outs, report, new_state = m2.update_guarded(state)
+            jax.block_until_ready(outs)
+        return outs, report, new_state
+
+    b_out, b_rep, b_st = run_pair(False)
+    s_out, s_rep, s_st = run_pair(True)
+
+    for name, b, s in zip(b_out._fields, b_out, s_out):
+        _bitwise(f"out.{name}", b, s)
+    for name, b, s in zip(b_rep._fields, b_rep, s_rep):
+        _bitwise(f"report.{name}", b, s)
+    for i, (b, s) in enumerate(zip(jax.tree_util.tree_leaves(b_st),
+                                   jax.tree_util.tree_leaves(s_st))):
+        _bitwise(f"state.leaf{i}", b, s)
+
+
+def test_guarded_update_steady_state_single_compile_under_mesh(arrays):
+    """Serving invariant on the mesh: after the first guarded update
+    compiles, subsequent same-shape slabs must NOT retrace (sharding
+    metadata drift in the state pytree would).  <=1 lowering across two
+    further updates."""
+    from mfm_tpu.config import QuarantinePolicy
+    from mfm_tpu.parallel.mesh import replicated
+    from mfm_tpu.utils.contracts import assert_max_compiles
+
+    a = arrays
+    cfg = RiskModelConfig(eigen_n_sims=8, eigen_sim_length=100,
+                          quarantine=QuarantinePolicy(enabled=True))
+    mesh = make_mesh(2, 4)
+    panels = tuple(jnp.asarray(v) for v in
+                   (a.ret, a.cap, a.styles, a.industry, a.valid))
+    T_HIST, SLAB = 48, 4
+
+    hist = tuple(p[:T_HIST] for p in panels)
+    m = RiskModel(*hist, n_industries=a.n_industries, config=cfg)
+    _, state = m.init_state()
+    state = jax.device_put(state, replicated(mesh))
+
+    @jax.jit
+    def step(state, ret, cap, styles, industry, valid):
+        m2 = RiskModel(ret, cap, styles, industry, valid,
+                       n_industries=a.n_industries, config=cfg)
+        return m2.update_guarded(state)
+
+    def slab_at(t0):
+        return shard_panel(tuple(p[t0:t0 + SLAB] for p in panels), mesh)
+
+    with use_mesh(mesh):
+        _, _, state = step(state, *slab_at(T_HIST))  # warmup compile
+        jax.block_until_ready(state)
+        with assert_max_compiles(1, "guarded update steady state on mesh"):
+            _, _, state = step(state, *slab_at(T_HIST + SLAB))
+            _, _, state = step(state, *slab_at(T_HIST + 2 * SLAB))
+            jax.block_until_ready(state)
+
+
+def _pipe_frame(T, N, P, Q, seed=0, missing=0.1):
+    import pandas as pd
+
+    rng = np.random.default_rng(seed)
+    dates = pd.date_range("2020-01-01", periods=T,
+                          freq="B").strftime("%Y-%m-%d")
+    styles = [f"st{q}" for q in range(Q)]
+    rows = []
+    for t in range(T):
+        for j in range(N):
+            if rng.random() < missing:
+                continue
+            row = {"date": dates[t], "stocknames": f"s{j:03d}",
+                   "capital": float(np.exp(rng.normal(10, 1))),
+                   "ret": float(0.01 * rng.standard_normal()),
+                   "industry": f"ind{j % P}"}
+            for s in styles:
+                row[s] = float(rng.standard_normal())
+            rows.append(row)
+    return pd.DataFrame(rows)
+
+
+def test_pipeline_shard_local_mesh_matches_dense():
+    """run_risk_pipeline(mesh=...) — shard-local panel construction, no
+    host-side full densify — against the classic dense path.  T=37 N=21
+    divides neither mesh axis, so make_array_from_callback fills the
+    overhang blocks with missing data.  Allclose, not bitwise: the jit
+    boundary here wraps the whole pipeline and the partitioner's fusion
+    choices perturb the NW scan at the ulp level (see module comment)."""
+    from mfm_tpu.config import PipelineConfig, RiskModelConfig as RMC
+    from mfm_tpu.pipeline import run_risk_pipeline
+
+    df = _pipe_frame(T=37, N=21, P=4, Q=3)
+    cfg = PipelineConfig(risk=RMC(eigen_n_sims=4, eigen_sim_length=24),
+                         dtype="float64")
+    res_d = run_risk_pipeline(barra_df=df, config=cfg)
+    mesh = make_mesh(4, 2)
+    res_s = run_risk_pipeline(barra_df=df, config=cfg, mesh=mesh)
+
+    for f in res_d.outputs._fields:
+        b = np.asarray(getattr(res_d.outputs, f))
+        s = np.asarray(getattr(res_s.outputs, f))
+        assert b.shape == s.shape, f  # cropped back to the real (T, N)
+        if b.dtype == bool:
+            np.testing.assert_array_equal(b, s, err_msg=f)
+        else:
+            np.testing.assert_allclose(s, b, rtol=1e-9, atol=1e-12,
+                                       equal_nan=True, err_msg=f)
+
+    # the result's arrays facade is lazy: metadata came from the COO axes,
+    # dense panels materialize only on access
+    assert res_s.factor_returns().shape == (37, 1 + 4 + 3)
+    assert res_s.specific_returns().shape == (37, 21)
+
+
+def test_pipeline_mesh_state_run_requires_divisible_shapes():
+    """A state (resumable-carry) run cannot be mesh-padded: padded dates
+    would fold into the NW/VR carries and padded stocks into the guard
+    ring.  Non-divisible shapes must raise, divisible shapes must match
+    the dense state run."""
+    from mfm_tpu.config import PipelineConfig, RiskModelConfig as RMC
+    from mfm_tpu.pipeline import run_risk_pipeline
+
+    df = _pipe_frame(T=36, N=21, P=4, Q=3)
+    cfg = PipelineConfig(risk=RMC(eigen_n_sims=4, eigen_sim_length=24),
+                         dtype="float64")
+
+    with pytest.raises(ValueError, match="state"):
+        run_risk_pipeline(barra_df=df, config=cfg, mesh=make_mesh(4, 2),
+                          with_state=True)
+
+    mesh = make_mesh(4, 1, devices=jax.devices()[:4])  # 36 % 4 == 21 % 1 == 0
+    res_s = run_risk_pipeline(barra_df=df, config=cfg, mesh=mesh,
+                              with_state=True)
+    res_d = run_risk_pipeline(barra_df=df, config=cfg, with_state=True)
+    assert res_s.state is not None
+    for ls, ld in zip(jax.tree_util.tree_leaves(res_s.state),
+                      jax.tree_util.tree_leaves(res_d.state)):
+        a, b = np.asarray(ls), np.asarray(ld)
+        if a.dtype == bool or a.dtype.kind in "iu":
+            np.testing.assert_array_equal(a, b)
+        else:
+            np.testing.assert_allclose(a, b, rtol=1e-9, atol=1e-12,
+                                       equal_nan=True)

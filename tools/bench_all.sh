@@ -49,6 +49,21 @@ python bench.py --config alpha   "${plat[@]}" | tail -1 > "$out/config5_alpha.js
 python bench.py --config query   "${plat[@]}" | tail -1 > "$out/config6_query.json"
 python bench.py --config scenario "${plat[@]}" | tail -1 > "$out/config7_scenario.json"
 
+# universe-scaling smoke (slow; skip with MFM_SKIP_UNIVERSE_SMOKE=1): the
+# full A-share universe (N=5000) on an 8-device host mesh, time-bounded by
+# BENCH_SMOKE_T so it proves the sharded path compiles and runs end to end
+# rather than re-measuring the committed grid (tools/multichip_bench.py
+# regenerates MULTICHIP_r06.json).  Pinned to --platform cpu: the knob under
+# test is host-device sharding, not the TPU tunnel.  The record's universe
+# is renamed "alla_t64" by the smoke bound, and bench_all does not perfgate
+# it — smoke-T walls are not comparable to the full-T trajectory.
+if [ -z "${MFM_SKIP_UNIVERSE_SMOKE:-}" ]; then
+  BENCH_SMOKE_T=64 python bench.py --config riskmodel --universe 5000 \
+      --devices 8 --platform cpu | tail -1 > "$out/config1_universe5000.json" \
+    || { echo "universe-scaling smoke failed — sharded N=5000 path broken" >&2
+         exit 1; }
+fi
+
 # eigen-stage evidence sweep (tools/profile_eigen.py --json): the
 # chunk x batch_hint x dtype grid with XLA cost analysis per cell — the
 # committed EIGEN_SWEEP_r*.json files are snapshots of this output, and a
@@ -77,9 +92,11 @@ done
 # atomicity, per-lane poison isolation, and trace-flush crash atomicity —
 # a SIGKILL mid trace.json flush must tear neither trace nor checkpoint),
 # plus the incremental-eigen carry: a SIGKILL mid eigen-carry checkpoint
-# save must leave the prior state bitwise-intact and doctor-green
+# save must leave the prior state bitwise-intact and doctor-green, and the
+# sharded append: a SIGKILL mid `--append --mesh 2x2` must prove the mesh
+# changes nothing about the fence (prior bytes identical, replay bitwise)
 python tools/faultinject.py --plans \
-  query-kill-mid-batch,query-poison-slab,query-overflow-storm,query-ckpt-swap,query-steady-state,scenario-kill-mid-batch,scenario-poison-spec,trace-kill-mid-flush,eigen-kill-mid-update \
+  query-kill-mid-batch,query-poison-slab,query-overflow-storm,query-ckpt-swap,query-steady-state,scenario-kill-mid-batch,scenario-poison-spec,trace-kill-mid-flush,eigen-kill-mid-update,shard-kill-mid-append \
   || { echo "query/scenario/trace chaos plans failed — config6/7 numbers are not evidence" >&2
        exit 1; }
 

@@ -404,6 +404,68 @@ def run_eigen_kill(plan, base: Baseline, root: str) -> dict:
             "replay": "bitwise", "doctor": "green"}
 
 
+def run_shard_kill(plan, base: Baseline, root: str) -> dict:
+    """shard-kill-mid-append: SIGKILL a ``risk --update --mesh DxS``
+    subprocess between the checkpoint's tmp write and its rename — the ONE
+    update step ran SHARDED (slab panels sharded over the mesh, state
+    replicated; PR 11's scaling path).  Sharding must change nothing about
+    the crash story: the prior generation stays byte-identical on disk,
+    the fenced load is clean, and an (unsharded) in-process replay lands
+    bitwise on the fault-free outputs and carries — proving the sharded
+    subprocess's aborted step left no side effects AND that a sharded
+    update is checkpoint-interchangeable with a single-device one."""
+    from mfm_tpu.data.artifacts import load_risk_state
+
+    point = plan.param("point")
+    mesh = plan.param("mesh", "2x2")
+    nd, _, ns = mesh.partition("x")
+    n_dev = int(nd) * int(ns or 1)
+    d = _fresh_workdir(root, plan.name, base.snaps[0])
+    path = os.path.join(d, "state.npz")
+    with open(path, "rb") as fh:
+        pre_bytes = fh.read()
+    table_csv = os.path.join(d, "slab0.csv")
+    base.slabs[0].to_csv(table_csv, index=False)
+    cmd = [sys.executable, "-m", "mfm_tpu.cli", "risk",
+           "--barra", table_csv, "--update", path, "--quarantine",
+           "--mesh", mesh,
+           "--eigen-sims", str(EIGEN_SIMS),
+           "--eigen-sim-length", str(T_TOTAL),
+           "--out", os.path.join(d, "tables")]
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        flags += f" --xla_force_host_platform_device_count={n_dev}"
+    env = {**os.environ, "MFM_CHAOS_KILL": point, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": flags,
+           "PYTHONPATH": os.path.dirname(os.path.dirname(
+               os.path.abspath(__file__)))}
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=600)
+    if proc.returncode != -signal.SIGKILL:
+        raise AssertionError(
+            f"{plan.name}: expected the sharded subprocess to die by "
+            f"SIGKILL at {point}, got rc={proc.returncode}\n"
+            f"{proc.stderr[-2000:]}")
+
+    # the fence's whole promise, now under a mesh: the tmp write touched
+    # nothing but its own tmp file
+    with open(path, "rb") as fh:
+        post_bytes = fh.read()
+    if post_bytes != pre_bytes:
+        raise AssertionError(f"{plan.name}: checkpoint bytes changed under "
+                             "a sharded write that never renamed")
+    _, meta = load_risk_state(path)  # fenced: must load clean
+    if meta["last_date"] != str(base.hist["date"].max()):
+        raise AssertionError(f"{plan.name}: checkpoint advanced past a "
+                             "sharded write that never completed")
+    res = _append(path, base.slabs[0], base.cfg)
+    _assert_outputs_equal(_outputs_by_date(res), base.outputs[0],
+                          base.slab_dates[0], plan.name)
+    _assert_carries_equal(_carries(res.state), base.carries[0], plan.name)
+    return {"killed_at": point, "mesh": mesh,
+            "prior_state": "byte-identical", "replay": "bitwise"}
+
+
 def run_kill_manifest(plan, base: Baseline, root: str) -> dict:
     """kill-at-manifest: SIGKILL between the manifest's tmp write and its
     rename.  The checkpoint (written and fenced BEFORE the manifest) must be
@@ -1146,7 +1208,8 @@ RUNNERS = {"truncate": run_byte_fault, "corrupt": run_byte_fault,
            "query_steady": run_query_steady,
            "scenario_kill": run_scenario_kill,
            "scenario_poison": run_scenario_poison,
-           "trace_kill": run_trace_kill, "eigen_kill": run_eigen_kill}
+           "trace_kill": run_trace_kill, "eigen_kill": run_eigen_kill,
+           "shard_kill": run_shard_kill}
 
 
 def main(argv=None) -> int:

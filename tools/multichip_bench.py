@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""MULTICHIP_r06 grid runner: the universe-scaling evidence table.
+
+Runs ``bench.py --config riskmodel --inner`` once per (universe N,
+device count) cell — each cell a fresh subprocess so ``--devices``
+can set ``XLA_FLAGS=--xla_force_host_platform_device_count`` before
+jax imports — and writes one JSON artifact holding every cell record
+plus the derived eigen-stage speedup matrix.
+
+The committed ``MULTICHIP_r06.json`` was produced on this repo's CI
+container, where the 8 "devices" are XLA *host* devices multiplexed
+onto the physical CPU cores actually present (``host_cpu_count`` in
+every cell; 1 on the container).  On such a box the wall-clock speedup
+from sharding is bounded by physical parallelism, not by the sharding
+itself — the honest quantity the grid pins down there is the per-device
+batch reduction (``eigen_rows_per_device``), which is what converts to
+wall speedup one-for-one on real multi-chip hardware, plus the proof
+that the sharded program scales to N=5000 at all without a host-side
+full panel.  Run the same command on a TPU pod slice to regenerate the
+table with real chips.
+
+Usage::
+
+    python tools/multichip_bench.py                      # full grid
+    python tools/multichip_bench.py --universes 300 --devices 1,2
+    BENCH_SMOKE_T=32 is honored via --smoke-t 32 (cells then carry a
+    ``_t32`` universe-name suffix so they can never masquerade as the
+    full-history record; see data/synthetic.py::resolve_universe).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _parse_ints(s: str) -> list:
+    return [int(x) for x in s.replace(" ", "").split(",") if x]
+
+
+def run_cell(universe: int, devices: int, platform: str, timeout: float,
+             smoke_t: int | None) -> dict:
+    """One grid cell = one fresh ``bench.py --inner`` subprocess.  Returns
+    the bench record, or an ``{"error": ...}`` stub on failure — a torn
+    cell must not lose the rest of the grid."""
+    cmd = [sys.executable, os.path.join(REPO, "bench.py"),
+           "--config", "riskmodel", "--inner", "--platform", platform,
+           "--universe", str(universe), "--devices", str(devices)]
+    env = dict(os.environ)
+    if smoke_t is not None:
+        env["BENCH_SMOKE_T"] = str(smoke_t)
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout, env=env, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout:.0f}s",
+                "universe_n": universe, "devices": devices}
+    wall = time.perf_counter() - t0
+    rec = None
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and "metric" in obj:
+                rec = obj
+                break
+    if proc.returncode != 0 or rec is None:
+        return {"error": f"rc={proc.returncode}",
+                "universe_n": universe, "devices": devices,
+                "stderr_tail": proc.stderr[-800:]}
+    rec["cell_wall_s"] = round(wall, 1)  # includes compile + subprocess
+    return rec
+
+
+def build_grid(universes, devices, platform="cpu", timeout=3600.0,
+               smoke_t=None, echo=print) -> dict:
+    cells = []
+    for n in universes:
+        for d in devices:
+            echo(f"multichip: N={n} devices={d} ...")
+            rec = run_cell(n, d, platform, timeout, smoke_t)
+            tag = (f"eigen={rec.get('eigen_stage_wall_s')}s "
+                   f"e2e={rec.get('e2e_wall_s')}s"
+                   if "error" not in rec else rec["error"])
+            echo(f"multichip: N={n} devices={d} -> {tag}")
+            cells.append(rec)
+
+    # eigen-stage speedup of each cell over its universe's 1-device cell
+    # (the ISSUE-11 acceptance quantity), plus the per-device eigh-batch
+    # row count — the hardware-independent scaling fact
+    def _cell(n, d):
+        for rec in cells:
+            if rec.get("universe_n") == n and rec.get("devices") == d \
+                    and "error" not in rec:
+                return rec
+        return None
+
+    speedups = {}
+    for n in universes:
+        base = _cell(n, 1)
+        row = {}
+        for d in devices:
+            cur = _cell(n, d)
+            if base and cur and base.get("eigen_stage_wall_s") \
+                    and cur.get("eigen_stage_wall_s"):
+                row[str(d)] = round(base["eigen_stage_wall_s"]
+                                    / cur["eigen_stage_wall_s"], 2)
+            if cur and cur.get("padded_t"):
+                cur["eigen_rows_per_device"] = cur["padded_t"] // max(
+                    cur.get("mesh", {}).get("date", d), 1)
+        speedups[str(n)] = row
+
+    target_n, target_d = max(universes), max(devices)
+    got = speedups.get(str(target_n), {}).get(str(target_d))
+    return {
+        "schema": "multichip/r06",
+        "generated_by": "tools/multichip_bench.py",
+        "platform": platform,
+        "host_cpu_count": os.cpu_count(),
+        "smoke_t": smoke_t,
+        "note": ("virtual XLA host devices share the physical cores below "
+                 "host_cpu_count; on a 1-core container the wall-clock "
+                 "speedup column is flat by construction and the scaling "
+                 "evidence is eigen_rows_per_device (the per-device batch "
+                 "each chip would own on real hardware)"),
+        "cells": cells,
+        "eigen_stage_speedup_vs_1dev": speedups,
+        "acceptance": {
+            "quantity": "eigen_stage_speedup_vs_1dev"
+                        f"[{target_n}][{target_d}]",
+            "target": 2.0,
+            "measured": got,
+            "met_on_this_host": bool(got is not None and got >= 2.0),
+            "physical_parallelism_bound": os.cpu_count(),
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--universes", default="300,1000,5000")
+    ap.add_argument("--devices", default="1,2,8")
+    ap.add_argument("--platform", default="cpu")
+    ap.add_argument("--timeout", type=float, default=3600.0,
+                    help="per-cell subprocess timeout (s)")
+    ap.add_argument("--smoke-t", type=int, default=None,
+                    help="bound history length via BENCH_SMOKE_T (cells "
+                         "get a _t<N> universe-name suffix)")
+    ap.add_argument("--out", default=os.path.join(REPO, "MULTICHIP_r06.json"))
+    args = ap.parse_args(argv)
+
+    grid = build_grid(_parse_ints(args.universes), _parse_ints(args.devices),
+                      platform=args.platform, timeout=args.timeout,
+                      smoke_t=args.smoke_t)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(grid, f, indent=1, sort_keys=True)
+        f.write("\n")
+    errs = [c for c in grid["cells"] if "error" in c]
+    print(f"multichip: wrote {args.out} "
+          f"({len(grid['cells'])} cells, {len(errs)} failed)")
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -19,9 +19,12 @@ Metrics and bands (overridable per metric with ``--tol``):
   doubles the fraction without meaning anything).
 - higher-is-better: ``portfolios_per_sec``, ``scenarios_per_sec``.
 
-The baseline per metric is the BEST same-backend value in the trajectory
-(min for walls, max for throughputs) — comparing a CPU-fallback run against
-a TPU round would only ever cry wolf, so cross-backend records are skipped.
+The baseline per metric is the BEST value in the trajectory under the
+``(backend, universe_n)`` key (min for walls, max for throughputs) —
+comparing a CPU-fallback run against a TPU round, or an N=5000 all-A wall
+against N=300 CSI300 history, would only ever cry wolf, so records from a
+different backend or universe are skipped.  Pre-PR-11 records carry no
+``universe_n``; :func:`universe_n` backfills it from the metric name.
 A record with no comparable baseline passes (you cannot regress from
 nothing), but the report says so.
 
@@ -65,7 +68,10 @@ def extract_metrics(rec) -> dict:
     if not isinstance(rec, dict):
         return out
     metric = rec.get("metric")
-    if metric == "csi300_riskmodel_e2e_wall":
+    if metric in ("csi300_riskmodel_e2e_wall", "riskmodel_e2e_wall",
+                  "alla_full_pipeline_wall"):
+        # the three riskmodel-wall families share one metric namespace;
+        # universe_n() keeps their baselines apart
         out["e2e_wall_s"] = rec.get("value")
         for k in ("daily_update_latency_s", "guarded_update_latency_s",
                   "eigen_stage_wall_s", "eigen_update_latency_s",
@@ -77,6 +83,27 @@ def extract_metrics(rec) -> dict:
         out["scenarios_per_sec"] = rec.get("value")
     return {k: v for k, v in out.items()
             if isinstance(v, (int, float)) and v == v}
+
+
+def universe_n(rec) -> int | None:
+    """The stock-count key a record's baselines are bucketed under.
+
+    Records written before PR 11 carry no ``universe_n``; every one of
+    them was CSI300-shaped (N=300) except the alla pipeline record, which
+    was N=5000 by construction — so absence backfills from the metric
+    name.  Returns None for non-universe records (query/scenario
+    throughputs), which gate across all universes as before."""
+    if not isinstance(rec, dict):
+        return None
+    n = rec.get("universe_n")
+    if isinstance(n, int):
+        return n
+    metric = rec.get("metric")
+    if metric in ("csi300_riskmodel_e2e_wall", "riskmodel_e2e_wall"):
+        return 300
+    if metric == "alla_full_pipeline_wall":
+        return 5000
+    return None
 
 
 def _unwrap(obj):
@@ -115,14 +142,18 @@ def gate_record(rec, trajectory, tolerances=None) -> dict:
     value), ``backend``, ``baseline_runs``."""
     tolerances = tolerances or {}
     backend = rec.get("backend") if isinstance(rec, dict) else None
+    uni = universe_n(rec)
     current = extract_metrics(rec)
 
-    # best same-backend value per metric (+ where it came from)
+    # best value per metric under the (backend, universe_n) key (+ where
+    # it came from) — an N=5000 wall must never be held to N=300 history
     best = {}
     runs = set()
     for entry in trajectory:
         base = entry["record"]
         if base.get("backend") != backend:
+            continue
+        if universe_n(base) != uni:
             continue
         for k, v in extract_metrics(base).items():
             direction = METRIC_SPECS[k][0]
@@ -139,9 +170,11 @@ def gate_record(rec, trajectory, tolerances=None) -> dict:
             skipped.append({"metric": name, "reason": "not in this record"})
             continue
         if name not in best:
-            skipped.append({"metric": name,
-                            "reason": f"no {backend or 'unknown'}-backend "
-                                      "baseline in trajectory"})
+            where = (f"no {backend or 'unknown'}-backend baseline in "
+                     "trajectory")
+            if uni is not None:
+                where += f" at universe_n={uni}"
+            skipped.append({"metric": name, "reason": where})
             continue
         base_v, base_run = best[name]
         tol = float(tolerances.get(name, tol))
@@ -156,14 +189,16 @@ def gate_record(rec, trajectory, tolerances=None) -> dict:
                        "baseline_run": base_run, "limit": round(limit, 6),
                        "tolerance": tol, "floor": floor,
                        "regressed": bool(regressed)})
-    return {"backend": backend, "checks": checks,
+    return {"backend": backend, "universe_n": uni, "checks": checks,
             "regressions": [c for c in checks if c["regressed"]],
             "skipped": skipped, "baseline_runs": sorted(runs)}
 
 
 def format_report(verdict: dict) -> str:
+    uni = verdict.get("universe_n")
     lines = [f"perfgate: backend={verdict['backend'] or 'unknown'} "
-             f"baselines={','.join(verdict['baseline_runs']) or 'none'}"]
+             + (f"universe_n={uni} " if uni is not None else "")
+             + f"baselines={','.join(verdict['baseline_runs']) or 'none'}"]
     for c in verdict["checks"]:
         arrow = "<=" if c["direction"] == "lower" else ">="
         status = "REGRESSED" if c["regressed"] else "ok"
