@@ -1026,6 +1026,122 @@ def bench_scenario():
             "summary": scenario_summary_from_registry()}
 
 
+def bench_grad():
+    """Config 8: the differentiable-risk subsystem (mfm_tpu/grad/).
+
+    Three numbers over a CSI300-shaped factor space, each inside the
+    <=1-compile steady-state contract of its donated jit: min-vol
+    construction throughput at B = 1e2 / 1e4 portfolios (with the KKT
+    stationarity residual as the convergence diagnostic), and
+    reverse-stress throughput (projected gradient ascent over the shock
+    ball, differentiating through the gated PSD projection).  The
+    reverse-stress answer is also checked against the preset drill
+    catalog: the worst admissible shock must be admissible AND report at
+    least every preset's vol for the same portfolio — a worst case that
+    loses to a drill the desk already runs is a solver bug, not a
+    benchmark."""
+    import jax.numpy as jnp
+
+    from mfm_tpu.grad.construct import minvol_batch
+    from mfm_tpu.grad.engine import (
+        GradEngine, MINVOL_ETA, MINVOL_STEPS, REVERSE_STEPS, ShockBall,
+    )
+    from mfm_tpu.models.risk_model import portfolio_vol
+    from mfm_tpu.scenario import PRESETS
+    from mfm_tpu.scenario.engine import ScenarioEngine
+    from mfm_tpu.serve import bucket_for
+    from mfm_tpu.utils.contracts import assert_max_compiles
+
+    K = 1 + 31 + 10          # country + industries + styles (config-1 shape)
+    rng = np.random.default_rng(0)
+    A = (rng.standard_normal((K, K)) / np.sqrt(K)).astype(np.float32)
+    cov = (A @ A.T + 1e-3 * np.eye(K, dtype=np.float32)) * 1e-4
+    names = [f"f{i}" for i in range(K)]
+    cov_j = jnp.array(cov)
+    lo = jnp.zeros(K, jnp.float32)
+    hi = jnp.ones(K, jnp.float32)
+    eta = jnp.asarray(MINVOL_ETA, jnp.float32)
+    steps = jnp.int32(MINVOL_STEPS)
+
+    minvol = {}
+    kkt_worst = 0.0
+    for b in (100, 10_000):
+        bucket = bucket_for(b)
+        xs0_np = np.full((bucket, K), 1.0 / K, np.float32)
+
+        def step(xs0_np=xs0_np):
+            # xs0 is donated — a fresh device buffer per call, like the
+            # engine path; the max KKT residual over the bucket forces
+            # the whole solve
+            x, vol, kkt = minvol_batch(jnp.array(xs0_np), cov_j, lo, hi,
+                                       eta, steps)
+            return jnp.max(kkt)
+
+        kkt = _force(step())  # compile + warmup: the one allowed compile
+        times = []
+        with assert_max_compiles(1, f"steady-state min-vol bucket {bucket}"):
+            for _ in range(3):
+                t0 = time.perf_counter()
+                kkt = _force(step())
+                times.append(time.perf_counter() - t0)
+        wall = min(times)
+        kkt_worst = max(kkt_worst, float(kkt))
+        minvol[str(b)] = {"bucket": bucket, "wall_s": round(wall, 4),
+                          "portfolios_per_sec": round(b / wall)}
+
+    # reverse stress: P books through the ascent (each step is a vjp
+    # through stress + gated PSD projection), then the catalog check
+    engine = GradEngine(cov, factor_names=names)
+    P = 64
+    rng2 = np.random.default_rng(1)
+    W = (0.2 * rng2.standard_normal((P, K))).astype(np.float32)
+    ball = ShockBall()
+    engine.reverse_stress(W, ball=ball)    # compile + warmup
+    times, entries = [], None
+    bucket = bucket_for(P)
+    with assert_max_compiles(1, f"steady-state reverse bucket {bucket}"):
+        for _ in range(3):
+            t0 = time.perf_counter()
+            entries = engine.reverse_stress(W, ball=ball)
+            times.append(time.perf_counter() - t0)
+    wall = min(times)
+    inadmissible = [e["label"] for e in entries if not e["admissible"]]
+    if inadmissible:
+        raise AssertionError("reverse-stress answers left the admissible "
+                             f"set: {inadmissible[:5]}")
+    # the worst case must dominate every preset drill for the same book
+    scen = ScenarioEngine(cov, factor_names=names)
+    drills = {r.spec.name: np.asarray(r.cov, np.float64)
+              for r in scen.run([PRESETS[n] for n in sorted(PRESETS)])}
+    x0 = np.asarray(W[0], np.float64)
+    losses = []
+    for name, dcov in drills.items():
+        drill_vol = float(portfolio_vol(jnp.array(dcov), jnp.array(x0)))
+        if entries[0]["vol_worst"] < drill_vol * (1 - 1e-5):
+            losses.append((name, drill_vol))
+    if losses:
+        raise AssertionError("reverse-stress worst case loses to preset "
+                             f"drills: {losses}")
+
+    reverse = {"P": P, "bucket": bucket, "steps": REVERSE_STEPS,
+               "wall_s": round(wall, 4),
+               "scenarios_per_sec": round(P / wall, 1),
+               "vol_worst_vs_presets": "dominates"}
+    return {"metric": "grad_throughput",
+            "value": minvol["10000"]["portfolios_per_sec"],
+            "unit": "portfolios/s", "vs_baseline": None,
+            "k_factors": K,
+            "minvol_portfolios_per_sec_b100":
+                minvol["100"]["portfolios_per_sec"],
+            "minvol_portfolios_per_sec_b10000":
+                minvol["10000"]["portfolios_per_sec"],
+            "reverse_scenarios_per_sec": reverse["scenarios_per_sec"],
+            "minvol_convergence_iters": MINVOL_STEPS,
+            "minvol_kkt_residual": round(kkt_worst, 8),
+            "minvol": minvol,
+            "reverse": reverse}
+
+
 CONFIGS = {
     "riskmodel": bench_riskmodel,
     "chunk_sweep": bench_chunk_sweep,
@@ -1036,6 +1152,7 @@ CONFIGS = {
     "alpha_alla": bench_alpha_alla,
     "query": bench_query,
     "scenario": bench_scenario,
+    "grad": bench_grad,
 }
 
 

@@ -419,6 +419,116 @@ def _scenario_cells():
     return cells
 
 
+def _replicated_mesh_cells(args, meshes=((2, 4),)):
+    """role='mesh' cells with EVERY operand replicated — the grad
+    entrypoints' wire layout: their batches are portfolio/scenario lanes
+    (no ('date','stock') panel axes to lay out), so under a mesh the whole
+    program replicates and the collective pass proves it stays
+    collective-free.  Skipped with a warn finding when the process has too
+    few devices (matches _risk_fused_cells)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    from mfm_tpu.parallel.mesh import make_mesh
+
+    cells = []
+    for nd, ns in meshes:
+        if jax.device_count() < nd * ns:
+            cells.append(Cell(f"mesh{nd}x{ns}", (), {}, role="mesh",
+                              mesh=(nd, ns)))
+            continue
+        mesh = make_mesh(nd, ns)
+        rep = NamedSharding(mesh, PartitionSpec())
+        cells.append(Cell(
+            f"mesh{nd}x{ns}",
+            tuple(jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=rep)
+                  for a in args),
+            {}, role="mesh", mesh=(nd, ns)))
+    return cells
+
+
+def _grad_ladder_cells(make_args):
+    """primary + ladder + replicated-mesh cells over the query bucket
+    ladder for one grad jit; ``make_args(b)`` builds the aval tuple at
+    batch ``b``.  Solver knobs (eta/step/steps) are TRACED scalar
+    operands, so every rung shares one static signature — the surface
+    pass proves exactly one cache key per bucket."""
+    from mfm_tpu.serve.query import bucket_for
+
+    b0 = _QUERY_BUCKETS[0]
+    cells = [Cell(f"bucket{b0}", make_args(b0), {}, bucket=b0)]
+    for b in _QUERY_BUCKETS:
+        assert bucket_for(b) == b, "declared grad bucket not a fixed point"
+        cells.append(Cell(f"bucket{b}", make_args(b), {}, role="ladder",
+                          bucket=b))
+    return cells + _replicated_mesh_cells(make_args(b0))
+
+
+def _grad_reverse_cells():
+    th = 2 * _K + 2        # theta layout: shift | scale | vol_mult | corr
+
+    def make(b):
+        return (
+            _sds((_K, _K), jnp.float32),      # cov
+            _sds((b, _K), jnp.float32),       # xs
+            _sds((b, th), jnp.float32),       # theta0 (donated)
+            _sds((th,), jnp.float32),         # lo
+            _sds((th,), jnp.float32),         # hi
+            _sds((), jnp.float32),            # step (traced)
+            _sds((), jnp.int32),              # steps (traced)
+        )
+    return _grad_ladder_cells(make)
+
+
+def _grad_minvol_cells():
+    def make(b):
+        return (
+            _sds((b, _K), jnp.float32),       # xs0 (donated)
+            _sds((_K, _K), jnp.float32),      # cov
+            _sds((_K,), jnp.float32),         # lo
+            _sds((_K,), jnp.float32),         # hi
+            _sds((), jnp.float32),            # eta (traced)
+            _sds((), jnp.int32),              # steps (traced)
+        )
+    return _grad_ladder_cells(make)
+
+
+def _grad_riskparity_cells():
+    def make(b):
+        return (
+            _sds((b, _K), jnp.float32),       # xs0 (donated)
+            _sds((_K, _K), jnp.float32),      # cov
+            _sds((), jnp.float32),            # eta (traced)
+            _sds((), jnp.int32),              # steps (traced)
+        )
+    return _grad_ladder_cells(make)
+
+
+def _grad_hedge_cells():
+    def make(b):
+        return (
+            _sds((b, _K), jnp.float32),       # xs0 (donated)
+            _sds((b, _K), jnp.float32),       # hs0 (donated)
+            _sds((_K, _K), jnp.float32),      # cov
+            _sds((b, _K), jnp.float32),       # mask
+            _sds((), jnp.float32),            # hmax (traced)
+            _sds((), jnp.float32),            # eta (traced)
+            _sds((), jnp.int32),              # steps (traced)
+        )
+    return _grad_ladder_cells(make)
+
+
+def _grad_sensitivity_cells():
+    def make(b):
+        return (
+            _sds((b, _K, _K), jnp.float32),   # base_cov
+            _sds((b, _K), jnp.float32),       # shift (donated)
+            _sds((b, _K), jnp.float32),       # scale (donated)
+            _sds((b,), jnp.float32),          # vol_mult
+            _sds((b,), jnp.float32),          # corr_beta
+            _sds((_K,), jnp.float32),         # x
+        )
+    return _grad_ladder_cells(make)
+
+
 def _guard_step_cells():
     T, N = AUDIT_MATRIX["T"], AUDIT_MATRIX["N"]
     policy = _guarded_config().quarantine
@@ -438,6 +548,9 @@ def _guard_step_cells():
 # -- the registry ------------------------------------------------------------
 
 def _build_registry() -> tuple:
+    from mfm_tpu.grad import construct as _gc
+    from mfm_tpu.grad import reverse as _gr
+    from mfm_tpu.grad import sensitivity as _gs
     from mfm_tpu.models import risk_model as _rm
     from mfm_tpu.scenario import kernel as _sk
     from mfm_tpu.serve import guard as _guard
@@ -499,6 +612,51 @@ def _build_registry() -> tuple:
             build_cells=_scenario_cells,
             ladder="scenario",
             notes="S-lane covariance shocks, query-engine bucket ladder"),
+        Entrypoint(
+            name="grad.reverse",
+            qualname="mfm_tpu.grad.reverse:reverse_stress_batch",
+            fn=_gr.reverse_stress_batch,
+            donate=(2,),
+            build_cells=_grad_reverse_cells,
+            ladder="query",
+            notes="reverse stress: projected ascent over the shock ball, "
+                  "differentiating through the gated PSD projection"),
+        Entrypoint(
+            name="grad.minvol",
+            qualname="mfm_tpu.grad.construct:minvol_batch",
+            fn=_gc.minvol_batch,
+            donate=(0,),
+            build_cells=_grad_minvol_cells,
+            ladder="query",
+            notes="min-vol construction (exponentiated gradient on the "
+                  "boxed simplex), query bucket ladder"),
+        Entrypoint(
+            name="grad.riskparity",
+            qualname="mfm_tpu.grad.construct:riskparity_batch",
+            fn=_gc.riskparity_batch,
+            donate=(0,),
+            build_cells=_grad_riskparity_cells,
+            ladder="query",
+            notes="equal-risk-contribution construction (damped Jacobi on "
+                  "the convex ERC root)"),
+        Entrypoint(
+            name="grad.hedge",
+            qualname="mfm_tpu.grad.construct:hedge_batch",
+            fn=_gc.hedge_batch,
+            donate=(0, 1),
+            build_cells=_grad_hedge_cells,
+            ladder="query",
+            notes="masked hedge-overlay construction (projected gradient "
+                  "in the |h| <= hmax box)"),
+        Entrypoint(
+            name="grad.sensitivity",
+            qualname="mfm_tpu.grad.sensitivity:sensitivity_batch",
+            fn=_gs.sensitivity_batch,
+            donate=(1, 2),
+            build_cells=_grad_sensitivity_cells,
+            ladder="query",
+            notes="exact d vol/d shock + d vol/d exposure rows per "
+                  "scenario lane (vjp, never finite differences)"),
         Entrypoint(
             name="guard.step",
             # the TRACED function's qualname (what mfmlint's call graph

@@ -1793,6 +1793,193 @@ def _scenario(args):
         raise SystemExit(1)
 
 
+def _grad_portfolios(args, engine):
+    """(P, K) portfolio rows off ``--portfolio`` JSON — one K-vector, a
+    list of them, or factor-name-keyed dicts; default is ONE equal-weight
+    portfolio over the engine's factors."""
+    import numpy as np
+
+    path = getattr(args, "portfolio", None)
+    if path is None:
+        return np.full((1, engine.K), 1.0 / engine.K)
+    try:
+        with open(path, encoding="utf-8") as fh:
+            obj = json.load(fh)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"grad: cannot read portfolio file {path}: {e}")
+    rows = obj if isinstance(obj, list) else [obj]
+    if rows and isinstance(rows[0], (int, float)):
+        rows = [rows]
+    W = np.zeros((len(rows), engine.K))
+    for i, row in enumerate(rows):
+        if isinstance(row, dict):
+            for k, v in row.items():
+                if str(k) not in engine.factor_index:
+                    raise SystemExit(f"grad: portfolio row {i} names "
+                                     f"unknown factor {k!r}")
+                try:
+                    W[i, engine.factor_index[str(k)]] = float(v)
+                except (TypeError, ValueError) as e:
+                    raise SystemExit(f"grad: bad weight in row {i}: {e}")
+        else:
+            try:
+                r = np.asarray(row, np.float64)
+            except (TypeError, ValueError) as e:
+                raise SystemExit(f"grad: bad portfolio row {i}: {e}")
+            if r.shape != (engine.K,):
+                raise SystemExit(f"grad: portfolio row {i} is {r.shape}, "
+                                 f"need ({engine.K},)")
+            W[i] = r
+    if not np.isfinite(W).all():
+        raise SystemExit(f"grad: non-finite weights in {path}")
+    return W
+
+
+def _grad_specs(args):
+    """Scenario specs for `grad sensitivity` off --preset/--spec (the
+    `scenario run` assembly); default: identity + the preset catalog."""
+    from mfm_tpu.scenario import PRESETS, ScenarioSpec, preset
+
+    specs = []
+    try:
+        for name in args.preset:
+            specs.append(preset(name))
+    except KeyError as e:
+        raise SystemExit(f"grad: {e.args[0]}")
+    for path in args.spec:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                obj = json.load(fh)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"grad: cannot read spec file {path}: {e}")
+        try:
+            for d in (obj if isinstance(obj, list) else [obj]):
+                specs.append(ScenarioSpec.from_dict(d))
+        except (TypeError, ValueError, KeyError) as e:
+            raise SystemExit(f"grad: bad spec in {path}: {e}")
+    if not specs:
+        specs = [ScenarioSpec.identity()] + [PRESETS[n]
+                                             for n in sorted(PRESETS)]
+    return specs
+
+
+def _grad(args):
+    """Differentiable risk over a guarded checkpoint
+    (docs/DIFFERENTIABLE.md): ``reverse`` finds the worst admissible
+    shock per portfolio, ``sensitivity`` stamps exact ∂vol/∂shock +
+    ∂vol/∂exposure rows into the scenario manifest, ``construct`` runs
+    the min-vol / risk-parity / hedge solvers.  Every subcommand writes
+    an atomic ``grad_report.json`` beside the checkpoint."""
+    import sys
+
+    import numpy as np
+
+    from mfm_tpu.data.artifacts import (
+        ArtifactCorruptError, ArtifactStaleError, load_risk_state,
+    )
+    from mfm_tpu.grad import GradEngine, ShockBall, write_grad_report
+    from mfm_tpu.grad.report import build_grad_report
+    from mfm_tpu.obs.trace import end_span
+
+    _metrics_init(args)
+    root = _root_span(args)
+    try:
+        state, meta = load_risk_state(args.state)
+    except (ArtifactCorruptError, ArtifactStaleError) as e:
+        # same refusal as `serve` / `scenario`: a checkpoint past its
+        # fence audit is not a world worth differentiating
+        raise SystemExit(f"grad: checkpoint failed its fence audit: {e}")
+    except OSError as e:
+        raise SystemExit(f"grad: cannot load {args.state}: {e}")
+    try:
+        engine = GradEngine.from_risk_state(state, meta)
+    except ValueError as e:
+        raise SystemExit(f"grad: {e}")
+    W = _grad_portfolios(args, engine)
+    out_dir = args.out or (os.path.dirname(args.state) or ".")
+    os.makedirs(out_dir, exist_ok=True)
+
+    if args.gcmd == "reverse":
+        from mfm_tpu.grad.engine import REVERSE_STEPS
+
+        ball = ShockBall(vol_mult_hi=args.vol_mult_max,
+                         corr_beta_hi=args.corr_beta_max)
+        steps = REVERSE_STEPS if args.steps is None else args.steps
+        try:
+            entries = engine.reverse_stress(W, ball=ball, steps=steps,
+                                            bucket=args.bucket)
+        except ValueError as e:
+            raise SystemExit(f"grad: {e}")
+        kind = "reverse_stress"
+        params = {"ball": ball.to_dict(), "steps": int(steps)}
+        failed = sum(1 for e in entries if not e["admissible"])
+    elif args.gcmd == "sensitivity":
+        specs = _grad_specs(args)
+        try:
+            entries = engine.sensitivities(specs, W[0], bucket=args.bucket)
+        except ValueError as e:
+            raise SystemExit(f"grad: {e}")
+        # stamp the rows into the scenario manifest too: the forward
+        # batch runs first (same specs, same bucket discipline) and each
+        # ok entry gains a "sensitivity" block — one file answers both
+        # "what happened" and "how fast it changes"
+        from mfm_tpu.obs.instrument import scenario_summary_from_registry
+        from mfm_tpu.scenario import (
+            ScenarioEngine, build_scenario_manifest, write_scenario_manifest,
+        )
+        scen = ScenarioEngine.from_risk_state(state, meta)
+        try:
+            results = scen.run(specs, bucket=args.bucket)
+        except ValueError as e:
+            raise SystemExit(f"grad: {e}")
+        summary = scenario_summary_from_registry()
+        summary["trace_id"] = root.trace_id
+        manifest = build_scenario_manifest(
+            results, scen.factor_names, stamp_json=meta.get("stamp"),
+            backend=jax_backend_name(), summary=summary,
+            staleness=scen.staleness,
+            sensitivities={e["name"]: e for e in entries})
+        write_scenario_manifest(out_dir, manifest)
+        kind = "sensitivity"
+        params = {"portfolio": np.asarray(W[0], np.float64).tolist()}
+        failed = sum(1 for e in entries if e["status"] != "ok")
+    else:
+        try:
+            res = engine.construct_solve(args.solver, W,
+                                         bucket=args.bucket)
+        except ValueError as e:
+            raise SystemExit(f"grad: {e}")
+        entries = []
+        for i in range(W.shape[0]):
+            diag = np.asarray(res["diag"][i])
+            entries.append({
+                "label": f"p{i}",
+                "solver": args.solver,
+                "weights": {str(n): float(v) for n, v in
+                            zip(engine.factor_names, res["weights"][i])},
+                "total_vol": float(res["vols"][i]),
+                "diag": diag.tolist() if diag.ndim else float(diag),
+            })
+        kind = "construct"
+        params = {"solver": args.solver}
+        failed = 0
+
+    report = build_grad_report(kind, entries, stamp_json=meta.get("stamp"),
+                               backend=jax_backend_name(),
+                               staleness=engine.staleness, params=params)
+    rpath = write_grad_report(out_dir, report)
+    for e in entries:
+        print(json.dumps(e, sort_keys=True, default=str))
+    end_span(root)
+    _metrics_flush(args)
+    print(json.dumps({"report": rpath, "grad_kind": kind,
+                      "n_entries": len(entries), "n_failed": failed,
+                      "trace_id": root.trace_id},
+                     indent=1), file=sys.stderr)
+    if entries and failed == len(entries):
+        raise SystemExit(1)
+
+
 def jax_backend_name() -> str:
     import jax
 
@@ -2514,6 +2701,66 @@ def main(argv=None):
     scr.add_argument("--metrics-dir", default=None, help=_metrics_dir_help)
     sc.set_defaults(fn=_scenario)
 
+    gr = sub.add_parser(
+        "grad",
+        help="differentiable risk over a guarded checkpoint: reverse "
+             "stress (worst admissible shock per portfolio), exact "
+             "d vol/d shock sensitivity reports stamped into the scenario "
+             "manifest, and gradient-based construction solvers — atomic "
+             "grad_report.json beside the checkpoint "
+             "(docs/DIFFERENTIABLE.md)")
+    grs = gr.add_subparsers(dest="gcmd", required=True)
+
+    def _grad_common(p):
+        p.add_argument("state", help="risk-state .npz saved with "
+                                     "quarantine enabled (grad runs "
+                                     "against its last_good_cov)")
+        p.add_argument("--portfolio", default=None,
+                       help="JSON portfolio file: one K-vector of factor "
+                            "weights, a list of them, or factor-name-"
+                            "keyed dicts (default: one equal-weight "
+                            "portfolio)")
+        p.add_argument("--out", default=None,
+                       help="directory for grad_report.json (default: "
+                            "beside the checkpoint)")
+        p.add_argument("--bucket", type=int, default=None,
+                       help="explicit pad bucket >= the batch size "
+                            "(default: the geometric bucket)")
+        p.add_argument("--metrics-dir", default=None,
+                       help=_metrics_dir_help)
+
+    grr = grs.add_parser(
+        "reverse", help="projected gradient ascent over the admissible "
+                        "shock ball: the worst-case ScenarioSpec per "
+                        "portfolio")
+    _grad_common(grr)
+    grr.add_argument("--steps", type=int, default=None,
+                     help="ascent iterations (default: 200)")
+    grr.add_argument("--vol-mult-max", type=float, default=3.5,
+                     help="shock-ball vol_mult ceiling (default: 3.5)")
+    grr.add_argument("--corr-beta-max", type=float, default=0.95,
+                     help="shock-ball corr_beta ceiling (default: 0.95)")
+
+    grn = grs.add_parser(
+        "sensitivity", help="exact d vol/d shock and d vol/d exposure "
+                            "rows per scenario, stamped into the "
+                            "scenario manifest")
+    _grad_common(grn)
+    grn.add_argument("--preset", action="append", default=[],
+                     help="preset scenario name, repeatable (default: "
+                          "identity + the whole preset catalog)")
+    grn.add_argument("--spec", action="append", default=[],
+                     help="JSON ScenarioSpec file — one spec object or a "
+                          "list of them (repeatable)")
+
+    grc = grs.add_parser(
+        "construct", help="gradient-based portfolio construction "
+                          "against the served covariance")
+    _grad_common(grc)
+    grc.add_argument("solver", choices=("min_vol", "risk_parity", "hedge"),
+                     help="which solver to run over the portfolio rows")
+    gr.set_defaults(fn=_grad)
+
     args = ap.parse_args(argv)
     if getattr(args, "select_out", None) and args.select is None:
         ap.error("--select-out requires --select")
@@ -2527,7 +2774,7 @@ def main(argv=None):
     # subcommands that actually jit: the data-only paths (etl-*, report,
     # crosscheck) must not pay the jax import or touch the cache dir.
     if args.cmd in ("risk", "factors", "demo", "prepare", "pipeline",
-                    "alpha", "serve") \
+                    "alpha", "serve", "grad") \
             or (args.cmd == "scenario"
                 and getattr(args, "scmd", None) == "run"):
         from mfm_tpu.utils.cache import enable_persistent_compilation_cache

@@ -1,0 +1,42 @@
+"""Differentiable risk: the grad subsystem (docs/DIFFERENTIABLE.md).
+
+Three consumer surfaces, all ``jax.grad``/``jax.vjp`` through the SAME
+compiled composition the rest of the framework serves and audits —
+``scenario/kernel.py``'s stressed covariance, the grad-safe PSD gate, and
+``models/risk_model.py``'s pure portfolio vol:
+
+- :mod:`mfm_tpu.grad.reverse` — reverse stress testing: per-portfolio
+  projected gradient ascent over the ScenarioSpec shock space, "which
+  admissible shock hurts THIS book most".
+- :mod:`mfm_tpu.grad.construct` — gradient-based portfolio construction:
+  min-vol / risk-parity / hedge-overlay solvers on the simplex, surfaced
+  as ``construct`` request types on ``mfm-tpu serve``.
+- :mod:`mfm_tpu.grad.sensitivity` — exact ∂vol/∂shock and ∂vol/∂exposure
+  Jacobian rows (vjp, not finite differences), stamped into scenario
+  manifests and the ``mfm-tpu grad`` CLI.
+
+Device code lives in the three kernel modules (one donated jit each,
+registered as audited cells in analysis/registry.py); host orchestration
+and the atomic report writer live in :mod:`mfm_tpu.grad.engine` and
+:mod:`mfm_tpu.grad.report` (mfmlint R7 host-only barriers).
+"""
+
+from mfm_tpu.grad.construct import hedge_batch, minvol_batch, riskparity_batch
+from mfm_tpu.grad.engine import GradEngine, ShockBall
+from mfm_tpu.grad.report import (GRAD_REPORT_NAME, read_grad_report,
+                                 write_grad_report)
+from mfm_tpu.grad.reverse import reverse_stress_batch
+from mfm_tpu.grad.sensitivity import sensitivity_batch
+
+__all__ = [
+    "GradEngine",
+    "ShockBall",
+    "GRAD_REPORT_NAME",
+    "read_grad_report",
+    "write_grad_report",
+    "reverse_stress_batch",
+    "minvol_batch",
+    "riskparity_batch",
+    "hedge_batch",
+    "sensitivity_batch",
+]

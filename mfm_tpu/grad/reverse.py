@@ -1,0 +1,103 @@
+"""Reverse stress testing: the worst admissible shock per portfolio.
+
+Forward stress testing asks "what does scenario s do to my book"; reverse
+stress testing asks the adjoint question — "which admissible scenario
+hurts my book MOST".  Here the scenario space is the dense part of
+:class:`mfm_tpu.scenario.spec.ScenarioSpec` (per-factor vol shift/scale,
+the global vol-regime multiplier, the correlation stress beta) flattened
+into one shock vector
+
+    theta = [shift (K,) | scale (K,) | vol_mult | corr_beta]   # (2K + 2,)
+
+and the search is projected gradient ASCENT of the predicted portfolio
+vol through the real serving composition — ``stress_cov`` -> the
+grad-safe PSD gate ``psd_project`` -> ``portfolio_vol`` — subject to an
+admissibility box (the "shock ball", :class:`mfm_tpu.grad.engine.ShockBall`)
+and, implicitly, the PSD cone (the shocked matrix a lane reports is the
+POST-projection one, exactly what serving would use).
+
+The inner step is ``jax.grad`` of that composition; the loop is a
+fixed-iteration ``lax.fori_loop`` INSIDE one donated jit, vmapped over
+portfolios, with ``steps``/``step`` as traced operands — so the jit keys
+on the padded portfolio bucket only and the steady state holds <= 1
+compile per bucket (the serve/query.py discipline; enforced by
+``assert_max_compiles`` in bench and tests).
+
+Per-coordinate scaling: theta's coordinates live on wildly different
+scales (a vol shift of 0.01 is a big move, a vol_mult of 2.5 is routine),
+so the ascent direction is the L2-normalized gradient scaled by each
+coordinate's box width — a diagonal preconditioner that makes one step
+move every coordinate a comparable fraction of its admissible range.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mfm_tpu.models.risk_model import portfolio_vol
+from mfm_tpu.scenario.kernel import psd_project, stress_cov
+
+#: guard against 0/0 in the gradient normalization; bitwise-neutral next
+#: to any real gradient norm at f32 and keeps all-zero (pad) lanes at 0
+_TINY = 1e-30
+
+
+def stressed_vol(theta, cov, x):
+    """Predicted vol of exposure vector ``x`` under shock ``theta`` —
+    the scalar the ascent differentiates.  ``cov`` is a constant (the
+    base world); the PSD gate is the grad-safe form, so the value agrees
+    with the serving kernel's projection and the vjp stays finite."""
+    K = cov.shape[0]
+    cov_s = stress_cov(cov, theta[:K], theta[K:2 * K],
+                       theta[2 * K], theta[2 * K + 1])
+    cov_p, _, _ = psd_project(cov_s)
+    return portfolio_vol(cov_p, x)
+
+
+def _reverse_one(cov, x, theta0, lo, hi, step, steps):
+    """Projected gradient ascent for ONE portfolio; vmapped over the
+    batch by :func:`reverse_stress_batch`."""
+    width = hi - lo
+
+    def body(_, theta):
+        g = jax.grad(stressed_vol)(theta, cov, x)
+        # the eigh vjp is genuinely non-differentiable at repeated
+        # eigenvalues (heavily clipped correlations can reach them along
+        # the ascent path); a non-finite component would poison theta
+        # forever, so zero it — the projection step keeps us admissible
+        # and the next iterate re-evaluates a clean gradient
+        g = jnp.where(jnp.isfinite(g), g, jnp.zeros((), g.dtype))
+        dirn = g / (jnp.sqrt(jnp.sum(g * g)) + _TINY)
+        return jnp.clip(theta + step * width * dirn, lo, hi)
+
+    theta = lax.fori_loop(jnp.int32(0), steps, body, theta0)
+    vol0 = portfolio_vol(cov, x)
+    return theta, stressed_vol(theta, cov, x), vol0
+
+
+# theta0 is donated: the caller assembles a fresh (B, 2K+2) start per run
+# and the ascent retires it into theta_star of the same shape/dtype.  cov
+# / lo / hi are NOT donated — the host threads them unchanged into every
+# next call (the sim_covs pattern of models/risk_model.py).
+@partial(jax.jit, donate_argnums=(2,))
+def reverse_stress_batch(cov, xs, theta0, lo, hi, step, steps):
+    """Worst-case shock search for B portfolios in one compiled program.
+
+    Args:
+      cov: (K, K) base covariance (shared across lanes).
+      xs: (B, K) factor-exposure vectors (pad lanes all-zero).
+      theta0: (B, 2K+2) start shocks (the identity point, normally).
+      lo, hi: (2K+2,) admissibility box (``ShockBall.bounds``).
+      step: scalar ascent rate (fraction of box width per iteration).
+      steps: scalar i32 iteration count (traced — NOT a static, so every
+        bucket rung shares one cache entry per shape).
+
+    Returns ``(theta_star (B, 2K+2), vol_star (B,), vol0 (B,))``.
+    """
+    return jax.vmap(_reverse_one,
+                    in_axes=(None, 0, 0, None, None, None, None))(
+        cov, xs, theta0, lo, hi, step, steps)

@@ -1,0 +1,69 @@
+"""Exact sensitivity reports: one vjp, every Jacobian row at once.
+
+For each scenario lane the report wants the gradient of the predicted
+portfolio vol with respect to EVERY shock coordinate and every exposure —
+∂vol/∂shift (K,), ∂vol/∂scale (K,), ∂vol/∂vol_mult, ∂vol/∂corr_beta, and
+∂vol/∂x (K,).  vol is a scalar, so ONE reverse-mode pull-back through the
+serving composition (``stress_cov`` -> grad-safe ``psd_project`` ->
+``portfolio_vol``) yields all 3K + 2 numbers exactly — no finite
+differences, no truncation error, no 3K+2 forward re-evaluations (the
+host-side FD loop this subsystem replaces).
+
+The derivative is evaluated AT the spec's shock point: an identity lane
+reports the local gradient at the unshocked world ("which shock hurts
+most from here"), the single most-asked sensitivity.  The bitwise
+identity-passthrough discipline of ``scenario_batch`` is about served
+COVARIANCE bytes and does not apply to derivatives, so this kernel has
+no passthrough operand — rejected lanes are simply never stamped by the
+host layer (grad/engine.py).
+
+Non-finiteness: the eigh vjp divides by eigenvalue gaps, so a lane whose
+stressed matrix is exactly degenerate (e.g. a full correlation melt-up
+clipping many entries to +/-1) can report inf/NaN rows.  That is a true
+mathematical statement — the vol there is not differentiable — and the
+host layer records such rows as ``null`` with a ``nondifferentiable``
+flag rather than laundering them into numbers (the parity taxonomy in
+docs/DIFFERENTIABLE.md).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from mfm_tpu.models.risk_model import portfolio_vol
+from mfm_tpu.scenario.kernel import psd_project, stress_cov
+
+
+def _one_sens(cov, shift, scale, vol_mult, corr_beta, x):
+    def vol_of(shift, scale, vol_mult, corr_beta, x):
+        cov_s = stress_cov(cov, shift, scale, vol_mult, corr_beta)
+        cov_p, _, _ = psd_project(cov_s)
+        return portfolio_vol(cov_p, x)
+
+    vol, pull = jax.vjp(vol_of, shift, scale, vol_mult, corr_beta, x)
+    d_shift, d_scale, d_vm, d_cb, d_x = pull(jnp.ones((), vol.dtype))
+    return vol, d_shift, d_scale, d_vm, d_cb, d_x
+
+
+# shift/scale are donated: the engine densifies fresh (S, K) shock stacks
+# per run (scenario/engine.py's _shock_vectors) and the d_shift/d_scale
+# outputs alias them exactly.  base_cov is not — no (S, K, K) output
+# exists to retire it into.
+@partial(jax.jit, donate_argnums=(1, 2))
+def sensitivity_batch(base_cov, shift, scale, vol_mult, corr_beta, x):
+    """All sensitivity rows for S scenario lanes in one compiled program.
+
+    Args:
+      base_cov: (S, K, K) resolved base covariances per lane.
+      shift, scale: (S, K) densified shock vectors (donated).
+      vol_mult, corr_beta: (S,) scalar shocks per lane.
+      x: (K,) the portfolio's factor exposures (shared across lanes).
+
+    Returns ``(vol (S,), d_shift (S, K), d_scale (S, K), d_vol_mult (S,),
+    d_corr_beta (S,), d_x (S, K))``.
+    """
+    return jax.vmap(_one_sens, in_axes=(0, 0, 0, 0, 0, None))(
+        base_cov, shift, scale, vol_mult, corr_beta, x)

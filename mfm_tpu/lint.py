@@ -105,7 +105,13 @@ _R7_OBS_MODULES = ("mfm_tpu.utils.obs", "mfm_tpu.obs")
 # fully lintable)
 _R7_HOST_ONLY_MODULES = ("mfm_tpu.serve.server", "mfm_tpu.cli",
                          "mfm_tpu.scenario.engine",
-                         "mfm_tpu.scenario.manifest")
+                         "mfm_tpu.scenario.manifest",
+                         # grad host orchestration + report writer (the
+                         # grad DEVICE code lives in grad/reverse.py,
+                         # grad/construct.py, grad/sensitivity.py — all
+                         # fully lintable)
+                         "mfm_tpu.grad.engine",
+                         "mfm_tpu.grad.report")
 
 
 def _is_obs_module(module: str) -> bool:

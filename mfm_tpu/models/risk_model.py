@@ -763,6 +763,27 @@ class RiskModel:
         )
 
 
+def portfolio_vol(cov, x, w=None, specific_var=None):
+    """Predicted portfolio volatility — the pure scalar the grad subsystem
+    differentiates.
+
+    ``sqrt(x' F x [+ sum(w^2 s^2)])`` with ``x`` the (K,) factor-exposure
+    vector, ``F`` the (K, K) factor covariance, and the optional specific
+    leg from (N,) holdings ``w`` against (N,) specific variances.  Deliberately
+    un-jitted and closure-free: :mod:`mfm_tpu.grad` composes it under
+    ``jax.grad`` / ``jax.vjp`` / ``vmap`` inside its own donated jits, and the
+    serving path (serve/query.py) keeps its existing fused batch kernels.
+
+    Note the sqrt: its gradient is unbounded at vol == 0, which only occurs
+    for all-zero pad lanes — grad consumers pad with zero portfolios and trim
+    before anything reads those lanes (docs/DIFFERENTIABLE.md).
+    """
+    var = x @ (cov @ x)
+    if w is not None and specific_var is not None:
+        var = var + jnp.sum(w * w * specific_var)
+    return jnp.sqrt(var)
+
+
 # module-level so the compile cache is shared across RiskModel instances of
 # the same shape/config; RiskModelConfig is frozen-hashable by design
 # (config.py), making it a valid static argument.  The five panel operands

@@ -44,6 +44,75 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+
+
+def stress_cov(cov, shift, scale, vol_mult, corr_beta):
+    """Steps 1-4 of the lane math: the stressed covariance BEFORE the PSD
+    gate.  Shared by the serving kernel below and by the grad subsystem
+    (:mod:`mfm_tpu.grad`), and differentiable w.r.t. every SHOCK operand
+    (shift / scale / vol_mult / corr_beta).  ``cov`` is a constant under
+    every grad surface — the vol split divides by ``outer(sigma, sigma)``
+    inside a ``jnp.where``, which is only vjp-safe for cotangents that
+    never reach the base-covariance branch.
+
+    Args mirror :func:`_one_scenario`; returns ``cov_s (K, K)``.
+    """
+    dtype = cov.dtype
+    K = cov.shape[0]
+    eye = jnp.eye(K, dtype=dtype)
+    one = jnp.asarray(1.0, dtype)
+
+    var = jnp.diagonal(cov)
+    sigma = jnp.sqrt(jnp.maximum(var, 0))
+    denom = jnp.outer(sigma, sigma)
+    corr = jnp.where(denom > 0, cov / denom, jnp.zeros((), dtype))
+    corr = corr * (one - eye) + eye
+    corr_s = jnp.clip(corr * (one + corr_beta), -one, one)
+    corr_s = corr_s * (one - eye) + eye
+    sigma_s = jnp.maximum(sigma * scale + shift, 0) * vol_mult
+    return corr_s * jnp.outer(sigma_s, sigma_s)
+
+
+def psd_project(cov_s):
+    """Step 5, the gated PSD projection, in its GRAD-SAFE form.
+
+    Forward outputs are value-identical to the serving gate inlined in
+    :func:`_one_scenario` (same eigh primitive, same clamp floor, same
+    reconstruction — when the gate fires the eigh input is bitwise
+    ``cov_s``, when it doesn't the output IS ``cov_s``), but the gating is
+    restructured so reverse-mode AD through it stays finite:
+
+    - the gate value comes from ``eigvalsh(stop_gradient(cov_s))`` — the
+      gate is a DECISION, not a differentiable quantity, and eigh's vjp on
+      a matrix with (near-)repeated eigenvalues divides by ``w_i - w_j``;
+    - the eigh whose vectors rebuild the projection runs on
+      ``where(needs, cov_s, GENERIC)`` with GENERIC a fixed matrix with
+      well-separated eigenvalues (diag(1..K)), so when the projection is
+      NOT selected the zero cotangent flowing into the unselected branch
+      multiplies finite eigh-vjp factors instead of the inf/NaN a
+      degenerate ``cov_s`` would produce (the classic where-NaN trap).
+
+    The serving kernel keeps its single-eigh inline gate (this form costs
+    a second eigendecomposition — the gate eigh and the projection eigh —
+    which the forward-only hot path does not want to pay); the grad
+    subsystem composes THIS function.  tests/test_grad.py pins the
+    forward parity between the two.
+
+    Returns ``(cov_psd, needs, min_eig)`` exactly like the inline gate.
+    """
+    dtype = cov_s.dtype
+    K = cov_s.shape[0]
+    w_gate = jnp.linalg.eigvalsh(lax.stop_gradient(cov_s))
+    min_eig = w_gate[0]
+    needs = min_eig < 0
+    generic = jnp.diag(jnp.arange(1, K + 1, dtype=jnp.int32).astype(dtype))
+    w, V = jnp.linalg.eigh(jnp.where(needs, cov_s, generic))
+    floor = jnp.maximum(w[-1], 0) * (K * jnp.finfo(dtype).eps)
+    w_cl = jnp.maximum(w, floor)
+    proj = (V * w_cl) @ V.T
+    proj = 0.5 * (proj + proj.T)
+    return jnp.where(needs, proj, cov_s), needs, min_eig
 
 
 def _one_scenario(cov, shift, scale, vol_mult, corr_beta, passthrough):
@@ -65,18 +134,7 @@ def _one_scenario(cov, shift, scale, vol_mult, corr_beta, passthrough):
     """
     dtype = cov.dtype
     K = cov.shape[0]
-    eye = jnp.eye(K, dtype=dtype)
-    one = jnp.asarray(1.0, dtype)
-
-    var = jnp.diagonal(cov)
-    sigma = jnp.sqrt(jnp.maximum(var, 0))
-    denom = jnp.outer(sigma, sigma)
-    corr = jnp.where(denom > 0, cov / denom, jnp.zeros((), dtype))
-    corr = corr * (one - eye) + eye
-    corr_s = jnp.clip(corr * (one + corr_beta), -one, one)
-    corr_s = corr_s * (one - eye) + eye
-    sigma_s = jnp.maximum(sigma * scale + shift, 0) * vol_mult
-    cov_s = corr_s * jnp.outer(sigma_s, sigma_s)
+    cov_s = stress_cov(cov, shift, scale, vol_mult, corr_beta)
 
     # gated PSD projection.  The eigh runs unconditionally (the gate needs
     # min_eig and K is small); the clamp floor is a small RELATIVE floor —

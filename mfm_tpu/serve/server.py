@@ -58,6 +58,8 @@ REQ_REASON_UNKNOWN_FACTOR = 16   # dict weight key not in the engine's space
 REQ_REASON_UNKNOWN_BENCHMARK = 32
 REQ_REASON_WEIGHT_OUTLIER = 64   # |w - med| > mad_k * MAD (policy-gated)
 REQ_REASON_UNKNOWN_SCENARIO = 128  # scenario tag not in the served table
+REQ_REASON_BAD_CONSTRUCT = 256   # construct solver unknown / unsupported
+                                 # space / bad hedge factors or hmax
 
 _REQ_REASON_NAMES = (
     (REQ_REASON_SCHEMA, "schema"),
@@ -68,7 +70,13 @@ _REQ_REASON_NAMES = (
     (REQ_REASON_UNKNOWN_BENCHMARK, "unknown_benchmark"),
     (REQ_REASON_WEIGHT_OUTLIER, "weight_outlier"),
     (REQ_REASON_UNKNOWN_SCENARIO, "unknown_scenario"),
+    (REQ_REASON_BAD_CONSTRUCT, "bad_construct"),
 )
+
+#: construct request vocabulary (mfm_tpu/grad/construct.py solvers); the
+#: import is deferred to keep this host-only module's import cost flat —
+#: grad pulls the kernel modules in
+CONSTRUCT_SOLVERS = ("min_vol", "risk_parity", "hedge")
 
 
 def req_reason_names(mask: int) -> list[str]:
@@ -200,10 +208,10 @@ class CircuitBreaker:
 
 class _Request:
     __slots__ = ("rid", "weights", "bidx", "enq_t", "deadline_t", "scenario",
-                 "trace_id", "span")
+                 "trace_id", "span", "construct")
 
     def __init__(self, rid, weights, bidx, enq_t, deadline_t, scenario=None,
-                 trace_id=None, span=None):
+                 trace_id=None, span=None, construct=None):
         self.rid = rid
         self.weights = weights
         self.bidx = bidx
@@ -212,6 +220,7 @@ class _Request:
         self.scenario = scenario
         self.trace_id = trace_id
         self.span = span
+        self.construct = construct
 
 
 def _line_trace_id(line: str) -> str:
@@ -222,19 +231,70 @@ def _line_trace_id(line: str) -> str:
     return hashlib.sha256(line.encode("utf-8", "replace")).hexdigest()[:32]
 
 
+def _parse_construct(raw, engine):
+    """Decode + guard a request's ``construct`` block.  Accepts the string
+    shorthand (``"min_vol"``) or an object (``{"solver": "hedge",
+    "hedge_factors": [...], "hmax": 0.5}``).  Returns
+    ``(spec_dict_or_None, reason_bits, detail)`` — the spec dict is what
+    rides on the queued request into the drain-side solver dispatch."""
+    if isinstance(raw, str):
+        raw = {"solver": raw}
+    if not isinstance(raw, dict):
+        return None, REQ_REASON_BAD_CONSTRUCT, \
+            "construct must be a solver name or an object"
+    solver = raw.get("solver")
+    if solver not in CONSTRUCT_SOLVERS:
+        return None, REQ_REASON_BAD_CONSTRUCT, \
+            f"unknown construct solver {solver!r}; have " \
+            f"{list(CONSTRUCT_SOLVERS)}"
+    if engine.space != "factor":
+        return None, REQ_REASON_BAD_CONSTRUCT, \
+            "construction runs in factor space (engine serves " \
+            f"{engine.space!r})"
+    spec = {"solver": str(solver), "hedge_mask": None, "hmax": 1.0}
+    if solver == "hedge":
+        factors = raw.get("hedge_factors")
+        if factors is not None:
+            if not isinstance(factors, (list, tuple)) or not factors:
+                return None, REQ_REASON_BAD_CONSTRUCT, \
+                    "hedge_factors must be a non-empty list"
+            unknown = [str(f) for f in factors
+                       if str(f) not in engine.factor_index]
+            if unknown:
+                return None, REQ_REASON_BAD_CONSTRUCT, \
+                    f"hedge_factors outside the engine's space: " \
+                    f"{sorted(unknown)[:5]}"
+            mask_vec = np.zeros(engine.N, np.float64)
+            for f in factors:
+                mask_vec[engine.factor_index[str(f)]] = 1.0
+            spec["hedge_mask"] = mask_vec
+        try:
+            hmax = float(raw.get("hmax", 1.0))
+            if not (np.isfinite(hmax) and hmax > 0):
+                raise ValueError(hmax)
+        except (TypeError, ValueError):
+            return None, REQ_REASON_BAD_CONSTRUCT, \
+                f"bad hmax {raw.get('hmax')!r} (need finite > 0)"
+        spec["hmax"] = hmax
+    return spec, 0, ""
+
+
 def parse_request(line: str, engine, policy: ServePolicy, scenarios=None):
     """Decode + guard one JSONL request.
 
     Returns ``(fields_or_None, reason_mask, detail)``: a zero mask means
     the request is admissible and ``fields`` is ``(rid, weights (D,)
     float, bidx int, deadline_s float, scenario str|None, trace_id
-    str|None)``; a nonzero mask means dead-letter (``detail`` says what
-    tripped, ``rid`` may still be recoverable and is returned inside
-    ``detail``-bearing fields as None).  ``trace_id`` is the caller's own
-    when the request JSON carries one, else None (the server derives a
-    deterministic one at admission).  ``scenarios``: the served scenario
-    table (names only are consulted); a ``scenario`` tag outside it —
-    including ANY tag when no table is served — is ``unknown_scenario``.
+    str|None, construct dict|None)``; a nonzero mask means dead-letter
+    (``detail`` says what tripped, ``rid`` may still be recoverable and
+    is returned inside ``detail``-bearing fields as None).  ``trace_id``
+    is the caller's own when the request JSON carries one, else None (the
+    server derives a deterministic one at admission).  ``scenarios``: the
+    served scenario table (names only are consulted); a ``scenario`` tag
+    outside it — including ANY tag when no table is served — is
+    ``unknown_scenario``.  ``construct`` asks for a portfolio-construction
+    solve instead of a risk query (the weights become the warm start /
+    base book); :func:`_parse_construct` guards its vocabulary.
     """
     mask = 0
     rid = None
@@ -253,8 +313,8 @@ def parse_request(line: str, engine, policy: ServePolicy, scenarios=None):
         trace_id = str(trace_id)
     raw_w = obj.get("weights")
     if raw_w is None:
-        return (rid, None, 0, 0.0, scenario, trace_id), REQ_REASON_SCHEMA, \
-            "missing 'weights'"
+        return (rid, None, 0, 0.0, scenario, trace_id, None), \
+            REQ_REASON_SCHEMA, "missing 'weights'"
 
     detail = ""
     if scenario is not None and scenario not in (scenarios or {}):
@@ -262,6 +322,13 @@ def parse_request(line: str, engine, policy: ServePolicy, scenarios=None):
         have = sorted(scenarios) if scenarios else []
         detail = f"unknown scenario {scenario!r} (serving " \
             f"{have[:5] if have else 'no scenario table'})"
+    construct = None
+    raw_c = obj.get("construct")
+    if raw_c is not None:
+        construct, c_bits, c_detail = _parse_construct(raw_c, engine)
+        if c_bits:
+            mask |= c_bits
+            detail = detail or c_detail
     if isinstance(raw_w, dict):
         # name-keyed weights: map onto the engine's own axis order.  In
         # factor space the keys are factor names; in stock space stock ids.
@@ -269,7 +336,7 @@ def parse_request(line: str, engine, policy: ServePolicy, scenarios=None):
                  else engine.factor_names if engine.space == "factor"
                  else None)
         if names is None:
-            return (rid, None, 0, 0.0, scenario, trace_id), \
+            return (rid, None, 0, 0.0, scenario, trace_id, None), \
                 REQ_REASON_SCHEMA, \
                 "dict weights need a named axis (engine has no stock ids)"
         index = (engine.factor_index if engine.space == "factor"
@@ -337,7 +404,8 @@ def parse_request(line: str, engine, policy: ServePolicy, scenarios=None):
         mask |= REQ_REASON_SCHEMA
         detail = detail or f"bad deadline_s {obj.get('deadline_s')!r}"
         deadline_s = policy.default_deadline_s
-    return (rid, w, bidx, deadline_s, scenario, trace_id), int(mask), detail
+    return (rid, w, bidx, deadline_s, scenario, trace_id, construct), \
+        int(mask), detail
 
 
 class QueryServer:
@@ -465,7 +533,7 @@ class QueryServer:
                                  "reasons": req_reason_names(mask),
                                  "detail": detail}, scenario_id=scen,
                                 trace_id=tid)]
-        rid, w, bidx, deadline_s, scen, tid = fields
+        rid, w, bidx, deadline_s, scen, tid, construct = fields
         if tid is None:
             tid = _line_trace_id(line)
         now = self._clock()
@@ -474,7 +542,8 @@ class QueryServer:
         sp = _trace.start_span("serve.request", trace_id=tid, parent_id=None,
                                request_id=rid, scenario=scen)
         self._queue.append(_Request(rid, w, bidx, now, now + deadline_s,
-                                    scenario=scen, trace_id=tid, span=sp))
+                                    scenario=scen, trace_id=tid, span=sp,
+                                    construct=construct))
         # bounded queue: shedding drops the OLDEST queued work first —
         # under overload the head of the queue is the request whose
         # deadline is nearest death; the freshest work is the most useful
@@ -553,58 +622,140 @@ class QueryServer:
                          "detail": f"scenario {scen!r} no longer served"},
                         scenario_id=scen, trace_id=r.trace_id))
                 continue
-            W = np.stack([r.weights for r in grp]).astype(engine.dtype)
-            bench = [r.bidx for r in grp]
-            # batch-execution child span: joins the first member's trace as
-            # a child of its request span; every member's trace_id rides in
-            # args (capped) so any slow request can be joined to its batch
-            head = grp[0]
-            bsp = _trace.start_span(
-                "serve.batch", trace_id=head.trace_id,
-                parent_id=(head.span.span_id if head.span else None),
-                batch=self._batch_i, scenario=scen, n=len(grp),
-                trace_ids=[r.trace_id for r in grp[:32]])
-            t0 = time.perf_counter()
-            try:
-                res = engine.query(W, bench=bench)
-            except Exception as e:   # noqa: BLE001 — any batch failure trips
-                _trace.end_span(bsp, outcome="error")
-                self.breaker.record_failure()
-                for r in grp:
-                    _obs.record_query_outcome("error")
-                    if r.span is not None:
-                        _trace.end_span(r.span, outcome="error")
-                    out.append(self._stamp({"id": r.rid, "ok": False,
-                                            "outcome": "error",
-                                            "detail": str(e)[:500]},
-                                           scenario_id=scen, engine=engine,
-                                           trace_id=r.trace_id))
-                continue
-            dt = time.perf_counter() - t0
-            _trace.end_span(bsp, outcome="ok")
-            self.breaker.record_success()
-            _obs.record_query_batch(len(grp), dt)
-            done = self._clock()
-            for i, r in enumerate(grp):
-                _obs.record_query_outcome("ok")
-                _obs.record_query_latency(max(0.0, done - r.enq_t))
-                if r.span is not None:
-                    _trace.end_span(r.span, outcome="ok",
-                                    batch=self._batch_i)
-                resp = {"id": r.rid, "ok": True, "outcome": "ok",
-                        "total_vol": float(res.total_vol[i]),
-                        "factor_var": float(res.factor_var[i]),
-                        "specific_var": float(res.specific_var[i]),
-                        "contribution": np.asarray(
-                            res.contribution[i]).tolist(),
-                        "marginal": np.asarray(res.marginal[i]).tolist()}
-                if r.bidx > 0:
-                    resp["active_risk"] = float(res.active_risk[i])
-                    resp["beta"] = float(res.beta[i])
-                out.append(self._stamp(resp, scenario_id=scen,
-                                       engine=engine, trace_id=r.trace_id))
+            # split risk queries from construction solves: the query
+            # sub-batch runs the exact pre-construct path (one stack, one
+            # engine.query — untagged risk traffic stays bitwise-identical),
+            # each (solver, hmax) construct sub-batch runs its own donated
+            # grad kernel against the SAME engine's covariance (so
+            # scenario-tagged construction solves against the stressed world)
+            qgrp = [r for r in grp if r.construct is None]
+            cgrps: dict = {}
+            for r in grp:
+                if r.construct is not None:
+                    key = (r.construct["solver"], r.construct["hmax"])
+                    cgrps.setdefault(key, []).append(r)
+            if qgrp:
+                out.extend(self._drain_query(engine, scen, qgrp))
+            for (solver, hmax), cg in cgrps.items():
+                out.extend(self._drain_construct(engine, scen, solver,
+                                                 hmax, cg))
         chaos_point("serve.after_batch", f"batch{self._batch_i}")
         self._batch_i += 1
+        return out
+
+    def _drain_query(self, engine, scen, grp) -> list[dict]:
+        """Answer one scenario group's risk queries in ONE device batch."""
+        out = []
+        W = np.stack([r.weights for r in grp]).astype(engine.dtype)
+        bench = [r.bidx for r in grp]
+        # batch-execution child span: joins the first member's trace as
+        # a child of its request span; every member's trace_id rides in
+        # args (capped) so any slow request can be joined to its batch
+        head = grp[0]
+        bsp = _trace.start_span(
+            "serve.batch", trace_id=head.trace_id,
+            parent_id=(head.span.span_id if head.span else None),
+            batch=self._batch_i, scenario=scen, n=len(grp),
+            trace_ids=[r.trace_id for r in grp[:32]])
+        t0 = time.perf_counter()
+        try:
+            res = engine.query(W, bench=bench)
+        except Exception as e:   # noqa: BLE001 — any batch failure trips
+            _trace.end_span(bsp, outcome="error")
+            self.breaker.record_failure()
+            for r in grp:
+                _obs.record_query_outcome("error")
+                if r.span is not None:
+                    _trace.end_span(r.span, outcome="error")
+                out.append(self._stamp({"id": r.rid, "ok": False,
+                                        "outcome": "error",
+                                        "detail": str(e)[:500]},
+                                       scenario_id=scen, engine=engine,
+                                       trace_id=r.trace_id))
+            return out
+        dt = time.perf_counter() - t0
+        _trace.end_span(bsp, outcome="ok")
+        self.breaker.record_success()
+        _obs.record_query_batch(len(grp), dt)
+        done = self._clock()
+        for i, r in enumerate(grp):
+            _obs.record_query_outcome("ok")
+            _obs.record_query_latency(max(0.0, done - r.enq_t))
+            if r.span is not None:
+                _trace.end_span(r.span, outcome="ok",
+                                batch=self._batch_i)
+            resp = {"id": r.rid, "ok": True, "outcome": "ok",
+                    "total_vol": float(res.total_vol[i]),
+                    "factor_var": float(res.factor_var[i]),
+                    "specific_var": float(res.specific_var[i]),
+                    "contribution": np.asarray(
+                        res.contribution[i]).tolist(),
+                    "marginal": np.asarray(res.marginal[i]).tolist()}
+            if r.bidx > 0:
+                resp["active_risk"] = float(res.active_risk[i])
+                resp["beta"] = float(res.beta[i])
+            out.append(self._stamp(resp, scenario_id=scen,
+                                   engine=engine, trace_id=r.trace_id))
+        return out
+
+    def _drain_construct(self, engine, scen, solver, hmax, grp) -> list[dict]:
+        """Answer one (solver, hmax) construct sub-batch in ONE donated
+        jit call (the grad/construct.py kernels, padded to the portfolio
+        bucket — <= 1 compile per (solver, bucket) in steady state), with
+        the query path's breaker / outcome / span semantics."""
+        from mfm_tpu.grad.engine import GradEngine
+        out = []
+        head = grp[0]
+        bsp = _trace.start_span(
+            "serve.construct", trace_id=head.trace_id,
+            parent_id=(head.span.span_id if head.span else None),
+            batch=self._batch_i, scenario=scen, solver=solver, n=len(grp),
+            trace_ids=[r.trace_id for r in grp[:32]])
+        t0 = time.perf_counter()
+        try:
+            ge = GradEngine(np.asarray(engine._cov),
+                            factor_names=engine.factor_names,
+                            staleness=engine.staleness, dtype=engine.dtype)
+            W = np.stack([r.weights for r in grp]).astype(engine.dtype)
+            hmask = None
+            if solver == "hedge":
+                hmask = np.stack([
+                    r.construct["hedge_mask"]
+                    if r.construct["hedge_mask"] is not None
+                    else np.ones(ge.K) for r in grp]).astype(engine.dtype)
+            res = ge.construct_solve(solver, W, hedge_mask=hmask, hmax=hmax)
+        except Exception as e:   # noqa: BLE001 — any batch failure trips
+            _trace.end_span(bsp, outcome="error")
+            self.breaker.record_failure()
+            for r in grp:
+                _obs.record_query_outcome("error")
+                if r.span is not None:
+                    _trace.end_span(r.span, outcome="error")
+                out.append(self._stamp({"id": r.rid, "ok": False,
+                                        "outcome": "error",
+                                        "kind": "construct",
+                                        "detail": str(e)[:500]},
+                                       scenario_id=scen, engine=engine,
+                                       trace_id=r.trace_id))
+            return out
+        dt = time.perf_counter() - t0
+        _trace.end_span(bsp, outcome="ok")
+        self.breaker.record_success()
+        _obs.record_query_batch(len(grp), dt)
+        done = self._clock()
+        for i, r in enumerate(grp):
+            _obs.record_query_outcome("ok")
+            _obs.record_query_latency(max(0.0, done - r.enq_t))
+            if r.span is not None:
+                _trace.end_span(r.span, outcome="ok", batch=self._batch_i)
+            resp = {"id": r.rid, "ok": True, "outcome": "ok",
+                    "kind": "construct", "solver": solver,
+                    "weights": np.asarray(res["weights"][i]).tolist(),
+                    "total_vol": float(res["vols"][i])}
+            diag = np.asarray(res["diag"][i])
+            resp["diag"] = diag.tolist() if diag.ndim else float(diag)
+            out.append(self._stamp(resp, scenario_id=scen, engine=engine,
+                                   trace_id=r.trace_id))
         return out
 
     # -- the loop ------------------------------------------------------------

@@ -54,6 +54,8 @@ CRASH_POINTS = (
                                     # not yet renamed (scenario/manifest.py)
     "trace.after_tmp",             # Chrome-trace flush: tmp durable, final
                                    # trace.json not yet renamed (obs/trace.py)
+    "grad_report.after_tmp",       # grad solve done, grad_report.json tmp
+                                   # not yet renamed (grad/report.py)
 )
 
 
@@ -206,7 +208,7 @@ class FaultPlan:
                      # query_kill | query_poison | query_overflow |
                      # query_swap | query_steady | scenario_kill |
                      # scenario_poison | trace_kill | eigen_kill |
-                     # shard_kill
+                     # shard_kill | grad_kill
     seed: int = 0
     params: tuple = ()   # ((key, value), ...) — hashable, printable
 
@@ -275,4 +277,9 @@ def plan_suite(seed: int = 0) -> tuple:
         # disk and the replay lands bitwise on the fault-free run
         FaultPlan("shard-kill-mid-append", "shard_kill", s + 20,
                   (("point", "save_artifact.after_tmp"), ("mesh", "2x2"))),
+        # differentiable risk (mfm_tpu/grad/): SIGKILL between the grad
+        # report's tmp write and its rename — no torn grad_report.json,
+        # checkpoint bytes untouched, clean re-run doctor-green
+        FaultPlan("grad-kill-mid-solve", "grad_kill", s + 21,
+                  (("point", "grad_report.after_tmp"),)),
     )

@@ -194,7 +194,7 @@ def test_plan_suite_is_deterministic():
                                    "query_swap", "query_steady",
                                    "scenario_kill", "scenario_poison",
                                    "trace_kill", "eigen_kill",
-                                   "shard_kill"}
+                                   "shard_kill", "grad_kill"}
     assert len({p.seed for p in a}) == len(a)
 
 

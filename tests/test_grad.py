@@ -1,0 +1,491 @@
+"""Differentiable-risk subsystem (mfm_tpu/grad): analytic sensitivities
+vs central differences at f64, bitwise batch-of-B == B-singles across a
+bucket boundary for every grad kernel, closed-form solver anchors
+(2-asset min-vol KKT, 1/sigma risk parity), forward parity of the
+grad-safe PSD gate against the serving kernel's inline gate, reverse-
+stress admissibility + preset dominance, and the serve-side construct
+request surface (guards, dead-lettering, <= 1 compile per bucket)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mfm_tpu.grad.construct import hedge_batch, minvol_batch, riskparity_batch
+from mfm_tpu.grad.engine import (
+    HEDGE_ETA,
+    HEDGE_STEPS,
+    MINVOL_ETA,
+    MINVOL_STEPS,
+    RISKPARITY_ETA,
+    RISKPARITY_STEPS,
+    GradEngine,
+    ShockBall,
+)
+from mfm_tpu.grad.reverse import reverse_stress_batch
+from mfm_tpu.grad.sensitivity import sensitivity_batch
+from mfm_tpu.models.risk_model import portfolio_vol
+from mfm_tpu.scenario.kernel import _one_scenario, psd_project, stress_cov
+from mfm_tpu.scenario.spec import PRESETS, ScenarioSpec
+from mfm_tpu.utils.contracts import assert_max_compiles
+
+K = 6
+
+
+def _cov(K=K, seed=0):
+    """The bench/test covariance recipe: well-conditioned, vol ~1e-2."""
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((K, K)) / np.sqrt(K)
+    return (a @ a.T + 1e-3 * np.eye(K)) * 1e-4
+
+
+def _pad(rows, B, K=K):
+    out = np.zeros((B, K))
+    out[:len(rows)] = rows
+    return out
+
+
+# -- PSD-gate forward parity --------------------------------------------------
+# psd_project is the grad-safe twin of the single-eigh gate inlined in
+# _one_scenario (kernel.py's docstring points here).  The two must stay
+# value-identical on BOTH gate branches, or a sensitivity would describe
+# a different matrix than the one serving answers from.
+
+@pytest.mark.parametrize("corr_beta,expect_fired", [
+    (0.0, False),    # untouched world: gate closed, output IS the input
+    (0.9, True),     # corr melt-up clips off-diagonals -> indefinite
+])
+def test_psd_gate_forward_parity(corr_beta, expect_fired):
+    cov = jnp.array(_cov())
+    shift = jnp.zeros(K)
+    scale = jnp.ones(K)
+    vm = jnp.asarray(1.3)
+    cb = jnp.asarray(corr_beta)
+
+    cov_s = stress_cov(cov, shift, scale, vm, cb)
+    grad_cov, grad_needs, grad_min = psd_project(cov_s)
+    serve_cov, serve_needs, serve_min = _one_scenario(
+        cov, shift, scale, vm, cb, jnp.asarray(False))
+
+    assert bool(grad_needs) == bool(serve_needs) == expect_fired
+    assert np.array_equal(np.asarray(grad_cov), np.asarray(serve_cov))
+    assert float(grad_min) == float(serve_min)
+    if not expect_fired:
+        # gate closed: the output is the stressed matrix itself, bitwise
+        assert np.array_equal(np.asarray(grad_cov), np.asarray(cov_s))
+    else:
+        lam = np.linalg.eigvalsh(np.asarray(grad_cov, np.float64))
+        assert float(serve_min) < 0       # the gate had a reason to fire
+        assert lam[0] >= -K * np.finfo(np.float64).eps * lam[-1]
+
+
+# -- analytic sensitivities vs central differences ----------------------------
+
+def test_sensitivity_rows_match_central_differences():
+    """Every Jacobian block of one vjp pull-back — ∂vol/∂shift, ∂scale,
+    ∂vol_mult, ∂corr_beta, ∂exposure — against central differences of the
+    same forward composition at f64 (conftest enables x64).  The chosen
+    point FIRES the projection gate, so this also proves the grad-safe
+    gate differentiates the projected branch correctly."""
+    K4 = 4
+    cov = _cov(K4, seed=0)
+    shift = np.array([0.002, -0.001, 0.0005, 0.00025])
+    scale = np.array([1.1, 0.9, 1.05, 1.0])
+    vm, cb = 1.5, 0.3
+    x = np.array([0.3, -0.2, 0.5, 0.1])
+
+    def vol_of(sh, sc, m, b, xx):
+        cov_s = stress_cov(jnp.array(cov), jnp.array(sh), jnp.array(sc),
+                           jnp.asarray(m), jnp.asarray(b))
+        cov_p, _, _ = psd_project(cov_s)
+        return float(portfolio_vol(cov_p, jnp.array(xx)))
+
+    vol, d_shift, d_scale, d_vm, d_cb, d_x = [
+        np.asarray(o) for o in sensitivity_batch(
+            jnp.array(cov)[None], jnp.array(shift)[None],
+            jnp.array(scale)[None], jnp.asarray([vm]), jnp.asarray([cb]),
+            jnp.array(x))]
+    assert vol[0] == pytest.approx(vol_of(shift, scale, vm, cb, x))
+
+    h = 1e-6
+    for j in range(K4):
+        e = np.zeros(K4)
+        e[j] = h
+        fd = (vol_of(shift + e, scale, vm, cb, x)
+              - vol_of(shift - e, scale, vm, cb, x)) / (2 * h)
+        assert d_shift[0, j] == pytest.approx(fd, rel=1e-6, abs=1e-9)
+        fd = (vol_of(shift, scale + e, vm, cb, x)
+              - vol_of(shift, scale - e, vm, cb, x)) / (2 * h)
+        assert d_scale[0, j] == pytest.approx(fd, rel=1e-6, abs=1e-9)
+        fd = (vol_of(shift, scale, vm, cb, x + e)
+              - vol_of(shift, scale, vm, cb, x - e)) / (2 * h)
+        assert d_x[0, j] == pytest.approx(fd, rel=1e-6, abs=1e-9)
+    fd = (vol_of(shift, scale, vm + h, cb, x)
+          - vol_of(shift, scale, vm - h, cb, x)) / (2 * h)
+    assert d_vm[0] == pytest.approx(fd, rel=1e-6, abs=1e-9)
+    fd = (vol_of(shift, scale, vm, cb + h, x)
+          - vol_of(shift, scale, vm, cb - h, x)) / (2 * h)
+    assert d_cb[0] == pytest.approx(fd, rel=1e-6, abs=1e-9)
+
+
+def test_engine_sensitivity_entries():
+    """Host-layer contract: ok lanes carry name-keyed Jacobian rows,
+    rejected specs carry problems and NO rows, identity lanes report the
+    local gradient at the unshocked world."""
+    names = [f"f{i}" for i in range(K)]
+    eng = GradEngine(_cov(), factor_names=names)
+    x = np.linspace(0.1, 0.6, K)
+    specs = [ScenarioSpec.identity(),
+             PRESETS["crash-2015-analog"],
+             ScenarioSpec(name="bogus", shift=(("nope", 0.01),))]
+    ident, crash, bogus = eng.sensitivities(specs, x)
+
+    assert ident["status"] == "ok" and not ident["problems"]
+    assert set(ident["d_shift"]) == set(names)
+    assert ident["vol"] == pytest.approx(
+        float(portfolio_vol(jnp.array(eng.cov), jnp.array(x))))
+    # at the identity point ∂vol/∂vol_mult is the vol itself
+    # (vol scales linearly in vol_mult: d(vm * vol)/d vm at vm=1)
+    assert ident["d_vol_mult"] == pytest.approx(ident["vol"], rel=1e-6)
+
+    assert crash["status"] == "ok"
+    assert crash["vol"] > ident["vol"]     # the drill is a stress
+
+    assert bogus["status"] == "rejected" and bogus["problems"]
+    assert "d_shift" not in bogus
+
+
+# -- reverse stress testing ---------------------------------------------------
+
+def test_reverse_batch_equals_singles_across_bucket_boundary():
+    """Batch-of-9 at bucket 32 == 9 singles at bucket 8, bitwise — the
+    scenario kernel's lane-isolation anchor re-proven for the ascent
+    (nothing contracts across the batch axis; pad lanes are frozen by the
+    isfinite guard)."""
+    eng = GradEngine(_cov(), factor_names=[f"f{i}" for i in range(K)])
+    rng = np.random.default_rng(1)
+    W = rng.standard_normal((9, K)) * 0.4
+    labels = [f"x{i}" for i in range(9)]
+
+    batch = eng.reverse_stress(W, bucket=32, steps=60, labels=labels)
+    for i in range(9):
+        single, = eng.reverse_stress(W[i:i + 1], bucket=8, steps=60,
+                                     labels=[labels[i]])
+        assert single == batch[i], f"lane {i} diverged from its solo run"
+
+
+def test_reverse_worst_case_admissible_and_dominates_presets():
+    """The worst shock the ascent returns must (a) sit inside the ball,
+    round-trip to a valid ScenarioSpec and keep the stressed matrix PSD
+    (the ``admissible`` flag), and (b) report at least as much vol as
+    every preset drill — the ball CONTAINS the whole preset catalog, so a
+    weaker answer would mean the search missed an admissible point the
+    desk already knows about."""
+    names = [f"f{i}" for i in range(K)]
+    cov = _cov()
+    eng = GradEngine(cov, factor_names=names)
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal(K) * 0.4
+
+    entry, = eng.reverse_stress(x[None])   # default ball, default steps
+    assert entry["admissible"]
+    assert entry["vol_worst"] >= entry["vol_base"]
+    assert entry["vol_delta"] == pytest.approx(
+        entry["vol_worst"] - entry["vol_base"])
+
+    # the answer is REPLAYABLE: the spec round-trips through the forward
+    # scenario path to the same worst-case vol
+    from mfm_tpu.scenario.engine import ScenarioEngine
+    scen = ScenarioEngine(cov, factor_names=names)
+    results = scen.run([ScenarioSpec.from_dict(entry["spec"])]
+                       + [PRESETS[n] for n in sorted(PRESETS)])
+    replay, presets = results[0], results[1:]
+    assert replay.status == "ok"
+    assert float(portfolio_vol(jnp.array(replay.cov), jnp.array(x))) == \
+        pytest.approx(entry["vol_worst"], rel=1e-6)
+    for r in presets:
+        preset_vol = float(portfolio_vol(jnp.array(r.cov), jnp.array(x)))
+        assert entry["vol_worst"] >= preset_vol * (1 - 1e-9), r.spec.name
+
+
+def test_reverse_respects_a_tighter_ball():
+    """Shrinking the ball shrinks the answer: the box is a real
+    constraint, not a suggestion."""
+    eng = GradEngine(_cov(), factor_names=[f"f{i}" for i in range(K)])
+    x = np.linspace(-0.3, 0.5, K)
+    tight = ShockBall(shift_max=0.001, scale_range=0.1,
+                      vol_mult_hi=1.5, corr_beta_hi=0.2)
+    wide, = eng.reverse_stress(x[None], steps=60)
+    small, = eng.reverse_stress(x[None], ball=tight, steps=60)
+    assert small["admissible"]
+    assert tight.contains(
+        np.concatenate([
+            [dict(small["spec"]["shift"]).get(f, 0.0)
+             for f in eng.factor_names],
+            [dict(small["spec"]["scale"]).get(f, 1.0)
+             for f in eng.factor_names],
+            [small["spec"]["vol_mult"], small["spec"]["corr_beta"]]]), K)
+    assert small["vol_worst"] < wide["vol_worst"]
+
+
+# -- portfolio construction ---------------------------------------------------
+
+def test_minvol_matches_closed_form_two_asset():
+    """With two assets and no binding box, the min-vol weight has the
+    closed form x1* = (F22 - F12) / (F11 + F22 - 2 F12); the KKT
+    stationarity residual at the solution must be ~0."""
+    F = np.array([[4.0, 0.5], [0.5, 1.0]]) * 1e-4
+    star = (F[1, 1] - F[0, 1]) / (F[0, 0] + F[1, 1] - 2 * F[0, 1])
+    x, vol, kkt = minvol_batch(
+        jnp.array(np.full((1, 2), 0.5)), jnp.array(F),
+        jnp.zeros(2), jnp.ones(2),
+        jnp.asarray(MINVOL_ETA), jnp.int32(MINVOL_STEPS))
+    x = np.asarray(x)[0]
+    assert x[0] == pytest.approx(star, abs=1e-6)
+    assert x[1] == pytest.approx(1 - star, abs=1e-6)
+    assert float(kkt[0]) < 1e-6
+    assert float(vol[0]) == pytest.approx(
+        float(np.sqrt(x @ F @ x)), rel=1e-12)
+
+
+def test_minvol_kkt_residual_small_at_k6():
+    eng = GradEngine(_cov(), factor_names=[f"f{i}" for i in range(K)])
+    res = eng.construct_solve("min_vol", np.full((3, K), 1.0 / K))
+    assert res["weights"].shape == (3, K)
+    np.testing.assert_allclose(res["weights"].sum(axis=1), 1.0, rtol=1e-9)
+    assert np.all(res["weights"] >= 0)
+    assert np.all(res["diag"] < 1e-3)      # ISSUE acceptance: KKT at tol
+
+
+def test_riskparity_equalizes_contributions():
+    # diagonal 2-asset: exact closed form x ∝ 1/σ
+    D = np.diag([4e-4, 1e-4])
+    x, _, spread = riskparity_batch(
+        jnp.array(np.full((1, 2), 0.5)), jnp.array(D),
+        jnp.asarray(RISKPARITY_ETA), jnp.int32(RISKPARITY_STEPS))
+    np.testing.assert_allclose(np.asarray(x)[0], [1 / 3, 2 / 3], atol=1e-9)
+    assert float(spread[0]) < 1e-9
+    # dense K=6: every risk contribution equal to machine-ish tolerance
+    cov = _cov()
+    x, _, spread = riskparity_batch(
+        jnp.array(np.full((1, K), 1.0 / K)), jnp.array(cov),
+        jnp.asarray(RISKPARITY_ETA), jnp.int32(RISKPARITY_STEPS))
+    x = np.asarray(x)[0]
+    rc = x * (cov @ x)
+    assert rc.max() - rc.min() < 1e-8 * rc.mean()
+    assert float(spread[0]) < 1e-6
+
+
+def _minvol_reference(cov):
+    """Exact min-vol on the simplex (no binding upper box) by active-set
+    elimination: solve the equality-constrained QP on the support, drop
+    the most negative weight, repeat until feasible."""
+    n = cov.shape[0]
+    act = np.ones(n, bool)
+    for _ in range(n):
+        kc = int(act.sum())
+        A = np.zeros((kc + 1, kc + 1))
+        A[:kc, :kc] = 2.0 * cov[np.ix_(act, act)]
+        A[:kc, kc] = 1.0
+        A[kc, :kc] = 1.0
+        b = np.zeros(kc + 1)
+        b[kc] = 1.0
+        xs = np.linalg.solve(A, b)[:kc]
+        if (xs >= -1e-12).all():
+            x = np.zeros(n)
+            x[act] = np.clip(xs, 0.0, None)
+            return x
+        act[np.where(act)[0][int(xs.argmin())]] = False
+    raise AssertionError("active-set elimination did not terminate")
+
+
+def test_minvol_converges_on_negative_correlation_cov():
+    """Regression for the constant-step limit cycle.  On a covariance
+    with strongly negative correlations the marginals (F x)_i change
+    sign across coordinates, the max-normalized gradient never vanishes,
+    and a constant EG step orbits the optimum in a period-2 cycle
+    instead of converging (observed on a real fitted checkpoint: 44%
+    excess vol, KKT diag ~9).  The annealed schedule must land on the
+    active-set optimum."""
+    corr = np.array([[1.0, -0.9, -0.2, 0.3],
+                     [-0.9, 1.0, 0.1, -0.4],
+                     [-0.2, 0.1, 1.0, -0.6],
+                     [0.3, -0.4, -0.6, 1.0]])
+    sig = np.array([0.02, 0.025, 0.015, 0.03])
+    cov = corr * np.outer(sig, sig)
+    assert (cov @ np.full(4, 0.25) < 0).any()   # the regime under test
+    ref = _minvol_reference(cov)
+
+    x, vol, kkt = minvol_batch(
+        jnp.array(np.full((1, 4), 0.25)), jnp.array(cov),
+        jnp.zeros(4), jnp.ones(4),
+        jnp.asarray(MINVOL_ETA), jnp.int32(MINVOL_STEPS))
+    x = np.asarray(x)[0]
+    np.testing.assert_allclose(x, ref, atol=1e-8)
+    assert float(vol[0]) == pytest.approx(
+        float(np.sqrt(ref @ cov @ ref)), rel=1e-10)
+    assert float(kkt[0]) < 1e-8
+
+
+def test_hedge_reduces_vol_and_respects_mask_and_box():
+    cov = _cov()
+    rng = np.random.default_rng(3)
+    x0 = rng.standard_normal(K) * 0.3
+    mask = np.array([1.0, 1.0, 0.0, 0.0, 1.0, 0.0])
+    hmax = 0.25
+    xt, h, vol = hedge_batch(
+        jnp.array(_pad(x0[None], 8)), jnp.array(np.zeros((8, K))),
+        jnp.array(cov), jnp.array(_pad(mask[None], 8)),
+        jnp.asarray(hmax), jnp.asarray(HEDGE_ETA), jnp.int32(HEDGE_STEPS))
+    xt = np.asarray(xt)[0]
+    h = np.asarray(h)[0]
+    base_vol = float(portfolio_vol(jnp.array(cov), jnp.array(x0)))
+    assert float(vol[0]) < base_vol        # the overlay is a hedge
+    assert np.all(h[mask == 0] == 0)       # unhedgeable factors untouched
+    assert np.all(np.abs(h) <= hmax + 1e-12)
+    np.testing.assert_array_equal(xt[mask == 0], x0[mask == 0])
+
+
+@pytest.mark.parametrize("solver", ["min_vol", "risk_parity", "hedge"])
+def test_construct_batch_equals_singles_bitwise(solver):
+    """Batch-of-9 at bucket 32 == 9 singles at bucket 8 for every solver
+    kernel, and all-zero pad lanes stay EXACTLY zero (construct.py's
+    pad-lane isolation contract)."""
+    cov = jnp.array(_cov())
+    rng = np.random.default_rng(4)
+    W = np.abs(rng.standard_normal((9, K)))
+    W = W / W.sum(axis=1, keepdims=True)
+    steps = jnp.int32(60)
+
+    def solve(rows, B):
+        xs0 = jnp.array(_pad(rows, B))
+        if solver == "min_vol":
+            return minvol_batch(xs0, cov, jnp.zeros(K), jnp.ones(K),
+                                jnp.asarray(MINVOL_ETA), steps)
+        if solver == "risk_parity":
+            return riskparity_batch(xs0, cov,
+                                    jnp.asarray(RISKPARITY_ETA), steps)
+        return hedge_batch(xs0, jnp.array(np.zeros((B, K))), cov,
+                           jnp.array(_pad(np.ones_like(rows), B)),
+                           jnp.asarray(0.5), jnp.asarray(HEDGE_ETA), steps)
+
+    batch = [np.asarray(o) for o in solve(W, 32)]
+    for i in range(9):
+        single = [np.asarray(o) for o in solve(W[i:i + 1], 8)]
+        for b, s in zip(batch, single):
+            assert np.array_equal(b[i], s[0]), f"lane {i} diverged"
+    assert np.all(batch[0][9:] == 0)       # pad weights frozen at zero
+
+
+# -- serve-side construction --------------------------------------------------
+
+K4 = 4
+
+
+def _serve_engine():
+    from mfm_tpu.serve import QueryEngine
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((K4, K4)) / 2
+    cov = (a @ a.T + 1e-3 * np.eye(K4)) * 1e-4
+    return QueryEngine(cov, factor_names=["country", "ind0", "size", "mom"],
+                       benchmarks={"idx": rng.standard_normal(K4)})
+
+
+def _req(rid, w=None, **kw):
+    return json.dumps({"id": rid,
+                       "weights": [0.1] * K4 if w is None else w, **kw})
+
+
+def test_serve_construct_end_to_end():
+    """Construction requests ride the query loop: same admission, same
+    stamps, answers from the grad solvers against the SERVED covariance
+    — and a mixed drain answers risk queries on the exact pre-construct
+    path."""
+    from mfm_tpu.serve import QueryServer, ServePolicy
+    eng = _serve_engine()
+    server = QueryServer(eng, ServePolicy(default_deadline_s=60.0),
+                         health="ok")
+    server.submit_line(_req("q1"))                       # plain risk query
+    server.submit_line(_req("c1", construct="min_vol"))
+    server.submit_line(_req("c2", construct={"solver": "risk_parity"}))
+    server.submit_line(_req("c3", construct={
+        "solver": "hedge", "hedge_factors": ["size", "mom"], "hmax": 0.5}))
+    out = {r["id"]: r for r in server.drain()}
+    assert len(out) == 4 and all(r["ok"] for r in out.values())
+
+    assert "kind" not in out["q1"]         # risk answers are unchanged
+    for rid, solver in (("c1", "min_vol"), ("c2", "risk_parity"),
+                        ("c3", "hedge")):
+        r = out[rid]
+        assert r["kind"] == "construct" and r["solver"] == solver
+        assert len(r["weights"]) == K4 and r["total_vol"] > 0
+        assert r["health"] == "ok" and r["scenario_id"] is None
+    # simplex solvers return simplex weights
+    assert sum(out["c1"]["weights"]) == pytest.approx(1.0, rel=1e-9)
+    assert min(out["c2"]["weights"]) > 0
+    # the hedge held the unhedgeable factors at the request book
+    assert out["c3"]["weights"][:2] == [0.1, 0.1]
+
+    # the served answer IS the GradEngine answer over the served matrix
+    ge = GradEngine(np.asarray(eng._cov), factor_names=eng.factor_names)
+    ref = ge.construct_solve("min_vol", np.full((1, K4), 0.1))
+    assert out["c1"]["total_vol"] == float(ref["vols"][0])
+
+
+def test_serve_construct_bad_solver_dead_letters(tmp_path):
+    from mfm_tpu.serve import QueryServer, ServePolicy
+    from mfm_tpu.serve.server import REQ_REASON_BAD_CONSTRUCT
+    dl = str(tmp_path / "dead.jsonl")
+    server = QueryServer(_serve_engine(), ServePolicy(), health="ok",
+                         dead_letter_path=dl)
+    bad, = server.submit_line(_req("b1", construct="sharpe_max"))
+    assert bad["outcome"] == "dead_letter"
+    assert bad["reasons"] == ["bad_construct"]
+    # hedge over factors the engine does not serve is inadmissible too
+    bad2, = server.submit_line(_req("b2", construct={
+        "solver": "hedge", "hedge_factors": ["bogus"]}))
+    assert bad2["reasons"] == ["bad_construct"]
+    server.close()
+    recs = [json.loads(ln) for ln in open(dl)]
+    assert [r["id"] for r in recs] == ["b1", "b2"]
+    assert all(r["mask"] == REQ_REASON_BAD_CONSTRUCT for r in recs)
+
+
+def test_serve_construct_scenario_tagged_solves_stressed_world():
+    """A scenario-tagged construct request solves against the STRESSED
+    covariance: under a pure vol-regime doubling the min-vol weights are
+    unchanged (argmin is scale-free) but the reported vol doubles."""
+    from mfm_tpu.scenario import ScenarioBuilder, ScenarioEngine
+    from mfm_tpu.serve import QueryServer, ServePolicy
+    eng = _serve_engine()
+    sc = ScenarioEngine(np.asarray(eng._cov), factor_names=eng.factor_names)
+    results = sc.run([ScenarioBuilder("hot").vol_regime(2.0).build()])
+    server = QueryServer(eng, ServePolicy(default_deadline_s=60.0),
+                         health="ok",
+                         scenarios=sc.query_engines(results, eng))
+    server.submit_line(_req("plain", construct="min_vol"))
+    server.submit_line(_req("hot", construct="min_vol", scenario="hot"))
+    out = {r["id"]: r for r in server.drain()}
+    assert out["hot"]["scenario_id"] == "hot"
+    np.testing.assert_allclose(out["hot"]["weights"], out["plain"]["weights"],
+                               atol=1e-9)
+    assert out["hot"]["total_vol"] == pytest.approx(
+        2.0 * out["plain"]["total_vol"], rel=1e-9)
+
+
+def test_serve_construct_steady_state_compiles():
+    """<= 1 compile per (solver, bucket): after a warm drain, further
+    construct traffic at the same bucket must not recompile."""
+    from mfm_tpu.serve import QueryServer, ServePolicy
+    server = QueryServer(_serve_engine(),
+                         ServePolicy(default_deadline_s=60.0), health="ok")
+    for i in range(2):                     # warm the (min_vol, 8) bucket
+        server.submit_line(_req(f"w{i}", construct="min_vol"))
+    assert all(r["ok"] for r in server.drain())
+    with assert_max_compiles(1, "steady-state construct bucket 8"):
+        for i in range(5):
+            server.submit_line(_req(f"s{i}", construct="min_vol"))
+        out = server.drain()
+    assert len(out) == 5 and all(r["ok"] for r in out)

@@ -453,6 +453,46 @@ def test_r7_scenario_host_only_barrier(tmp_path):
         [("R1", "scenario_batch")]
 
 
+def test_r7_grad_host_only_barrier(tmp_path):
+    """mfm_tpu.grad.engine / .report are host-only barriers (orchestration
+    + atomic report IO), so their obs/IO never flags — while the grad
+    DEVICE modules (grad/reverse.py etc.) are NOT on the list and a
+    doctrine violation there still flags."""
+    res = _lint(tmp_path, {
+        "mfm_tpu/obs/instrument.py": """
+            def record_scenario_batch(n, seconds):
+                pass
+        """,
+        "mfm_tpu/grad/engine.py": """
+            from mfm_tpu.obs.instrument import record_scenario_batch
+
+            class GradEngine:
+                def reverse_stress(self, portfolios):
+                    record_scenario_batch(len(portfolios), 0.1)
+                    return portfolios
+        """,
+        "mfm_tpu/grad/report.py": """
+            import json
+            import os
+
+            def write_grad_report(path, report):
+                with open(path, "w") as fh:
+                    json.dump(report, fh)
+                os.replace(path, path)
+        """,
+        "mfm_tpu/grad/reverse.py": """
+            import numpy as np
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def reverse_stress_batch(x):
+                return jnp.asarray(np.mean(x))   # R1: np math in traced code
+        """})
+    assert [(v.rule, v.qualname) for v in res.new] == \
+        [("R1", "reverse_stress_batch")]
+
+
 def test_r7_bare_method_over_approximation(tmp_path):
     """A bare ``.inc(...)`` in traced code resolves (over-approximately)
     against every known def — including obs metric methods — so it flags.

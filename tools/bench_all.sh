@@ -48,6 +48,7 @@ python bench.py --config alla    "${plat[@]}" | tail -1 > "$out/config4_alla.jso
 python bench.py --config alpha   "${plat[@]}" | tail -1 > "$out/config5_alpha.json"
 python bench.py --config query   "${plat[@]}" | tail -1 > "$out/config6_query.json"
 python bench.py --config scenario "${plat[@]}" | tail -1 > "$out/config7_scenario.json"
+python bench.py --config grad    "${plat[@]}" | tail -1 > "$out/config8_grad.json"
 
 # universe-scaling smoke (slow; skip with MFM_SKIP_UNIVERSE_SMOKE=1): the
 # full A-share universe (N=5000) on an 8-device host mesh, time-bounded by
@@ -79,7 +80,7 @@ python tools/profile_eigen.py --json "$out/eigen_sweep.json" \
 # same-backend baselines only).  A regression fails the sweep — slower
 # numbers are a finding, not evidence to file.
 for rec in "$out/config1_risk.json" "$out/config6_query.json" \
-           "$out/config7_scenario.json"; do
+           "$out/config7_scenario.json" "$out/config8_grad.json"; do
   python tools/perfgate.py "$rec" \
     || { echo "perfgate: $rec regressed vs the BENCH_r*.json trajectory" >&2
          exit 1; }
@@ -94,10 +95,12 @@ done
 # plus the incremental-eigen carry: a SIGKILL mid eigen-carry checkpoint
 # save must leave the prior state bitwise-intact and doctor-green, and the
 # sharded append: a SIGKILL mid `--append --mesh 2x2` must prove the mesh
-# changes nothing about the fence (prior bytes identical, replay bitwise)
+# changes nothing about the fence (prior bytes identical, replay bitwise),
+# and the grad report: a SIGKILL between grad_report.json's tmp write and
+# rename must tear neither report nor checkpoint (config 8's evidence)
 python tools/faultinject.py --plans \
-  query-kill-mid-batch,query-poison-slab,query-overflow-storm,query-ckpt-swap,query-steady-state,scenario-kill-mid-batch,scenario-poison-spec,trace-kill-mid-flush,eigen-kill-mid-update,shard-kill-mid-append \
-  || { echo "query/scenario/trace chaos plans failed — config6/7 numbers are not evidence" >&2
+  query-kill-mid-batch,query-poison-slab,query-overflow-storm,query-ckpt-swap,query-steady-state,scenario-kill-mid-batch,scenario-poison-spec,trace-kill-mid-flush,eigen-kill-mid-update,shard-kill-mid-append,grad-kill-mid-solve \
+  || { echo "query/scenario/trace/grad chaos plans failed — config6/7/8 numbers are not evidence" >&2
        exit 1; }
 
 cat "$out"/config*.json

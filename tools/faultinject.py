@@ -1199,6 +1199,62 @@ def run_scenario_poison(plan, base: Baseline, root: str) -> dict:
             "healthy_bitwise": [h.name for h in healthy]}
 
 
+def run_grad_kill(plan, base: Baseline, root: str) -> dict:
+    """grad-kill-mid-solve: SIGKILL a real `mfm-tpu grad sensitivity`
+    subprocess between the grad report's tmp write and its rename.  No
+    torn ``grad_report.json`` may exist, the checkpoint's bytes must be
+    untouched (the grad path only READS the state), and a clean re-run
+    must write a report ``read_grad_report`` accepts plus a manifest
+    ``doctor --scenarios`` is green on."""
+    from mfm_tpu.grad.report import grad_report_path_for, read_grad_report
+
+    point = plan.param("point")
+    d = _fresh_workdir(root, plan.name, base.snaps[0])
+    path = os.path.join(d, "state.npz")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo_root}
+    with open(path, "rb") as fh:
+        state_bytes = fh.read()
+
+    cmd = [sys.executable, "-m", "mfm_tpu.cli", "grad", "sensitivity", path,
+           "--preset", "covid-2020-analog", "--out", d]
+    proc = subprocess.run(cmd, env={**env, "MFM_CHAOS_KILL": point},
+                          capture_output=True, text=True, timeout=600)
+    if proc.returncode != -signal.SIGKILL:
+        raise AssertionError(
+            f"{plan.name}: expected the grad run to die by SIGKILL at "
+            f"{point}, got rc={proc.returncode}\n{proc.stderr[-2000:]}")
+    rpath = grad_report_path_for(d)
+    if os.path.exists(rpath):
+        raise AssertionError(f"{plan.name}: a grad report exists despite "
+                             "the kill before its rename — the write is "
+                             "not tmp-then-rename atomic")
+    with open(path, "rb") as fh:
+        if fh.read() != state_bytes:
+            raise AssertionError(f"{plan.name}: the checkpoint's bytes "
+                                 "changed under a read-only grad run")
+    # clean re-run: report lands and parses, manifest is doctor-green
+    proc2 = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                           timeout=600)
+    if proc2.returncode != 0:
+        raise AssertionError(f"{plan.name}: post-crash grad run failed "
+                             f"rc={proc2.returncode}\n{proc2.stderr[-2000:]}")
+    rep = read_grad_report(rpath)   # raises on a torn report
+    if rep["grad_kind"] != "sensitivity" or rep["n_entries"] != 1:
+        raise AssertionError(f"{plan.name}: recovered report answered "
+                             f"kind={rep['grad_kind']} "
+                             f"n_entries={rep['n_entries']}, expected one "
+                             "sensitivity entry")
+    doc = subprocess.run([sys.executable, "-m", "mfm_tpu.cli", "doctor", d,
+                          "--scenarios"],
+                         env=env, capture_output=True, text=True, timeout=600)
+    if doc.returncode != 0:
+        raise AssertionError(f"{plan.name}: doctor --scenarios rejects the "
+                             f"post-crash directory\n{doc.stdout[-2000:]}")
+    return {"killed_at": point, "report_after_crash": "absent",
+            "recovered_entries": rep["n_entries"]}
+
+
 RUNNERS = {"truncate": run_byte_fault, "corrupt": run_byte_fault,
            "kill": run_kill, "kill_manifest": run_kill_manifest,
            "nan_slab": run_poison, "outlier_slab": run_poison,
@@ -1209,7 +1265,7 @@ RUNNERS = {"truncate": run_byte_fault, "corrupt": run_byte_fault,
            "scenario_kill": run_scenario_kill,
            "scenario_poison": run_scenario_poison,
            "trace_kill": run_trace_kill, "eigen_kill": run_eigen_kill,
-           "shard_kill": run_shard_kill}
+           "shard_kill": run_shard_kill, "grad_kill": run_grad_kill}
 
 
 def main(argv=None) -> int:

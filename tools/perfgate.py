@@ -56,6 +56,9 @@ METRIC_SPECS = {
     "tracing_overhead_frac": ("lower", 0.50, 0.01),
     "portfolios_per_sec": ("higher", 0.20, None),
     "scenarios_per_sec": ("higher", 0.20, None),
+    "minvol_portfolios_per_sec_b100": ("higher", 0.20, None),
+    "minvol_portfolios_per_sec_b10000": ("higher", 0.20, None),
+    "reverse_scenarios_per_sec": ("higher", 0.20, None),
 }
 
 
@@ -81,6 +84,11 @@ def extract_metrics(rec) -> dict:
         out["portfolios_per_sec"] = rec.get("value")
     elif metric == "scenario_throughput":
         out["scenarios_per_sec"] = rec.get("value")
+    elif metric == "grad_throughput":
+        for k in ("minvol_portfolios_per_sec_b100",
+                  "minvol_portfolios_per_sec_b10000",
+                  "reverse_scenarios_per_sec"):
+            out[k] = rec.get(k)
     return {k: v for k, v in out.items()
             if isinstance(v, (int, float)) and v == v}
 
