@@ -11,6 +11,7 @@ eigenfactor adjustment + vol-regime adjustment) on a CSI300-shaped panel
   python bench.py --config alla   # config 4: all-A full pipeline + risk stack
   python bench.py --config alpha  # config 5: 1000 alpha expressions, CSI300 panel
   python bench.py --config query  # config 6: batched portfolio-query service
+  python bench.py --config fleet  # config 9: coalescing front end vs 1-at-a-time
 
 The reference publishes no numbers (BASELINE.md), so the config-1 baseline is
 measured here: the golden NumPy implementation of the identical math (same
@@ -1142,6 +1143,204 @@ def bench_grad():
             "reverse": reverse}
 
 
+def bench_fleet():
+    """Config 9 (fleet): the coalescing front end vs the one-line-at-a-time
+    baseline under seeded mixed small-request (B=1) traffic.
+
+    Three measurements (tools/trafficgen.py drives all of them):
+
+    - **baseline_qps**: submit + drain per line — one jit dispatch per
+      request, the pre-fleet arrival-time behaviour of the stdin loop.
+    - **fleet_qps / latency**: the same request shapes through a
+      :class:`Coalescer` at a >= 2k req/s seeded OPEN-LOOP schedule;
+      sustained QPS is completions over the span from first arrival to
+      last completion, latency is per-request (scheduled arrival ->
+      delivery).  The p99 must sit inside the configured linger plus one
+      batch wall (the coalescer's latency contract), and every response
+      must be BITWISE the sequential single-threaded loop's for the same
+      request id (the bucket-ladder invariant).
+    - **closed_loop_qps**: 32 virtual clients, one request in flight
+      each — the self-throttled ceiling for comparison.
+    """
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import io
+    import threading
+
+    import trafficgen
+    from mfm_tpu.obs.instrument import fleet_summary_from_registry
+    from mfm_tpu.serve import (
+        Coalescer, QueryEngine, QueryServer, ServePolicy,
+    )
+
+    K = 1 + 31 + 10          # country + industries + styles (config-1 shape)
+    rng = np.random.default_rng(0)
+    A = (rng.standard_normal((K, K)) / np.sqrt(K)).astype(np.float32)
+    cov = (A @ A.T + 1e-3 * np.eye(K, dtype=np.float32)) * 1e-4
+    bench_map = {"idx": 0.1 * rng.standard_normal(K)}
+    stressed = (cov * 1.21).astype(np.float32)   # a 10% vol-regime shock
+
+    # batch_max 256 keeps each flush's construct sub-groups (~10% share
+    # each) inside a FULL bucket-32 kernel — at 512 they straddle into a
+    # half-empty bucket-128 and the padding halves sustained QPS
+    def mk_server(batch_max=256):
+        eng = QueryEngine(cov, benchmarks=bench_map)
+        scen = {"stress": QueryEngine(stressed, benchmarks=bench_map)}
+        return QueryServer(eng, ServePolicy(batch_max=batch_max,
+                                            queue_max=65536,
+                                            default_deadline_s=600.0),
+                           health="ok", scenarios=scen)
+
+    # construct solves are the expensive tail (a min_vol solve is ~30x a
+    # risk query) — they are where batching amortizes hardest, so the mix
+    # weights them at 20% (10% min_vol, 10% risk_parity by alternation)
+    mix = (0.45, 0.20, 0.15, 0.20)
+    n, rate, linger = 10000, 2400.0, 0.1
+    lines = trafficgen.gen_requests(7, n, K, scenario="stress", mix=mix)
+
+    wrng = np.random.default_rng(99)
+
+    def _wline(kind, i):
+        req = {"id": f"w{kind}{i}",
+               "weights": np.round(
+                   0.2 * wrng.standard_normal(K), 6).tolist(),
+               "deadline_s": 600.0}
+        if kind == "s":
+            req["scenario"] = "stress"
+        elif kind == "mv":
+            req["construct"] = {"solver": "min_vol"}
+        elif kind == "rp":
+            req["construct"] = {"solver": "risk_parity"}
+        return json.dumps(req, sort_keys=True)
+
+    def warm(server, buckets):
+        """Compile every (scenario, kernel-group, bucket) shape the run can
+        hit, so no XLA compile lands inside a timed window."""
+        for kind in ("q", "s", "mv", "rp"):
+            for b in buckets:
+                for i in range(b):
+                    server.submit_line_routed(_wline(kind, b * 1000 + i),
+                                              origin=None)
+                while server._queue:
+                    server.drain_routed()
+
+    # -- baseline: one-line-at-a-time (dispatch latency per request) ---------
+    base_lines = lines[:400]
+    bserver = mk_server(batch_max=1)
+    sink = io.StringIO()
+    warm(bserver, (1,))
+    t0 = time.perf_counter()
+    for ln in base_lines:
+        for r in bserver.submit_line(ln):
+            sink.write(json.dumps(r, sort_keys=True))
+        for r in bserver.drain():
+            # drain() hands back host dicts, but force a scalar anyway so
+            # the span is visibly synchronous (mfmlint R5)
+            _force(r.get("total_vol") or 0.0)
+            sink.write(json.dumps(r, sort_keys=True))
+    base_wall = time.perf_counter() - t0
+    baseline_qps = len(base_lines) / base_wall
+
+    # -- sequential reference for the bitwise check --------------------------
+    ref_buf = io.StringIO()
+    mk_server().run(list(lines), ref_buf, gulp=True)
+    ref = {}
+    for ln in ref_buf.getvalue().splitlines():
+        ref[json.loads(ln)["id"]] = ln
+
+    # -- coalesced open loop -------------------------------------------------
+    server = mk_server()
+    warm(server, (8, 32, 128, 512))
+    batch_walls = []
+    orig_drain = server.drain_routed
+
+    def timed_drain():
+        t = time.perf_counter()
+        out = orig_drain()
+        batch_walls.append(time.perf_counter() - t)
+        return out
+    server.drain_routed = timed_drain
+
+    completions, delivered = {}, {}
+    done = threading.Event()
+
+    def deliver(pairs):
+        now = time.monotonic()
+        for origin, resp in pairs:
+            completions[origin] = now
+            delivered[origin] = resp
+        if len(delivered) >= n:
+            done.set()
+
+    co = Coalescer(server, linger_s=linger, deliver=deliver)
+    co.start()
+    sched = trafficgen.open_loop(
+        lambda line, i: co.submit(line, origin=i), lines, rate)
+    done.wait(timeout=120.0)
+    co.stop()
+    if completions:
+        t_last = max(completions.values())
+        fleet_wall = max(t_last - sched["t0"], 1e-9)
+        fleet_qps = len(delivered) / fleet_wall
+    else:
+        # nothing completed inside the wait: report it (unanswered == n
+        # via latency_stats) instead of crashing on max() of nothing
+        fleet_qps = 0.0
+    lat = trafficgen.latency_stats(sched["arrivals"], completions)
+    max_batch_wall = max(batch_walls) if batch_walls else 0.0
+
+    mismatched = [i for i, resp in delivered.items()
+                  if json.dumps(resp, sort_keys=True)
+                  != ref.get(resp.get("id"))]
+    summary = fleet_summary_from_registry()
+
+    # -- closed loop ---------------------------------------------------------
+    cserver = mk_server()
+    warm(cserver, (8, 32))
+    events, cresp = {}, {}
+
+    def cdeliver(pairs):
+        for origin, resp in pairs:
+            cresp[origin] = resp
+            ev = events.get(origin)
+            if ev is not None:
+                ev.set()
+
+    cco = Coalescer(cserver, linger_s=0.002, deliver=cdeliver)
+    cco.start()
+
+    def submit_and_wait(line, i):
+        events[i] = threading.Event()
+        cco.submit(line, origin=i)
+        events[i].wait(timeout=60.0)
+    closed = trafficgen.closed_loop(submit_and_wait, lines[:2000], 32)
+    cco.stop()
+
+    return {"metric": "fleet_serving_throughput",
+            "value": round(fleet_qps),
+            "unit": "requests/s", "vs_baseline": None,
+            "k_factors": K, "n_requests": n,
+            "offered_rate_rps": rate,
+            "linger_s": linger,
+            "fleet_qps": round(fleet_qps, 1),
+            "baseline_qps": round(baseline_qps, 1),
+            "speedup_vs_baseline": round(fleet_qps / baseline_qps, 2),
+            "fleet_p50_latency_s": lat.get("p50_s"),
+            "fleet_p99_latency_s": lat.get("p99_s"),
+            "fleet_max_latency_s": lat.get("max_s"),
+            "max_batch_wall_s": round(max_batch_wall, 6),
+            "p99_within_linger_plus_batch": bool(
+                lat.get("p99_s", float("inf"))
+                <= linger + max_batch_wall),
+            "coalesce_batch_fill_frac":
+                summary["coalesce_batch_fill_frac"],
+            "coalesce_flushes": summary["coalesce_flushes"],
+            "bitwise_identical": not mismatched,
+            "bitwise_mismatches": len(mismatched),
+            "unanswered": lat.get("unanswered"),
+            "closed_loop_qps": round(closed["qps"], 1),
+            "closed_loop_concurrency": 32}
+
+
 CONFIGS = {
     "riskmodel": bench_riskmodel,
     "chunk_sweep": bench_chunk_sweep,
@@ -1153,6 +1352,7 @@ CONFIGS = {
     "query": bench_query,
     "scenario": bench_scenario,
     "grad": bench_grad,
+    "fleet": bench_fleet,
 }
 
 

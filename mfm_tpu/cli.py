@@ -1414,10 +1414,18 @@ def _doctor(args):
     if getattr(args, "serve", False) and man_dir is not None:
         from mfm_tpu.obs.manifest import ManifestError, read_run_manifest
 
+        from mfm_tpu.serve.replica import FLEET_MANIFEST_NAME
+
         spath = os.path.join(man_dir, SERVE_MANIFEST_NAME)
         rec = {"file": spath, "kind": "serve_manifest", "status": "ok",
                "problems": [], "warnings": []}
         records.append(rec)
+        fpath = os.path.join(man_dir, FLEET_MANIFEST_NAME)
+        if not os.path.exists(spath) and os.path.exists(fpath):
+            # a fleet run writes ONE merged manifest — the front end's
+            # serve summary lives there, not in serve_manifest.json
+            spath = fpath
+            rec["file"] = fpath
         if not os.path.exists(spath):
             rec["status"] = "missing"
             rec["problems"].append(
@@ -1464,6 +1472,62 @@ def _doctor(args):
                         "build, or tracing disabled)")
                 if rec["problems"]:
                     rec["status"] = "unhealthy"
+
+        # fleet audit: when a merged fleet manifest sits beside the
+        # artifacts, the per-replica delivered outcome counts plus the
+        # front end's locally-answered ledger must sum to the accepted
+        # count — a mismatch means responses were lost between dispatch
+        # and delivery (a replica death the re-dispatch failed to cover),
+        # which no per-process manifest can see on its own
+        from mfm_tpu.serve.replica import FLEET_MANIFEST_NAME
+        fpath = os.path.join(man_dir, FLEET_MANIFEST_NAME)
+        if os.path.exists(fpath):
+            frec = {"file": fpath, "kind": "fleet_manifest",
+                    "status": "ok", "problems": [], "warnings": []}
+            records.append(frec)
+            try:
+                fman = read_run_manifest(fpath)
+            except ManifestError as err:
+                frec["status"] = "corrupt"
+                frec["problems"].append(str(err))
+            else:
+                fm = fman.get("fleet")
+                if not isinstance(fm, dict):
+                    frec["problems"].append(
+                        "fleet manifest has no 'fleet' merge block")
+                else:
+                    audit = fm.get("audit", {})
+                    frec["accepted_total"] = audit.get("accepted_total")
+                    frec["replica_outcomes_sum"] = audit.get(
+                        "replica_outcomes_sum")
+                    frec["frontend_local_total"] = audit.get(
+                        "frontend_local_total")
+                    delivered = audit.get(
+                        "delivered_total",
+                        audit.get("replica_outcomes_sum"))
+                    frec["delivered_total"] = delivered
+                    if not audit.get("consistent"):
+                        frec["problems"].append(
+                            "delivered outcome counts (replicas + "
+                            f"frontend-local = {delivered}) do not sum "
+                            "to the front end's accepted count "
+                            f"({audit.get('accepted_total')}) — "
+                            "responses were lost between dispatch and "
+                            "delivery")
+                    for rep in fm.get("replicas", []):
+                        if rep.get("lost"):
+                            frec["warnings"].append(
+                                f"replica {rep.get('replica')} was lost "
+                                f"(exit {rep.get('exit_code')}) — its "
+                                "in-flight batch re-dispatched to "
+                                "survivors")
+                        if rep.get("quarantined"):
+                            frec["warnings"].append(
+                                f"replica {rep.get('replica')} was "
+                                "quarantined after failing its fence "
+                                "audit")
+                if frec["problems"]:
+                    frec["status"] = "unhealthy"
 
     # --scenarios: audit the scenario manifest beside the artifacts — a
     # torn write, an embedded spec whose recomputed hash disagrees with
@@ -1628,7 +1692,8 @@ def _serve(args):
         default_deadline_s=args.deadline_s,
         breaker_failures=args.breaker_failures,
         breaker_cooldown_s=args.breaker_cooldown_s,
-        weight_mad_k=args.weight_mad_k)
+        weight_mad_k=args.weight_mad_k,
+        fsync_emits=args.fsync_emits)
 
     reload_fn = None
     if args.watch:
@@ -1659,6 +1724,40 @@ def _serve(args):
     server = QueryServer(engine, policy, health=_health_beside(),
                          dead_letter_path=args.dead_letter,
                          reload_fn=reload_fn)
+    man_dir = os.path.dirname(state_path) or "."
+
+    def _finish(summary: dict, manifest_name: str, extra: dict) -> None:
+        manifest = build_run_manifest(
+            stamp_json=meta.get("stamp"),
+            checkpoint=state_path,
+            backend=jax_backend_name(),
+            metrics_snapshot=REGISTRY.snapshot(),
+            guard_summary=guard_summary_from_registry(),
+            health={"status": server.health, "checks": {}},
+            extra=dict(extra, serve=summary, trace_id=root.trace_id),
+        )
+        spath = os.path.join(man_dir, manifest_name)
+        write_run_manifest(spath, manifest)
+        end_span(root)
+        _metrics_flush(args)
+        print(json.dumps({"serve": summary, "manifest": spath,
+                          "trace_id": root.trace_id},
+                         indent=1), file=sys.stderr)
+
+    if args.worker:
+        # fleet worker: admitted lines in, seq envelopes out (the wire
+        # protocol in serve/replica.py); manifest shard beside the
+        # checkpoint for the front end's merge
+        from mfm_tpu.serve.replica import WORKER_MANIFEST_FMT, run_worker
+
+        summary = run_worker(server, sys.stdin, sys.stdout)
+        _finish(summary, WORKER_MANIFEST_FMT.format(idx=args.worker_id),
+                {"worker_id": args.worker_id})
+        return
+
+    if args.replicas or args.listen:
+        _serve_fleet(args, server, state_path, man_dir, _finish)
+        return
 
     in_fp = (sys.stdin if args.input in (None, "-")
              else open(args.input, encoding="utf-8"))
@@ -1671,24 +1770,106 @@ def _serve(args):
             in_fp.close()
         if out_fp is not sys.stdout:
             out_fp.close()
+    _finish(summary, SERVE_MANIFEST_NAME, {})
 
-    manifest = build_run_manifest(
-        stamp_json=meta.get("stamp"),
-        checkpoint=state_path,
-        backend=jax_backend_name(),
-        metrics_snapshot=REGISTRY.snapshot(),
-        guard_summary=guard_summary_from_registry(),
-        health={"status": server.health, "checks": {}},
-        extra={"serve": summary, "trace_id": root.trace_id},
+
+def _serve_fleet(args, server, state_path, man_dir, _finish) -> None:
+    """The fleet/coalescing serve paths: ``--replicas N`` dispatches
+    batches to worker subprocesses; ``--listen`` accepts concurrent
+    socket (or ``--http``) connections; either alone also works —
+    ``--replicas`` over stdin is the deterministic drill mode, and
+    ``--listen`` without replicas coalesces into the local engine."""
+    import signal
+    import sys
+
+    from mfm_tpu.obs.instrument import fleet_summary_from_registry
+    from mfm_tpu.serve.coalesce import Coalescer
+    from mfm_tpu.serve.frontend import SocketFrontend
+    from mfm_tpu.serve.replica import (
+        FLEET_MANIFEST_NAME, FleetServer, Replica, build_fleet_manifest,
+        replica_env, worker_cmd,
     )
-    spath = os.path.join(os.path.dirname(state_path) or ".",
-                         SERVE_MANIFEST_NAME)
-    write_run_manifest(spath, manifest)
-    end_span(root)
-    _metrics_flush(args)
-    print(json.dumps({"serve": summary, "manifest": spath,
-                      "trace_id": root.trace_id},
-                     indent=1), file=sys.stderr)
+
+    fleet = None
+    if args.replicas:
+        policy_args = [
+            "--queue-max", str(args.queue_max),
+            "--batch-max", str(args.batch_max),
+            "--deadline-s", str(args.deadline_s),
+            "--breaker-failures", str(args.breaker_failures),
+            "--breaker-cooldown-s", str(args.breaker_cooldown_s),
+            "--weight-mad-k", str(args.weight_mad_k)]
+        if args.benchmarks:
+            policy_args += ["--benchmarks", args.benchmarks]
+        if args.watch:
+            policy_args += ["--watch"]
+        if args.fsync_emits:
+            policy_args += ["--fsync-emits"]
+        replicas = [
+            Replica(i, worker_cmd(state_path, worker_id=i,
+                                  policy_args=policy_args),
+                    env=replica_env(i))
+            for i in range(args.replicas)]
+
+    def make_backend(deliver=None):
+        if args.replicas:
+            return FleetServer(server, replicas, linger_s=args.linger_s,
+                               deliver=deliver)
+        return Coalescer(server, linger_s=args.linger_s, deliver=deliver)
+
+    if args.listen:
+        host, _, port = args.listen.rpartition(":")
+        fe = SocketFrontend(host or "127.0.0.1", int(port or 0),
+                            http=args.http)
+        backend = make_backend(deliver=fe.deliver)
+        fe.backend = backend
+        fleet = backend if args.replicas else None
+        addr = fe.listen()
+        print(json.dumps({"listening": f"{addr[0]}:{addr[1]}",
+                          "replicas": args.replicas or 0,
+                          "http": bool(args.http)}),
+              file=sys.stderr, flush=True)
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda *_: fe.stop())
+        fe.serve(backend)   # blocks until stop(); drains the backend
+    else:
+        backend = make_backend()
+        fleet = backend if args.replicas else None
+        in_fp = (sys.stdin if args.input in (None, "-")
+                 else open(args.input, encoding="utf-8"))
+        out_fp = (sys.stdout if args.output in (None, "-")
+                  else open(args.output, "w", encoding="utf-8"))
+
+        def emit(pairs):
+            for _origin, resp in pairs:
+                out_fp.write(json.dumps(resp, sort_keys=True) + "\n")
+            if pairs:
+                out_fp.flush()
+                if server.policy.fsync_emits:
+                    try:
+                        os.fsync(out_fp.fileno())
+                    except (OSError, ValueError):
+                        pass
+        try:
+            for line in in_fp:
+                line = line.strip()
+                if not line:
+                    continue
+                emit(backend.submit(line))
+            emit(backend.stop())
+        finally:
+            if in_fp is not sys.stdin:
+                in_fp.close()
+            if out_fp is not sys.stdout:
+                out_fp.close()
+
+    summary = fleet_summary_from_registry()
+    if fleet is not None:
+        fleet.close_replicas()
+        fm = build_fleet_manifest(summary, fleet, man_dir)
+        _finish(summary, FLEET_MANIFEST_NAME, {"fleet": fm})
+    else:
+        _finish(summary, SERVE_MANIFEST_NAME, {})
 
 
 def _scenario(args):
@@ -2663,6 +2844,32 @@ def main(argv=None):
                     help="poll latest.json between batches and hot-swap "
                          "the engine when the checkpoint generation moves; "
                          "a failed fence audit opens the breaker")
+    sv.add_argument("--fsync-emits", action="store_true",
+                    help="fsync the response stream after every emitted "
+                         "batch — responses survive SIGKILL through the "
+                         "OS page cache, not just the Python buffer")
+    sv.add_argument("--replicas", type=int, default=0,
+                    help="run N worker replica processes behind a "
+                         "coalescing front end sharing the fenced "
+                         "checkpoint store (0 = serve in-process); "
+                         "writes fleet_manifest.json beside the "
+                         "checkpoint (docs/SERVING.md §Fleet)")
+    sv.add_argument("--listen", default=None, metavar="HOST:PORT",
+                    help="accept concurrent socket connections (JSONL "
+                         "per connection) instead of reading --input; "
+                         "port 0 binds ephemerally and the bound address "
+                         "is printed to stderr")
+    sv.add_argument("--http", action="store_true",
+                    help="speak HTTP/1.1 on the --listen socket (POST / "
+                         "with a JSONL body; GET /healthz, /metrics)")
+    sv.add_argument("--linger-s", type=float, default=0.01,
+                    help="coalescer max-linger budget: the oldest "
+                         "admitted request flushes after this wait even "
+                         "if its bucket has not filled (default 0.01)")
+    sv.add_argument("--worker", action="store_true",
+                    help=argparse.SUPPRESS)   # internal: fleet replica
+    sv.add_argument("--worker-id", type=int, default=0,
+                    help=argparse.SUPPRESS)   # internal: replica index
     sv.add_argument("--load-attempts", type=int, default=3,
                     help="startup checkpoint-load retries (default 3)")
     sv.add_argument("--load-backoff-s", type=float, default=0.1,

@@ -104,6 +104,11 @@ _R7_OBS_MODULES = ("mfm_tpu.utils.obs", "mfm_tpu.obs")
 # scenario DEVICE code lives alone in scenario/kernel.py, which stays
 # fully lintable)
 _R7_HOST_ONLY_MODULES = ("mfm_tpu.serve.server", "mfm_tpu.cli",
+                         # the fleet layer is pure host plumbing: threads,
+                         # sockets, subprocess pipes — no device code at all
+                         "mfm_tpu.serve.coalesce",
+                         "mfm_tpu.serve.frontend",
+                         "mfm_tpu.serve.replica",
                          "mfm_tpu.scenario.engine",
                          "mfm_tpu.scenario.manifest",
                          # grad host orchestration + report writer (the

@@ -123,6 +123,38 @@ BREAKER_STATE = REGISTRY.gauge(
 _BREAKER_STATE_CODE = {"closed": 0, "half_open": 1, "open": 2}
 _BREAKER_CODE_STATE = {v: k for k, v in _BREAKER_STATE_CODE.items()}
 
+# -- fleet layer (serve/coalesce.py, serve/frontend.py, serve/replica.py) -----
+
+COALESCE_FLUSHES_TOTAL = REGISTRY.counter(
+    "mfm_coalesce_flushes_total", "coalescer flushes by trigger",
+    labelnames=("trigger",))   # full | linger | eof
+COALESCE_BATCH_FILL = REGISTRY.histogram(
+    "mfm_coalesce_batch_fill",
+    "true queued requests / geometric bucket capacity per coalesced flush "
+    "(1.0 = the jit dispatch was fully amortized)",
+    buckets=(0.05, 0.1, 0.25, 0.5, 0.625, 0.75, 0.875, 1.0))
+COALESCE_LINGER_SECONDS = REGISTRY.histogram(
+    "mfm_coalesce_linger_seconds",
+    "oldest-request wait inside the coalescer at flush time",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0))
+FRONTEND_CONNECTIONS_TOTAL = REGISTRY.counter(
+    "mfm_frontend_connections_total", "client connections accepted")
+FLEET_DISPATCH_TOTAL = REGISTRY.counter(
+    "mfm_fleet_dispatch_total",
+    "admitted request lines dispatched to worker replicas",
+    labelnames=("replica",))
+FLEET_REPLICA_DEATHS_TOTAL = REGISTRY.counter(
+    "mfm_fleet_replica_deaths_total",
+    "worker replicas lost mid-serve (crash/SIGKILL — their in-flight "
+    "batch is re-dispatched to survivors)")
+FLEET_REPLICA_QUARANTINED_TOTAL = REGISTRY.counter(
+    "mfm_fleet_replica_quarantined_total",
+    "worker replicas drained out after failing their fence audit")
+FLEET_REDISPATCH_TOTAL = REGISTRY.counter(
+    "mfm_fleet_redispatch_total",
+    "request lines re-dispatched after a replica death or quarantine")
+
 # -- scenario engine (scenario/engine.py batched stress tests) ----------------
 
 SCENARIOS_RUN_TOTAL = REGISTRY.counter(
@@ -283,6 +315,73 @@ def serve_summary_from_registry() -> dict:
         "query_p50_latency_s": (None if p50 != p50 else round(p50, 6)),
         "query_p99_latency_s": (None if p99 != p99 else round(p99, 6)),
     }
+
+
+def record_coalesce_flush(n_true: int, capacity: int, trigger: str,
+                          lingered_s: float) -> None:
+    """Tally one coalesced flush: fill fraction vs the geometric bucket
+    the batch padded to, what triggered it, and how long the oldest
+    queued request lingered."""
+    COALESCE_FLUSHES_TOTAL.inc(1, trigger=trigger)
+    if capacity > 0:
+        COALESCE_BATCH_FILL.observe(min(1.0, n_true / capacity))
+    COALESCE_LINGER_SECONDS.observe(max(0.0, float(lingered_s)))
+
+
+def record_frontend_connection(n: int = 1) -> None:
+    FRONTEND_CONNECTIONS_TOTAL.inc(int(n))
+
+
+def record_fleet_dispatch(replica: int, n: int = 1) -> None:
+    FLEET_DISPATCH_TOTAL.inc(int(n), replica=str(replica))
+
+
+def record_replica_death(n: int = 1) -> None:
+    FLEET_REPLICA_DEATHS_TOTAL.inc(int(n))
+
+
+def record_replica_quarantine(n: int = 1) -> None:
+    FLEET_REPLICA_QUARANTINED_TOTAL.inc(int(n))
+
+
+def record_fleet_redispatch(n: int = 1) -> None:
+    FLEET_REDISPATCH_TOTAL.inc(int(n))
+
+
+def fleet_summary_from_registry() -> dict:
+    """The fleet manifest's front-end block, off the live counters.
+
+    Extends :func:`serve_summary_from_registry` with the coalescer and
+    replica-dispatch counters; ``mfm-tpu doctor --serve`` audits the
+    per-replica outcome counts in the merged manifest against this
+    block's dispatch totals."""
+    out = serve_summary_from_registry()
+    flushes = {k[0]: int(v)
+               for k, v in COALESCE_FLUSHES_TOTAL.series().items()}
+    dispatch = {k[0]: int(v)
+                for k, v in FLEET_DISPATCH_TOTAL.series().items()}
+    fill_series = COALESCE_BATCH_FILL.series()
+    fill_mean = None
+    if fill_series:
+        st = next(iter(fill_series.values()))
+        if st.count:
+            fill_mean = round(st.total / st.count, 6)
+    linger_p99 = COALESCE_LINGER_SECONDS.quantile_est(0.99)
+    out.update({
+        "coalesce_flushes": flushes,
+        "coalesce_flushes_total": sum(flushes.values()),
+        "coalesce_batch_fill_frac": fill_mean,
+        "coalesce_linger_p99_s": (None if linger_p99 != linger_p99
+                                  else round(linger_p99, 6)),
+        "connections_total": int(FRONTEND_CONNECTIONS_TOTAL.value()),
+        "dispatch_by_replica": dispatch,
+        "dispatch_total": sum(dispatch.values()),
+        "replica_deaths_total": int(FLEET_REPLICA_DEATHS_TOTAL.value()),
+        "replica_quarantined_total": int(
+            FLEET_REPLICA_QUARANTINED_TOTAL.value()),
+        "redispatch_total": int(FLEET_REDISPATCH_TOTAL.value()),
+    })
+    return out
 
 
 def record_scenario_batch(n_true: int, seconds: float) -> None:

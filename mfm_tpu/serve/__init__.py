@@ -14,6 +14,13 @@ Two guard surfaces protect the two directions of the serving stack:
 :mod:`mfm_tpu.serve._checks` holds the formula primitives both guard
 layers share (MAD outliers, reason-bitmask plumbing) so they cannot
 drift.
+
+The fleet layer stacks on top of the single loop:
+:mod:`mfm_tpu.serve.coalesce` merges concurrent submissions into the
+bucket ladder under a linger budget, :mod:`mfm_tpu.serve.frontend`
+accepts concurrent socket/HTTP connections, and
+:mod:`mfm_tpu.serve.replica` runs N worker processes behind the fenced
+checkpoint store (docs/SERVING.md §"Fleet").
 """
 
 from mfm_tpu.serve.guard import (  # noqa: F401
@@ -39,4 +46,12 @@ from mfm_tpu.serve.server import (  # noqa: F401
     ServePolicy,
     parse_request,
     req_reason_names,
+)
+from mfm_tpu.serve.coalesce import Coalescer  # noqa: F401
+from mfm_tpu.serve.frontend import SocketFrontend  # noqa: F401
+from mfm_tpu.serve.replica import (  # noqa: F401
+    FleetServer,
+    Replica,
+    ReplicaDeadError,
+    run_worker,
 )

@@ -1,0 +1,435 @@
+"""Worker replicas: N serving processes behind one coalescing front end,
+sharing the generation-fenced checkpoint store.
+
+Process model
+-------------
+The front end (the :class:`FleetServer` below, usually wrapped by
+``serve/frontend.py``) runs ADMISSION only: request guards, dead-letter
+quarantine, shed-oldest backpressure and deadline stamping through its own
+:class:`~mfm_tpu.serve.server.QueryServer` — which it never drains.
+Admitted raw lines pool under the coalescer's linger budget, then each
+flush round-robins one batch to a worker replica over a pipe.
+
+Workers are ``mfm-tpu serve --worker`` subprocesses.  Each loads the SAME
+fenced checkpoint (so re-parsing an admitted line is deterministic),
+polls the pointer between batches for zero-downtime hot reload, and
+answers with the unchanged batched drain path — which is why fleet
+responses stay bitwise-identical per request id to the single-process
+loop.
+
+Wire protocol (JSONL both ways, ``__fleet__`` is the control key —
+reserved at ADMISSION: ``parse_request`` dead-letters any request
+carrying it, and a worker accepts a control frame only when the parsed
+object is exactly ``{"__fleet__": ...}``, so a client can never spoof a
+flush or shift response ordinals):
+
+- frontend -> worker: admitted request lines verbatim, then
+  ``{"__fleet__": "flush"}`` to drain the batch.
+- worker -> frontend: one envelope ``{"seq": i, "resp": {...}}`` per line
+  (``seq`` = the line's ordinal within the current batch — request ids
+  need not be unique, ordinals are), then
+  ``{"__fleet__": "flushed", "n": k}``.
+
+Failure semantics
+-----------------
+- A worker that DIES mid-batch (crash, SIGKILL — detected as EOF or a
+  broken pipe) loses nothing but its in-flight batch: the batch is
+  re-dispatched to the next healthy replica, the death and re-dispatch
+  are counted, and the checkpoint bytes are untouched (workers only ever
+  read the store).
+- A worker that fails its FENCE AUDIT on reload force-opens its own
+  breaker, so the whole batch comes back ``rejected`` with
+  ``breaker == "fence_audit"``.  The front end does NOT deliver those: the
+  replica is quarantined — drained out, never killed mid-batch — and the
+  batch re-dispatches to a replica that still passes its audit.
+- With NO healthy replica left, queued work answers ``error`` locally
+  (clients see a well-formed response, the merged manifest shows the
+  outage).
+
+At shutdown each worker writes its own serve manifest shard
+(``serve_manifest.r{i}.json`` beside the checkpoint); the front end merges
+them with its own summary into ``fleet_manifest.json``, whose audit
+invariant — per-replica delivered outcome counts plus the front end's
+locally-answered ledger sum to the accepted count — is what
+``mfm-tpu doctor --serve`` checks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+
+from mfm_tpu.obs import instrument as _obs
+from mfm_tpu.obs import trace as _trace
+from mfm_tpu.serve.coalesce import Coalescer
+from mfm_tpu.serve.query import bucket_for
+from mfm_tpu.serve.server import FLEET_CONTROL_KEY as CONTROL_KEY
+
+#: per-replica manifest shard name beside the checkpoint
+WORKER_MANIFEST_FMT = "serve_manifest.r{idx}.json"
+FLEET_MANIFEST_NAME = "fleet_manifest.json"
+
+
+class ReplicaDeadError(RuntimeError):
+    """The worker's pipe broke mid-batch (crash/SIGKILL)."""
+
+
+def _control_frame(line: str) -> dict | None:
+    """Parse ``line`` as a control frame, or None if it is a request.
+
+    Only an object that is EXACTLY ``{"__fleet__": ...}`` counts:
+    admission already dead-letters any request carrying the reserved key
+    (``parse_request``), and the strict shape here is the second wall —
+    a line that somehow reaches a worker with ``__fleet__`` among other
+    keys falls through to normal admission (consuming its seq ordinal)
+    instead of flushing mid-batch or silently shifting ordinals, either
+    of which would desync the pipe and route responses to the wrong
+    clients."""
+    if CONTROL_KEY not in line[:16]:
+        return None
+    try:
+        obj = json.loads(line)
+    except ValueError:
+        return None
+    if isinstance(obj, dict) and set(obj) == {CONTROL_KEY}:
+        return obj
+    return None
+
+
+# -- worker side --------------------------------------------------------------
+
+def run_worker(server, in_fp, out_fp) -> dict:
+    """The worker-side loop: admitted lines in, seq envelopes out.
+
+    ``server`` is a fully-wired :class:`QueryServer` (engine off the
+    fenced checkpoint, ``reload_fn`` polling the pointer).  Returns the
+    worker's serve summary for its manifest shard."""
+
+    def emit(pairs):
+        for origin, resp in pairs:
+            out_fp.write(json.dumps({"seq": origin, "resp": resp},
+                                    sort_keys=True) + "\n")
+
+    def flush_out():
+        out_fp.flush()
+        if server.policy.fsync_emits:
+            try:
+                os.fsync(out_fp.fileno())
+            except (OSError, ValueError):
+                pass
+
+    # Immediate responses (worker-side rejections, shed notices) BUFFER
+    # until the flush control: the front end writes its whole batch before
+    # it starts reading, so a worker that wrote envelopes mid-batch could
+    # fill the stdout pipe while the front end fills stdin — a deadlock.
+    # Holding writes until flush makes the pipe strictly half-duplex.
+    seq = 0
+    held: list = []
+    for line in in_fp:
+        line = line.strip()
+        if not line:
+            continue
+        ctl = _control_frame(line)
+        if ctl is not None:
+            if ctl[CONTROL_KEY] == "flush":
+                n_batch = seq
+                emit(held)
+                held = []
+                server.poll_reload()
+                while server._queue:
+                    emit(server.drain_routed())
+                out_fp.write(json.dumps(
+                    {CONTROL_KEY: "flushed", "n": n_batch},
+                    sort_keys=True) + "\n")
+                flush_out()
+                seq = 0   # seq is an ordinal WITHIN a batch
+            continue
+        held.extend(server.submit_line_routed(line, origin=seq))
+        seq += 1
+    # EOF: drain the tail (a frontend that closes our stdin without a
+    # final flush still gets every admitted request answered)
+    emit(held)
+    server.poll_reload()
+    while server._queue:
+        emit(server.drain_routed())
+    flush_out()
+    server.close()
+    return _obs.serve_summary_from_registry()
+
+
+# -- frontend side ------------------------------------------------------------
+
+class Replica:
+    """One worker subprocess + its delivery ledger."""
+
+    def __init__(self, idx: int, cmd: list, env: dict | None = None):
+        self.idx = int(idx)
+        self.cmd = list(cmd)
+        self.proc = subprocess.Popen(
+            self.cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            text=True, env=env)
+        self.quarantined = False
+        #: outcome -> responses DELIVERED to clients off this replica
+        #: (a quarantined fence-audit batch is not delivered, by design)
+        self.delivered: dict[str, int] = {}
+
+    @property
+    def alive(self) -> bool:
+        return not self.quarantined and self.proc.poll() is None
+
+    def run_batch(self, lines: list) -> dict:
+        """Send one batch + flush, block for the envelopes.  Returns
+        ``{seq: resp}``; raises :class:`ReplicaDeadError` on a broken
+        pipe / EOF / torn output line."""
+        try:
+            for ln in lines:
+                self.proc.stdin.write(ln + "\n")
+            self.proc.stdin.write(
+                json.dumps({CONTROL_KEY: "flush"}) + "\n")
+            self.proc.stdin.flush()
+        except (BrokenPipeError, OSError) as e:
+            raise ReplicaDeadError(f"replica {self.idx}: {e}") from e
+        resps: dict = {}
+        while True:
+            raw = self.proc.stdout.readline()
+            if not raw:
+                raise ReplicaDeadError(
+                    f"replica {self.idx}: EOF mid-batch (pid "
+                    f"{self.proc.pid}, rc {self.proc.poll()})")
+            try:
+                obj = json.loads(raw)
+            except ValueError as e:
+                raise ReplicaDeadError(
+                    f"replica {self.idx}: torn output line") from e
+            if obj.get(CONTROL_KEY) == "flushed":
+                return resps
+            resps[int(obj["seq"])] = obj["resp"]
+
+    def close(self, timeout: float = 30.0) -> int | None:
+        """Graceful drain-out: EOF on stdin lets the worker answer its
+        tail and write its manifest shard.  Returns the exit code."""
+        try:
+            if self.proc.stdin and not self.proc.stdin.closed:
+                self.proc.stdin.close()
+        except (BrokenPipeError, OSError):
+            pass
+        try:
+            self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+        return self.proc.poll()
+
+
+def worker_cmd(state_path: str, *, worker_id: int, policy_args=(),
+               python=None) -> list:
+    """The ``mfm-tpu serve --worker`` argv for one replica."""
+    import sys
+    py = python or sys.executable
+    return ([py, "-m", "mfm_tpu.cli", "serve", str(state_path),
+             "--worker", "--worker-id", str(worker_id)]
+            + list(policy_args))
+
+
+def replica_env(idx: int, base_env=None) -> dict:
+    """Worker environment with chaos-kill targeting: when
+    ``MFM_CHAOS_KILL_REPLICA`` names this replica's index, the
+    ``MFM_CHAOS_KILL``/``MFM_CHAOS_KILL_MATCH`` pair passes through;
+    every other worker (and the front end, which never drains) runs
+    clean — the drill kills exactly one replica."""
+    env = dict(base_env if base_env is not None else os.environ)
+    target = env.pop("MFM_CHAOS_KILL_REPLICA", None)
+    if target is not None and int(target) != int(idx):
+        env.pop("MFM_CHAOS_KILL", None)
+        env.pop("MFM_CHAOS_KILL_MATCH", None)
+    return env
+
+
+class FleetServer(Coalescer):
+    """The fleet dispatcher: a :class:`Coalescer` whose flush sends each
+    batch to a worker replica instead of draining locally.
+
+    ``server`` is the ADMISSION QueryServer (same engine/policy as the
+    workers, but it never drains — its queue is the coalescing pool and
+    its guards/shed/dead-letter run in-process so rejects never cost a
+    pipe round trip)."""
+
+    def __init__(self, server, replicas: list, *, linger_s: float = 0.01,
+                 clock=None, deliver=None):
+        import time
+        super().__init__(server, linger_s=linger_s,
+                         clock=clock or time.monotonic, deliver=deliver)
+        self.replicas = list(replicas)
+        self.accepted_total = 0   # requests popped for dispatch
+        #: outcome -> responses the FRONT END answered locally (deadline
+        #: expiry in its queue, no-healthy-replica outage, dropped seq);
+        #: merged into the fleet manifest so the delivery audit still
+        #: balances — every accepted request's response is in exactly one
+        #: ledger, a replica's or this one
+        self.local_delivered: dict[str, int] = {}
+        self._rr = 0
+
+    # callers hold self._lock (Coalescer.submit/poll/flush/stop take it)
+    def _flush_locked(self, trigger: str) -> list:
+        out = []
+        now = self._clock()
+        lingered = (now - self._oldest_t) if self._oldest_t is not None else 0.0
+        while self.server._queue:
+            batch = []
+            while (self.server._queue
+                   and len(batch) < self.server.policy.batch_max):
+                batch.append(self.server._queue.popleft())
+            _obs.record_queue_depth(len(self.server._queue))
+            _obs.record_coalesce_flush(len(batch), bucket_for(len(batch)),
+                                       trigger, lingered)
+            lingered = 0.0
+            self.accepted_total += len(batch)
+            # enforce deadlines HERE, not just in the worker: workers
+            # re-stamp deadlines at their own enqueue time, so time spent
+            # lingering or queued at the front end would otherwise never
+            # count against a request's budget — same check drain() runs
+            live = []
+            for r in batch:
+                if now > r.deadline_t:
+                    out.append(self._local_deadline(r))
+                else:
+                    live.append(r)
+            if live:
+                out.extend(self._dispatch(live))
+        self._oldest_t = None
+        return out
+
+    def _next_replica(self):
+        n = len(self.replicas)
+        for _ in range(n):
+            w = self.replicas[self._rr % n]
+            self._rr += 1
+            if w.alive:
+                return w
+        return None
+
+    def _count_local(self, outcome: str) -> None:
+        self.local_delivered[outcome] = \
+            self.local_delivered.get(outcome, 0) + 1
+
+    def _local_error(self, r, detail: str) -> tuple:
+        _obs.record_query_outcome("error")
+        self._count_local("error")
+        if r.span is not None:
+            _trace.end_span(r.span, outcome="error")
+        return (r.origin, self.server._stamp(
+            {"id": r.rid, "ok": False, "outcome": "error",
+             "detail": detail},
+            scenario_id=r.scenario, trace_id=r.trace_id))
+
+    def _local_deadline(self, r) -> tuple:
+        _obs.record_query_outcome("deadline")
+        self._count_local("deadline")
+        if r.span is not None:
+            _trace.end_span(r.span, outcome="deadline")
+        return (r.origin, self.server._stamp(
+            {"id": r.rid, "ok": False, "outcome": "deadline"},
+            scenario_id=r.scenario, trace_id=r.trace_id))
+
+    def _dispatch(self, batch: list) -> list:
+        lines = [r.line for r in batch]
+        while True:
+            w = self._next_replica()
+            if w is None:
+                return [self._local_error(r, "no healthy replicas")
+                        for r in batch]
+            _obs.record_fleet_dispatch(w.idx, len(lines))
+            try:
+                resps = w.run_batch(lines)
+            except ReplicaDeadError:
+                _obs.record_replica_death()
+                _obs.record_fleet_redispatch(len(lines))
+                continue
+            if (len(resps) == len(lines) and resps and
+                    all(isinstance(v, dict)
+                        and v.get("breaker") == "fence_audit"
+                        for v in resps.values())):
+                # the replica's own reload failed its fence audit: drain
+                # it out (no more batches; graceful close at shutdown so
+                # it still writes its manifest shard) and re-dispatch
+                w.quarantined = True
+                _obs.record_replica_quarantine()
+                _obs.record_fleet_redispatch(len(lines))
+                continue
+            pairs = []
+            for i, r in enumerate(batch):
+                resp = resps.get(i)
+                if resp is None:
+                    pairs.append(self._local_error(
+                        r, f"replica {w.idx} dropped seq {i}"))
+                    continue
+                outcome = str(resp.get("outcome", "error"))
+                _obs.record_query_outcome(outcome)
+                w.delivered[outcome] = w.delivered.get(outcome, 0) + 1
+                if r.span is not None:
+                    _trace.end_span(r.span, outcome=outcome)
+                pairs.append((r.origin, resp))
+            return pairs
+
+    def close_replicas(self) -> None:
+        for w in self.replicas:
+            w.close()
+
+
+# -- merged manifest ----------------------------------------------------------
+
+def build_fleet_manifest(frontend_summary: dict, fleet,
+                         manifest_dir: str) -> dict:
+    """Merge the front end's summary with every replica's ledger + its
+    manifest shard (a SIGKILLed worker has no shard — that IS the loss
+    the manifest counts).  The ``audit`` block is the doctor invariant:
+    per-replica delivered outcome counts plus the front end's own
+    locally-answered ledger (deadline expiry at the front end, outage
+    errors, dropped seqs — all well-formed responses clients DID receive)
+    must sum to the accepted count."""
+    from mfm_tpu.obs.manifest import ManifestError, read_run_manifest
+    reps = []
+    outcomes_sum = 0
+    for w in fleet.replicas:
+        rc = w.proc.poll()
+        shard_path = os.path.join(manifest_dir,
+                                  WORKER_MANIFEST_FMT.format(idx=w.idx))
+        shard = None
+        try:
+            shard = read_run_manifest(shard_path).get("serve")
+        except (ManifestError, OSError):
+            pass
+        total = sum(w.delivered.values())
+        outcomes_sum += total
+        reps.append({
+            "replica": w.idx,
+            "exit_code": rc,
+            "lost": bool(rc is not None and rc != 0),
+            "quarantined": bool(w.quarantined),
+            "outcomes": dict(sorted(w.delivered.items())),
+            "outcomes_total": total,
+            "manifest_shard": (WORKER_MANIFEST_FMT.format(idx=w.idx)
+                               if shard is not None else None),
+            "worker_summary": shard,
+        })
+    accepted = int(fleet.accepted_total)
+    local = dict(sorted(getattr(fleet, "local_delivered", {}).items()))
+    local_total = sum(local.values())
+    return {
+        "schema": 1,
+        "frontend": frontend_summary,
+        "accepted_total": accepted,
+        "replicas": reps,
+        "frontend_local": {
+            "outcomes": local,
+            "outcomes_total": local_total,
+        },
+        "audit": {
+            "replica_outcomes_sum": outcomes_sum,
+            "frontend_local_total": local_total,
+            "delivered_total": outcomes_sum + local_total,
+            "accepted_total": accepted,
+            "consistent": outcomes_sum + local_total == accepted,
+        },
+    }

@@ -35,6 +35,8 @@ import collections
 import dataclasses
 import hashlib
 import json
+import os
+import threading
 import time
 from typing import Callable
 
@@ -78,6 +80,12 @@ _REQ_REASON_NAMES = (
 #: grad pulls the kernel modules in
 CONSTRUCT_SOLVERS = ("min_vol", "risk_parity", "hedge")
 
+#: JSONL key reserved for the fleet wire protocol (serve/replica.py).
+#: Admission REJECTS any request carrying it, so admitted lines can be
+#: forwarded to a worker replica verbatim without frame escaping — a
+#: client can never smuggle a control frame past the front end.
+FLEET_CONTROL_KEY = "__fleet__"
+
 
 def req_reason_names(mask: int) -> list[str]:
     """Human-readable names of the bits set in a request-reason mask."""
@@ -106,6 +114,12 @@ class ServePolicy:
         (shared formula with the slab guards); 0 disables the check.
       breaker_on_degraded: force the breaker open while the model health
         verdict is "degraded".
+      fsync_emits: fsync the response stream after every emitted event
+        batch.  The per-emit ``flush()`` already makes responses durable
+        against the PYTHON buffer (a SIGKILLed loop loses nothing it
+        wrote); fsync extends that through the OS page cache, so emitted
+        responses also survive a power cut.  Off by default — an fsync per
+        drain is an I/O wall the pipe-to-consumer deployment doesn't need.
     """
 
     queue_max: int = 4096
@@ -115,6 +129,7 @@ class ServePolicy:
     breaker_cooldown_s: float = 5.0
     weight_mad_k: float = 0.0
     breaker_on_degraded: bool = True
+    fsync_emits: bool = False
 
     def __post_init__(self):
         if self.queue_max < 1:
@@ -149,6 +164,12 @@ class CircuitBreaker:
     success closes, probe failure re-opens (cooldown restarts).
     :meth:`force_open` is the degraded-health / fence-audit path — it
     records why, and the reason rides on rejected responses.
+
+    Thread-safe: every state transition and counter bump happens under one
+    internal lock.  The fleet front end (serve/frontend.py) admits requests
+    from N connection threads while the drain loop records batch outcomes —
+    an unlocked ``_consecutive += 1`` under that interleaving can lose
+    failures and never open the breaker.
     """
 
     def __init__(self, failures: int = 3, cooldown_s: float = 5.0,
@@ -156,6 +177,7 @@ class CircuitBreaker:
         self._threshold = int(failures)
         self._cooldown = float(cooldown_s)
         self._clock = clock
+        self._lock = threading.RLock()
         self._state = "closed"
         self._consecutive = 0
         self._opened_at = 0.0
@@ -164,54 +186,63 @@ class CircuitBreaker:
 
     @property
     def state(self) -> str:
-        return self._state
+        with self._lock:
+            return self._state
 
     def _to(self, state: str) -> None:
+        # callers hold self._lock
         if state != self._state:
             self._state = state
             _obs.record_breaker_state(state)
 
     def allow(self) -> bool:
         """Admit a request?  May transition open -> half_open."""
-        if self._state == "open":
-            if self._clock() - self._opened_at >= self._cooldown:
-                self._to("half_open")
-                return True
-            return False
-        return True
+        with self._lock:
+            if self._state == "open":
+                if self._clock() - self._opened_at >= self._cooldown:
+                    self._to("half_open")
+                    return True
+                return False
+            return True
 
     def record_success(self) -> None:
-        self._consecutive = 0
-        if self._state == "half_open":
-            self.open_reason = None
-            self._to("closed")
+        with self._lock:
+            self._consecutive = 0
+            if self._state == "half_open":
+                self.open_reason = None
+                self._to("closed")
 
     def record_failure(self) -> None:
-        self._consecutive += 1
-        if self._state == "half_open" or \
-                self._consecutive >= self._threshold:
-            self.force_open("failures")
+        with self._lock:
+            self._consecutive += 1
+            if self._state == "half_open" or \
+                    self._consecutive >= self._threshold:
+                self.force_open("failures")
 
     def force_open(self, reason: str) -> None:
-        self._consecutive = 0
-        self._opened_at = self._clock()
-        self.open_reason = reason
-        # re-arm the cooldown even if already open (repeated force_open
-        # keeps rejecting); only a transition tallies breaker_open_total
-        self._to("open")
+        with self._lock:
+            self._consecutive = 0
+            self._opened_at = self._clock()
+            self.open_reason = reason
+            # re-arm the cooldown even if already open (repeated force_open
+            # keeps rejecting); only a transition tallies breaker_open_total
+            self._to("open")
 
     def retry_after(self) -> float:
-        if self._state != "open":
-            return 0.0
-        return max(0.0, self._cooldown - (self._clock() - self._opened_at))
+        with self._lock:
+            if self._state != "open":
+                return 0.0
+            return max(0.0,
+                       self._cooldown - (self._clock() - self._opened_at))
 
 
 class _Request:
     __slots__ = ("rid", "weights", "bidx", "enq_t", "deadline_t", "scenario",
-                 "trace_id", "span", "construct")
+                 "trace_id", "span", "construct", "origin", "line")
 
     def __init__(self, rid, weights, bidx, enq_t, deadline_t, scenario=None,
-                 trace_id=None, span=None, construct=None):
+                 trace_id=None, span=None, construct=None, origin=None,
+                 line=None):
         self.rid = rid
         self.weights = weights
         self.bidx = bidx
@@ -221,6 +252,12 @@ class _Request:
         self.trace_id = trace_id
         self.span = span
         self.construct = construct
+        # origin: an opaque routing token (connection handle, replica
+        # dispatch ordinal) stamped by the fleet layer; None on the plain
+        # single-stream loop.  line: the raw admitted request bytes — the
+        # fleet dispatcher forwards them verbatim to a worker replica.
+        self.origin = origin
+        self.line = line
 
 
 def _line_trace_id(line: str) -> str:
@@ -311,6 +348,10 @@ def parse_request(line: str, engine, policy: ServePolicy, scenarios=None):
     trace_id = obj.get("trace_id")
     if trace_id is not None:
         trace_id = str(trace_id)
+    if FLEET_CONTROL_KEY in obj:
+        return (rid, None, 0, 0.0, scenario, trace_id, None), \
+            REQ_REASON_SCHEMA, \
+            f"reserved key {FLEET_CONTROL_KEY!r} (fleet control namespace)"
     raw_w = obj.get("weights")
     if raw_w is None:
         return (rid, None, 0, 0.0, scenario, trace_id, None), \
@@ -511,14 +552,25 @@ class QueryServer:
         event produced (rejection, dead-letter ack, shed notices for
         displaced older work); an admitted request answers later, at
         drain."""
+        return [resp for _, resp in self.submit_line_routed(line)]
+
+    def submit_line_routed(self, line: str, origin=None) -> list[tuple]:
+        """:meth:`submit_line` with response routing: every immediate
+        response comes back as ``(origin, resp)``, where the origin is the
+        one the RESPONSE's request was admitted with — a shed notice
+        carries the DISPLACED (older) request's origin, which may belong
+        to a different connection than the line that triggered it.  The
+        fleet front end routes each response to its own connection off
+        this pairing; the single-stream loop passes ``origin=None`` and
+        ignores it."""
         out = []
         if not self.breaker.allow():
             _obs.record_query_outcome("rejected")
-            return [self._stamp({
+            return [(origin, self._stamp({
                 "id": _peek_id(line), "ok": False, "outcome": "rejected",
                 "retry_after_s": round(self.breaker.retry_after(), 3),
                 "breaker": self.breaker.open_reason or "open"},
-                trace_id=_peek_trace_id(line) or _line_trace_id(line))]
+                trace_id=_peek_trace_id(line) or _line_trace_id(line)))]
         fields, mask, detail = parse_request(line, self.engine, self.policy,
                                              scenarios=self.scenarios)
         if mask:
@@ -528,11 +580,11 @@ class QueryServer:
             self._dead_letter(rid, mask, detail, line,
                               extra={"scenario_id": scen, "trace_id": tid})
             _obs.record_query_outcome("dead_letter")
-            return [self._stamp({"id": rid, "ok": False,
-                                 "outcome": "dead_letter",
-                                 "reasons": req_reason_names(mask),
-                                 "detail": detail}, scenario_id=scen,
-                                trace_id=tid)]
+            return [(origin, self._stamp({"id": rid, "ok": False,
+                                          "outcome": "dead_letter",
+                                          "reasons": req_reason_names(mask),
+                                          "detail": detail}, scenario_id=scen,
+                                         trace_id=tid))]
         rid, w, bidx, deadline_s, scen, tid, construct = fields
         if tid is None:
             tid = _line_trace_id(line)
@@ -543,7 +595,8 @@ class QueryServer:
                                request_id=rid, scenario=scen)
         self._queue.append(_Request(rid, w, bidx, now, now + deadline_s,
                                     scenario=scen, trace_id=tid, span=sp,
-                                    construct=construct))
+                                    construct=construct, origin=origin,
+                                    line=line))
         # bounded queue: shedding drops the OLDEST queued work first —
         # under overload the head of the queue is the request whose
         # deadline is nearest death; the freshest work is the most useful
@@ -553,10 +606,10 @@ class QueryServer:
             _obs.record_query_outcome("shed")
             if old.span is not None:
                 _trace.end_span(old.span, outcome="shed")
-            out.append(self._stamp({"id": old.rid, "ok": False,
-                                    "outcome": "shed"},
-                                   scenario_id=old.scenario,
-                                   trace_id=old.trace_id))
+            out.append((old.origin, self._stamp({"id": old.rid, "ok": False,
+                                                 "outcome": "shed"},
+                                                scenario_id=old.scenario,
+                                                trace_id=old.trace_id)))
         _obs.record_queue_depth(len(self._queue))
         return out
 
@@ -568,6 +621,12 @@ class QueryServer:
         touching the device.  A batch failure tallies the breaker; the
         chaos point fires after every drained batch (crash-recovery plans
         key on its deterministic ``batch{i}`` path)."""
+        return [resp for _, resp in self.drain_routed()]
+
+    def drain_routed(self) -> list[tuple]:
+        """:meth:`drain` with response routing: ``(origin, resp)`` pairs,
+        each response paired with the origin its request was admitted
+        with (see :meth:`submit_line_routed`)."""
         taken = []
         while self._queue and len(taken) < self.policy.batch_max:
             taken.append(self._queue.popleft())
@@ -581,10 +640,11 @@ class QueryServer:
                 _obs.record_query_outcome("deadline")
                 if r.span is not None:
                     _trace.end_span(r.span, outcome="deadline")
-                out.append(self._stamp({"id": r.rid, "ok": False,
-                                        "outcome": "deadline"},
-                                       scenario_id=r.scenario,
-                                       trace_id=r.trace_id))
+                out.append((r.origin,
+                            self._stamp({"id": r.rid, "ok": False,
+                                         "outcome": "deadline"},
+                                        scenario_id=r.scenario,
+                                        trace_id=r.trace_id)))
             else:
                 live.append(r)
         if not live:
@@ -596,11 +656,11 @@ class QueryServer:
                 _obs.record_query_outcome("rejected")
                 if r.span is not None:
                     _trace.end_span(r.span, outcome="rejected")
-                out.append(self._stamp({
+                out.append((r.origin, self._stamp({
                     "id": r.rid, "ok": False, "outcome": "rejected",
                     "retry_after_s": round(self.breaker.retry_after(), 3),
                     "breaker": self.breaker.open_reason or "open"},
-                    scenario_id=r.scenario, trace_id=r.trace_id))
+                    scenario_id=r.scenario, trace_id=r.trace_id)))
             return out
         # group by scenario tag, first-appearance order: the None group is
         # the exact pre-scenario path (one stack, one engine.query) so
@@ -617,10 +677,10 @@ class QueryServer:
                     _obs.record_query_outcome("error")
                     if r.span is not None:
                         _trace.end_span(r.span, outcome="error")
-                    out.append(self._stamp(
+                    out.append((r.origin, self._stamp(
                         {"id": r.rid, "ok": False, "outcome": "error",
                          "detail": f"scenario {scen!r} no longer served"},
-                        scenario_id=scen, trace_id=r.trace_id))
+                        scenario_id=scen, trace_id=r.trace_id)))
                 continue
             # split risk queries from construction solves: the query
             # sub-batch runs the exact pre-construct path (one stack, one
@@ -643,8 +703,9 @@ class QueryServer:
         self._batch_i += 1
         return out
 
-    def _drain_query(self, engine, scen, grp) -> list[dict]:
-        """Answer one scenario group's risk queries in ONE device batch."""
+    def _drain_query(self, engine, scen, grp) -> list[tuple]:
+        """Answer one scenario group's risk queries in ONE device batch.
+        Returns routed ``(origin, resp)`` pairs."""
         out = []
         W = np.stack([r.weights for r in grp]).astype(engine.dtype)
         bench = [r.bidx for r in grp]
@@ -667,11 +728,12 @@ class QueryServer:
                 _obs.record_query_outcome("error")
                 if r.span is not None:
                     _trace.end_span(r.span, outcome="error")
-                out.append(self._stamp({"id": r.rid, "ok": False,
-                                        "outcome": "error",
-                                        "detail": str(e)[:500]},
-                                       scenario_id=scen, engine=engine,
-                                       trace_id=r.trace_id))
+                out.append((r.origin,
+                            self._stamp({"id": r.rid, "ok": False,
+                                         "outcome": "error",
+                                         "detail": str(e)[:500]},
+                                        scenario_id=scen, engine=engine,
+                                        trace_id=r.trace_id)))
             return out
         dt = time.perf_counter() - t0
         _trace.end_span(bsp, outcome="ok")
@@ -694,15 +756,17 @@ class QueryServer:
             if r.bidx > 0:
                 resp["active_risk"] = float(res.active_risk[i])
                 resp["beta"] = float(res.beta[i])
-            out.append(self._stamp(resp, scenario_id=scen,
-                                   engine=engine, trace_id=r.trace_id))
+            out.append((r.origin, self._stamp(resp, scenario_id=scen,
+                                              engine=engine,
+                                              trace_id=r.trace_id)))
         return out
 
-    def _drain_construct(self, engine, scen, solver, hmax, grp) -> list[dict]:
+    def _drain_construct(self, engine, scen, solver, hmax, grp) -> list[tuple]:
         """Answer one (solver, hmax) construct sub-batch in ONE donated
         jit call (the grad/construct.py kernels, padded to the portfolio
         bucket — <= 1 compile per (solver, bucket) in steady state), with
-        the query path's breaker / outcome / span semantics."""
+        the query path's breaker / outcome / span semantics.
+        Returns routed ``(origin, resp)`` pairs."""
         from mfm_tpu.grad.engine import GradEngine
         out = []
         head = grp[0]
@@ -731,12 +795,13 @@ class QueryServer:
                 _obs.record_query_outcome("error")
                 if r.span is not None:
                     _trace.end_span(r.span, outcome="error")
-                out.append(self._stamp({"id": r.rid, "ok": False,
-                                        "outcome": "error",
-                                        "kind": "construct",
-                                        "detail": str(e)[:500]},
-                                       scenario_id=scen, engine=engine,
-                                       trace_id=r.trace_id))
+                out.append((r.origin,
+                            self._stamp({"id": r.rid, "ok": False,
+                                         "outcome": "error",
+                                         "kind": "construct",
+                                         "detail": str(e)[:500]},
+                                        scenario_id=scen, engine=engine,
+                                        trace_id=r.trace_id)))
             return out
         dt = time.perf_counter() - t0
         _trace.end_span(bsp, outcome="ok")
@@ -754,8 +819,9 @@ class QueryServer:
                     "total_vol": float(res["vols"][i])}
             diag = np.asarray(res["diag"][i])
             resp["diag"] = diag.tolist() if diag.ndim else float(diag)
-            out.append(self._stamp(resp, scenario_id=scen, engine=engine,
-                                   trace_id=r.trace_id))
+            out.append((r.origin,
+                        self._stamp(resp, scenario_id=scen, engine=engine,
+                                    trace_id=r.trace_id)))
         return out
 
     # -- the loop ------------------------------------------------------------
@@ -769,11 +835,18 @@ class QueryServer:
         def emit(resps):
             # flush per event batch: an emitted response is durable even if
             # the process is SIGKILLed before the next drain (the chaos
-            # kill plans assert the survivor prefix replays bitwise)
+            # kill plans assert the survivor prefix replays bitwise).
+            # fsync_emits extends that durability through the OS page
+            # cache — flush alone only empties the Python-level buffer.
             for r in resps:
                 out_fp.write(json.dumps(r, sort_keys=True) + "\n")
             if resps:
                 out_fp.flush()
+                if self.policy.fsync_emits:
+                    try:
+                        os.fsync(out_fp.fileno())
+                    except (OSError, ValueError):
+                        pass  # not a real file (StringIO, closed pipe)
 
         for line in lines:
             line = line.strip()

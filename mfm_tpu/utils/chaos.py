@@ -208,7 +208,7 @@ class FaultPlan:
                      # query_kill | query_poison | query_overflow |
                      # query_swap | query_steady | scenario_kill |
                      # scenario_poison | trace_kill | eigen_kill |
-                     # shard_kill | grad_kill
+                     # shard_kill | grad_kill | fleet_kill
     seed: int = 0
     params: tuple = ()   # ((key, value), ...) — hashable, printable
 
@@ -282,4 +282,14 @@ def plan_suite(seed: int = 0) -> tuple:
         # checkpoint bytes untouched, clean re-run doctor-green
         FaultPlan("grad-kill-mid-solve", "grad_kill", s + 21,
                   (("point", "grad_report.after_tmp"),)),
+        # serving fleet (PR 15): SIGKILL one of three worker replicas
+        # after it computed a batch but before its envelopes reached the
+        # pipe — the survivors keep answering (the front end re-dispatches
+        # the dead replica's batch), every response is bitwise the
+        # single-process replay's, the merged fleet manifest counts the
+        # loss while its delivery audit balances, and the checkpoint's
+        # bytes stay untouched
+        FaultPlan("fleet-kill-replica", "fleet_kill", s + 22,
+                  (("point", "serve.after_batch"), ("match", "batch1"),
+                   ("replica", 1), ("replicas", 3))),
     )

@@ -194,7 +194,8 @@ def test_plan_suite_is_deterministic():
                                    "query_swap", "query_steady",
                                    "scenario_kill", "scenario_poison",
                                    "trace_kill", "eigen_kill",
-                                   "shard_kill", "grad_kill"}
+                                   "shard_kill", "grad_kill",
+                                   "fleet_kill"}
     assert len({p.seed for p in a}) == len(a)
 
 

@@ -1,0 +1,623 @@
+"""Serving fleet (mfm_tpu/serve/{coalesce,frontend,replica}.py): coalesced
+mixed-type batches bitwise-equal to the single-threaded loop, the linger/
+full/eof flush triggers, the <=1-compile steady state with the coalescer
+on, the worker wire protocol, death re-dispatch + fence-audit quarantine +
+the merged-manifest delivery audit, the thread-safety hammer for the
+breaker and the metrics registry, fsync-on-emit, and the socket front end
+under concurrent clients."""
+
+import io
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mfm_tpu.obs import instrument as _obs
+from mfm_tpu.serve import (
+    CircuitBreaker,
+    Coalescer,
+    FleetServer,
+    QueryEngine,
+    QueryServer,
+    ReplicaDeadError,
+    ServePolicy,
+    SocketFrontend,
+)
+from mfm_tpu.serve.replica import (
+    CONTROL_KEY,
+    build_fleet_manifest,
+    replica_env,
+    run_worker,
+)
+
+K = 4
+
+
+def _engine(scale=1.0):
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((K, K)) / 2
+    cov = (a @ a.T + 1e-3 * np.eye(K)) * 1e-4 * scale
+    return QueryEngine(cov, factor_names=["country", "ind0", "size", "mom"],
+                       benchmarks={"idx": rng.standard_normal(K)})
+
+
+def _server(batch_max=64, **kw):
+    policy = ServePolicy(batch_max=batch_max, queue_max=4096,
+                         default_deadline_s=600.0)
+    return QueryServer(_engine(), policy, health="ok",
+                       scenarios={"stress": _engine(scale=1.44)}, **kw)
+
+
+def _mixed_lines(n, seed=3):
+    """Seeded mixed request stream: plain, benchmark, scenario-tagged and
+    both construct solvers, ids m0..m{n-1}."""
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n):
+        req = {"id": f"m{i}",
+               "weights": np.round(0.2 * rng.standard_normal(K), 6).tolist(),
+               "deadline_s": 600.0}
+        kind = i % 5
+        if kind == 1:
+            req["benchmark"] = "idx"
+        elif kind == 2:
+            req["scenario"] = "stress"
+        elif kind == 3:
+            req["construct"] = {"solver": "min_vol"}
+        elif kind == 4:
+            req["construct"] = {"solver": "risk_parity"}
+        lines.append(json.dumps(req, sort_keys=True))
+    return lines
+
+
+def _sequential_by_id(lines, batch_max=64):
+    out = io.StringIO()
+    _server(batch_max=batch_max).run(list(lines), out, gulp=True)
+    return {json.loads(ln)["id"]: ln for ln in out.getvalue().splitlines()}
+
+
+class Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+# -- coalescer: bitwise equality + triggers ----------------------------------
+
+@pytest.mark.parametrize("batch_max", [4, 64])
+def test_coalescer_mixed_bitwise_vs_sequential(batch_max):
+    # batch_max=4 exercises repeated full-trigger flushes (several
+    # bucket-8 rounds); 64 exercises one eof flush spanning buckets
+    lines = _mixed_lines(22)
+    ref = _sequential_by_id(lines, batch_max=batch_max)
+    co = Coalescer(_server(batch_max=batch_max), linger_s=10.0)
+    got = {}
+    for i, ln in enumerate(lines):
+        for origin, resp in co.submit(ln, origin=i):
+            got[origin] = resp
+    for origin, resp in co.stop():
+        got[origin] = resp
+    assert len(got) == len(lines)
+    for i, ln in enumerate(lines):
+        rid = json.loads(ln)["id"]
+        assert json.dumps(got[i], sort_keys=True) == ref[rid], \
+            f"coalesced response for {rid} diverges from sequential loop"
+
+
+def test_coalescer_full_linger_eof_triggers():
+    clk = Clock()
+    co = Coalescer(_server(batch_max=4), linger_s=0.5, clock=clk)
+    t0 = _obs.fleet_summary_from_registry()["coalesce_flushes"]
+    # full: the 4th admitted request flushes immediately
+    pairs = []
+    for i, ln in enumerate(_mixed_lines(4, seed=5)):
+        pairs += co.submit(ln, origin=i)
+    assert len(pairs) == 4 and co.queued() == 0
+    # linger: one queued request, poll is a no-op until the budget expires
+    co.submit(_mixed_lines(1, seed=6)[0], origin=99)
+    assert co.poll() == [] and co.queued() == 1
+    assert co.next_deadline() == pytest.approx(clk.t + 0.5)
+    clk.t += 0.6
+    lingered = co.poll()
+    assert [o for o, _ in lingered] == [99]
+    # eof: stop drains the tail
+    co.submit(_mixed_lines(1, seed=7)[0], origin=7)
+    assert [o for o, _ in co.stop()] == [7]
+    t1 = _obs.fleet_summary_from_registry()["coalesce_flushes"]
+
+    def delta(trig):
+        return t1.get(trig, 0) - t0.get(trig, 0)
+    assert delta("full") == 1 and delta("linger") == 1 and delta("eof") == 1
+
+
+def test_coalescer_steady_state_single_compile():
+    """S4: with the coalescer on, a warmed (type, bucket) shape never
+    recompiles — repeated same-shape flushes run with zero new jit
+    compiles."""
+    from mfm_tpu.utils.contracts import assert_max_compiles
+
+    co = Coalescer(_server(batch_max=64), linger_s=10.0)
+    lines = _mixed_lines(20, seed=11)   # all five kinds, buckets warmed
+    for i, ln in enumerate(lines):
+        co.submit(ln, origin=i)
+    co.flush()
+    with assert_max_compiles(0, "coalesced steady state"):
+        for round_ in range(3):
+            for i, ln in enumerate(_mixed_lines(20, seed=20 + round_)):
+                co.submit(ln, origin=i)
+            co.flush()
+    co.stop()
+
+
+# -- S1: thread-safety hammers ------------------------------------------------
+
+def test_breaker_thread_hammer():
+    """8 threads x 300 failures each: no lost increment — the breaker must
+    be OPEN long before the end, and the final failure count is exact when
+    kept below the threshold."""
+    br = CircuitBreaker(failures=8 * 300, cooldown_s=1e9)
+    n_threads, per = 8, 300
+
+    def hammer():
+        for _ in range(per - 1):
+            br.record_failure()
+
+    ts = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # (per - 1) * n failures < threshold: every increment must have landed
+    # and none may have tripped it early
+    assert br.state == "closed"
+    assert br._consecutive == n_threads * (per - 1)
+    br.record_failure()
+    for _ in range(n_threads - 1):
+        br.record_failure()
+    assert br.state == "open" and br.open_reason == "failures"
+
+
+def test_metrics_registry_thread_hammer():
+    """Concurrent counter bumps and histogram observes tally exactly."""
+    before = _obs.fleet_summary_from_registry()
+    n_threads, per = 8, 250
+
+    def hammer(idx):
+        for i in range(per):
+            _obs.record_fleet_dispatch(idx % 2, 1)
+            _obs.record_coalesce_flush(4, 8, "full", 0.001)
+
+    ts = [threading.Thread(target=hammer, args=(i,))
+          for i in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    after = _obs.fleet_summary_from_registry()
+    assert after["dispatch_total"] - before["dispatch_total"] \
+        == n_threads * per
+    assert (after["coalesce_flushes_total"]
+            - before["coalesce_flushes_total"]) == n_threads * per
+
+
+# -- S2: fsync on emit --------------------------------------------------------
+
+def test_fsync_emits_policy(tmp_path, monkeypatch):
+    calls = []
+    real_fsync = os.fsync
+
+    def counting_fsync(fd):
+        calls.append(fd)
+        return real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", counting_fsync)
+    lines = _mixed_lines(4, seed=9)
+    out_path = tmp_path / "resp.jsonl"
+    policy = ServePolicy(batch_max=4, queue_max=64,
+                         default_deadline_s=600.0, fsync_emits=True)
+    server = QueryServer(_engine(), policy, health="ok")
+    with open(out_path, "w") as fh:
+        server.run(list(lines), fh, gulp=True)
+    assert calls, "fsync_emits=True must fsync the response stream"
+    assert len(out_path.read_text().splitlines()) == 4
+    # a non-file sink (StringIO raises UnsupportedOperation) is tolerated
+    server2 = QueryServer(_engine(), policy, health="ok")
+    buf = io.StringIO()
+    server2.run(list(_mixed_lines(2, seed=10)), buf, gulp=True)
+    assert len(buf.getvalue().splitlines()) == 2
+
+
+# -- worker wire protocol -----------------------------------------------------
+
+def test_run_worker_wire_protocol():
+    """Envelopes carry per-batch ordinals, every flush ends with the
+    sentinel, seq resets between batches, and an EOF without a final flush
+    still answers the tail."""
+    lines = _mixed_lines(7, seed=13)
+    flush = json.dumps({CONTROL_KEY: "flush"})
+    in_text = "\n".join(lines[:3] + [flush] + lines[3:5] + [flush]
+                        + lines[5:]) + "\n"   # tail: EOF, no flush
+    out = io.StringIO()
+    summary = run_worker(_server(batch_max=8), io.StringIO(in_text), out)
+    assert isinstance(summary, dict) and "requests_total" in summary
+    envs = [json.loads(ln) for ln in out.getvalue().splitlines()]
+    sentinels = [e for e in envs if e.get(CONTROL_KEY) == "flushed"]
+    assert [s["n"] for s in sentinels] == [3, 2]
+    resps = [e for e in envs if CONTROL_KEY not in e]
+    assert [e["seq"] for e in resps] == [0, 1, 2, 0, 1, 0, 1]
+    ref = _sequential_by_id(lines, batch_max=8)
+    for env, ln in zip(resps, lines[:3] + lines[3:5] + lines[5:]):
+        rid = json.loads(ln)["id"]
+        assert json.dumps(env["resp"], sort_keys=True) == ref[rid]
+
+
+def test_control_key_rejected_at_admission():
+    """A request smuggling the reserved __fleet__ key dead-letters at
+    admission (never forwarded to a worker), with the schema reason."""
+    server = _server()
+    spoof = json.dumps({"id": "evil", "weights": [0.1] * K,
+                        "__fleet__": "flush"}, sort_keys=True)
+    resps = server.submit_line(spoof)
+    assert len(resps) == 1
+    assert resps[0]["outcome"] == "dead_letter"
+    assert resps[0]["id"] == "evil"
+    assert "schema" in resps[0]["reasons"]
+    assert not server._queue
+
+
+def test_worker_control_frame_not_spoofable():
+    """Only an object that is EXACTLY {__fleet__: ...} is a control frame:
+    a request line carrying the key among other keys consumes its seq
+    ordinal and answers dead_letter — no mid-batch flush, no ordinal
+    shift, no cross-client response misrouting."""
+    lines = _mixed_lines(2, seed=31)
+    spoof = json.dumps({"__fleet__": "flush", "id": "evil",
+                        "weights": [0.1] * K}, sort_keys=True)
+    assert spoof.startswith('{"__fleet__"')   # worst case for the prefix scan
+    flush = json.dumps({CONTROL_KEY: "flush"})
+    in_text = "\n".join([lines[0], spoof, lines[1], flush]) + "\n"
+    out = io.StringIO()
+    run_worker(_server(batch_max=8), io.StringIO(in_text), out)
+    envs = [json.loads(ln) for ln in out.getvalue().splitlines()]
+    sentinels = [e for e in envs if e.get(CONTROL_KEY) == "flushed"]
+    assert [s["n"] for s in sentinels] == [3]
+    resps = {e["seq"]: e["resp"] for e in envs if CONTROL_KEY not in e}
+    assert set(resps) == {0, 1, 2}
+    assert resps[1]["outcome"] == "dead_letter"
+    assert resps[0]["outcome"] == "ok" and resps[2]["outcome"] == "ok"
+    ref = _sequential_by_id(lines, batch_max=8)
+    for seq, ln in ((0, lines[0]), (2, lines[1])):
+        rid = json.loads(ln)["id"]
+        assert json.dumps(resps[seq], sort_keys=True) == ref[rid]
+
+
+# -- fleet dispatch: death, quarantine, outage, manifest ----------------------
+
+class _StubProc:
+    def __init__(self):
+        self.rc = None
+
+    def poll(self):
+        return self.rc
+
+
+class _StubReplica:
+    """Duck-typed Replica: answers through a real in-process worker server
+    (so responses stay bitwise-comparable), or fails on demand."""
+
+    def __init__(self, idx, mode="ok"):
+        self.idx = idx
+        self.mode = mode
+        self.quarantined = False
+        self.delivered = {}
+        self.proc = _StubProc()
+        self._wserver = _server(batch_max=64)
+
+    @property
+    def alive(self):
+        return not self.quarantined and self.proc.poll() is None
+
+    def run_batch(self, lines):
+        if self.mode == "dead":
+            self.proc.rc = -9
+            raise ReplicaDeadError(f"replica {self.idx}: EOF mid-batch")
+        if self.mode == "fence":
+            return {i: {"id": json.loads(ln)["id"], "ok": False,
+                        "outcome": "rejected", "breaker": "fence_audit"}
+                    for i, ln in enumerate(lines)}
+        resps = {}
+        for i, ln in enumerate(lines):
+            for o, r in self._wserver.submit_line_routed(ln, origin=i):
+                resps[o] = r
+        while self._wserver._queue:
+            for o, r in self._wserver.drain_routed():
+                resps[o] = r
+        return resps
+
+    def close(self, timeout=None):
+        if self.proc.rc is None:
+            self.proc.rc = 0
+        return self.proc.rc
+
+
+def _fleet_run(replicas, n=8, batch_max=4):
+    fleet = FleetServer(_server(batch_max=batch_max), replicas,
+                        linger_s=10.0)
+    lines = _mixed_lines(n, seed=17)
+    got = {}
+    for i, ln in enumerate(lines):
+        for o, r in fleet.submit(ln, origin=i):
+            got[o] = r
+    for o, r in fleet.stop():
+        got[o] = r
+    return fleet, lines, got
+
+
+def test_fleet_death_redispatch_bitwise(tmp_path):
+    """A replica dying mid-batch loses nothing: its batch re-dispatches to
+    a survivor and every response matches the single-process loop."""
+    dead = _StubReplica(0, mode="dead")
+    ok = _StubReplica(1)
+    fleet, lines, got = _fleet_run([dead, ok])
+    assert len(got) == len(lines)
+    ref = _sequential_by_id(lines, batch_max=4)
+    for i, ln in enumerate(lines):
+        rid = json.loads(ln)["id"]
+        assert json.dumps(got[i], sort_keys=True) == ref[rid]
+    fleet.close_replicas()
+    fm = build_fleet_manifest({}, fleet, str(tmp_path))
+    assert fm["audit"]["consistent"]
+    assert fm["audit"]["accepted_total"] == len(lines)
+    by_idx = {r["replica"]: r for r in fm["replicas"]}
+    assert by_idx[0]["lost"] and by_idx[0]["outcomes_total"] == 0
+    assert by_idx[0]["manifest_shard"] is None
+    assert by_idx[1]["outcomes_total"] == len(lines)
+
+
+def test_fleet_quarantine_on_fence_audit(tmp_path):
+    """An all-fence_audit batch quarantines the replica WITHOUT delivering
+    the rejections; the batch re-dispatches to a healthy replica."""
+    fenced = _StubReplica(0, mode="fence")
+    ok = _StubReplica(1)
+    fleet, lines, got = _fleet_run([fenced, ok])
+    assert fenced.quarantined and not fenced.alive
+    assert all(r.get("breaker") != "fence_audit" for r in got.values())
+    assert len(got) == len(lines)
+    fleet.close_replicas()
+    fm = build_fleet_manifest({}, fleet, str(tmp_path))
+    assert fm["audit"]["consistent"]
+    by_idx = {r["replica"]: r for r in fm["replicas"]}
+    assert by_idx[0]["quarantined"] and by_idx[0]["outcomes_total"] == 0
+
+
+def test_fleet_no_healthy_replicas_local_error(tmp_path):
+    dead = _StubReplica(0, mode="dead")
+    fleet, lines, got = _fleet_run([dead], n=4)
+    assert len(got) == 4
+    for r in got.values():
+        assert r["outcome"] == "error" and "no healthy replicas" in r["detail"]
+    # locally-answered outage responses land in the frontend's own ledger,
+    # so the delivery audit still balances (clients DID get responses)
+    fleet.close_replicas()
+    fm = build_fleet_manifest({}, fleet, str(tmp_path))
+    assert fm["frontend_local"]["outcomes"] == {"error": 4}
+    assert fm["audit"]["consistent"]
+    assert fm["audit"]["frontend_local_total"] == 4
+    assert fm["audit"]["delivered_total"] == 4
+
+
+def test_fleet_frontend_enforces_deadline(tmp_path):
+    """Time queued at the front end (linger + dispatch backlog) counts
+    against deadline_s: a request whose budget expires before dispatch
+    answers `deadline` locally — never shipped to a worker, which would
+    re-stamp the deadline at its own enqueue time — and the audit
+    balances across the replica + frontend-local ledgers."""
+    clk = Clock()
+    ok = _StubReplica(1)
+    fleet = FleetServer(_server(clock=clk), [ok], linger_s=5.0, clock=clk)
+    fleet.submit(json.dumps({"id": "d0", "weights": [0.1] * K,
+                             "deadline_s": 1.0}), origin=0)
+    fleet.submit(json.dumps({"id": "d1", "weights": [0.1] * K,
+                             "deadline_s": 600.0}), origin=1)
+    clk.t += 2.0   # linger past d0's budget, inside d1's
+    got = {o: r for o, r in fleet.stop()}
+    assert got[0]["outcome"] == "deadline"
+    assert got[1]["outcome"] == "ok"
+    assert fleet.local_delivered == {"deadline": 1}
+    assert sum(ok.delivered.values()) == 1
+    fleet.close_replicas()
+    fm = build_fleet_manifest({}, fleet, str(tmp_path))
+    assert fm["audit"]["consistent"]
+    assert fm["audit"]["accepted_total"] == 2
+
+
+def test_build_fleet_manifest_inconsistent_audit(tmp_path):
+    """S5: a delivery shortfall (responses lost between dispatch and
+    delivery) must break the audit invariant the doctor checks."""
+    ok = _StubReplica(1)
+    fleet, lines, got = _fleet_run([ok], n=6)
+    fleet.close_replicas()
+    fleet.accepted_total += 1   # simulate a dropped response
+    fm = build_fleet_manifest({}, fleet, str(tmp_path))
+    assert not fm["audit"]["consistent"]
+    assert fm["audit"]["replica_outcomes_sum"] == 6
+    assert fm["audit"]["accepted_total"] == 7
+
+
+def test_replica_env_chaos_targeting():
+    base = {"MFM_CHAOS_KILL": "serve.after_batch",
+            "MFM_CHAOS_KILL_MATCH": "batch1",
+            "MFM_CHAOS_KILL_REPLICA": "1", "KEEP": "x"}
+    victim = replica_env(1, base)
+    clean = replica_env(0, base)
+    assert victim["MFM_CHAOS_KILL"] == "serve.after_batch"
+    assert "MFM_CHAOS_KILL" not in clean
+    assert "MFM_CHAOS_KILL_MATCH" not in clean
+    # the targeting var itself never reaches any worker
+    assert "MFM_CHAOS_KILL_REPLICA" not in victim
+    assert clean["KEEP"] == "x"
+
+
+# -- socket front end ---------------------------------------------------------
+
+def _client_roundtrip(addr, lines):
+    """One raw JSONL client: send all lines, half-close, read to EOF."""
+    with socket.create_connection(addr, timeout=30) as s:
+        s.sendall(("\n".join(lines) + "\n").encode())
+        s.shutdown(socket.SHUT_WR)
+        buf = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    return [json.loads(ln) for ln in buf.decode().splitlines()]
+
+
+def test_socket_frontend_concurrent_clients():
+    """3 concurrent connections: each reads exactly its own responses,
+    coalesced across connections but routed by origin."""
+    fe = SocketFrontend("127.0.0.1", 0)
+    backend = Coalescer(_server(batch_max=64), linger_s=0.02,
+                        deliver=fe.deliver)
+    fe.backend = backend
+    addr = fe.listen()
+    fe.start()
+    try:
+        all_lines = _mixed_lines(12, seed=23)
+        per_client = [all_lines[i::3] for i in range(3)]
+        results = [None] * 3
+
+        def go(ci):
+            results[ci] = _client_roundtrip(addr, per_client[ci])
+
+        ts = [threading.Thread(target=go, args=(ci,)) for ci in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        ref = _sequential_by_id(all_lines, batch_max=64)
+        for ci in range(3):
+            want_ids = [json.loads(ln)["id"] for ln in per_client[ci]]
+            got = results[ci]
+            assert got is not None and len(got) == len(want_ids)
+            assert sorted(r["id"] for r in got) == sorted(want_ids)
+            for r in got:
+                assert json.dumps(r, sort_keys=True) == ref[r["id"]]
+    finally:
+        fe.stop()
+
+
+def test_conn_delivery_never_blocks_on_slow_client(monkeypatch):
+    """Delivery runs under the coalescer lock, so it must never block on
+    a client socket: sends go through the per-connection outbox, and a
+    client that stops reading overflows its outbox and is dropped —
+    without ever stalling the delivering thread."""
+    from mfm_tpu.serve.frontend import _Conn
+
+    monkeypatch.setattr(_Conn, "OUTBOX_MAX", 8)
+    a, b = socket.socketpair()
+    try:
+        a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4096)
+        conn = _Conn(a, 0)
+        payload = "x" * 65536
+        t0 = time.monotonic()
+        results = [conn.send_line(payload) for _ in range(64)]
+        elapsed = time.monotonic() - t0
+        # enqueues are put_nowait: even with the peer never reading and
+        # the writer thread wedged in sendall, no call blocked
+        assert elapsed < 5.0
+        assert results[0] and not results[-1]
+        assert conn.closed
+    finally:
+        b.close()
+
+
+def test_http_frontend_post_and_healthz():
+    fe = SocketFrontend("127.0.0.1", 0, http=True)
+    backend = Coalescer(_server(batch_max=64), linger_s=0.02,
+                        deliver=fe.deliver)
+    fe.backend = backend
+    addr = fe.listen()
+    fe.start()
+    try:
+        lines = _mixed_lines(3, seed=29)
+        body = ("\n".join(lines) + "\n").encode()
+        with socket.create_connection(addr, timeout=30) as s:
+            s.sendall(b"POST / HTTP/1.1\r\nHost: x\r\n"
+                      + f"Content-Length: {len(body)}\r\n".encode()
+                      + b"Connection: close\r\n\r\n" + body)
+            raw = b""
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                raw += chunk
+        head, _, payload = raw.partition(b"\r\n\r\n")
+        assert head.startswith(b"HTTP/1.1 200")
+        resps = [json.loads(ln) for ln in payload.decode().splitlines()]
+        assert [r["id"] for r in resps] \
+            == [json.loads(ln)["id"] for ln in lines]
+        with socket.create_connection(addr, timeout=30) as s:
+            s.sendall(b"GET /healthz HTTP/1.1\r\nHost: x\r\n"
+                      b"Connection: close\r\n\r\n")
+            raw = b""
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                raw += chunk
+        head, _, payload = raw.partition(b"\r\n\r\n")
+        assert head.startswith(b"HTTP/1.1 200")
+        assert "requests_total" in json.loads(payload.decode())
+    finally:
+        fe.stop()
+
+
+def test_doctor_serve_accepts_fleet_manifest(tmp_path, capsys):
+    """S5: a fleet-only dir has no serve_manifest.json — the merged fleet
+    manifest carries the front end's serve summary and doctor --serve must
+    audit THAT instead of flagging the single-process file as missing."""
+    from mfm_tpu import cli
+    from mfm_tpu.data.artifacts import save_artifact
+    from mfm_tpu.obs.manifest import build_run_manifest, write_run_manifest
+    from mfm_tpu.serve.replica import FLEET_MANIFEST_NAME
+
+    d = str(tmp_path)
+    save_artifact(os.path.join(d, "x.npz"), {"a": np.zeros(2)})
+
+    def rc(args):
+        with pytest.raises(SystemExit) as exc:
+            cli.main(["doctor", *args])
+        return exc.value.code
+
+    assert rc([d, "--serve"]) == 1        # nothing to audit at all
+    ok = _StubReplica(0)
+    fleet, lines, got = _fleet_run([ok], n=4)
+    fleet.close_replicas()
+    fm = build_fleet_manifest({}, fleet, d)
+    serve_block = {"breaker_state": "closed", "breaker_open_total": 0,
+                   "shed_total": 0, "shed_rate": 0.0,
+                   "requests_total": fleet.accepted_total}
+    write_run_manifest(
+        os.path.join(d, FLEET_MANIFEST_NAME),
+        build_run_manifest(backend="cpu",
+                           health={"status": "ok", "checks": {}},
+                           extra={"serve": serve_block, "fleet": fm,
+                                  "trace_id": "a" * 32}))
+    capsys.readouterr()
+    assert rc([d, "--serve"]) == 0
+    recs = {r["kind"]: r for r in
+            json.loads(capsys.readouterr().out)["records"]}
+    srec = recs["serve_manifest"]
+    assert srec["status"] == "ok"
+    assert srec["file"].endswith(FLEET_MANIFEST_NAME)
+    assert srec["breaker_state"] == "closed"
+    frec = recs["fleet_manifest"]
+    assert frec["status"] == "ok" and frec["accepted_total"] == 4

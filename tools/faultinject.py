@@ -797,7 +797,10 @@ def run_query_kill(plan, base: Baseline, root: str) -> dict:
         return [sys.executable, "-m", "mfm_tpu.cli", "serve", path,
                 "--input", req, "--output", os.path.join(d, out_name),
                 "--dead-letter", os.path.join(d, "dead_letter.jsonl"),
-                "--batch-max", "8", "--deadline-s", "600", "--gulp"]
+                "--batch-max", "8", "--deadline-s", "600", "--gulp",
+                # fsync per emit: the durable-prefix assertion below then
+                # covers ServePolicy.fsync_emits, not just Python's flush
+                "--fsync-emits"]
 
     kill_env = {**env, "MFM_CHAOS_KILL": plan.param("point"),
                 "MFM_CHAOS_KILL_MATCH": plan.param("match")}
@@ -1255,6 +1258,99 @@ def run_grad_kill(plan, base: Baseline, root: str) -> dict:
             "recovered_entries": rep["n_entries"]}
 
 
+def run_fleet_kill(plan, base: Baseline, root: str) -> dict:
+    """fleet-kill-replica: SIGKILL one of three worker replicas mid-drain
+    (after it computed a batch, before its envelopes hit the pipe).  The
+    survivors must keep answering — the front end re-dispatches the dead
+    replica's in-flight batch, every request id gets a response bitwise
+    equal to the single-process replay, the merged fleet manifest counts
+    the loss while its delivery audit still balances, the checkpoint's
+    bytes are untouched, and ``doctor --serve`` stays green."""
+    n_replicas = int(plan.param("replicas", 3))
+    victim = int(plan.param("replica", 1))
+    d = _fresh_workdir(root, plan.name, base.snaps[0])
+    path = os.path.join(d, "state.npz")
+    k = _query_engine(path).K
+    req = os.path.join(d, "req.jsonl")
+    # 48 requests / batch-max 8 = 6 batches round-robin over 3 replicas:
+    # the victim (replica 1) sees global batches 1 and 4 as its local
+    # batch0/batch1 — MATCH=batch1 kills it on its SECOND batch, mid-run
+    with open(req, "w") as fh:
+        fh.write("\n".join(_query_requests(plan.seed, 48, k)) + "\n")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo_root}
+    with open(path, "rb") as fh:
+        state_bytes = fh.read()
+
+    fleet_cmd = [sys.executable, "-m", "mfm_tpu.cli", "serve", path,
+                 "--input", req, "--output", os.path.join(d, "resp_fleet.jsonl"),
+                 "--replicas", str(n_replicas), "--batch-max", "8",
+                 "--deadline-s", "600"]
+    kill_env = {**env, "MFM_CHAOS_KILL": plan.param("point"),
+                "MFM_CHAOS_KILL_MATCH": plan.param("match"),
+                "MFM_CHAOS_KILL_REPLICA": str(victim)}
+    proc = subprocess.run(fleet_cmd, env=kill_env, capture_output=True,
+                          text=True, timeout=600)
+    if proc.returncode != 0:
+        raise AssertionError(
+            f"{plan.name}: the front end must survive a replica's death, "
+            f"got rc={proc.returncode}\n{proc.stderr[-2000:]}")
+    with open(path, "rb") as fh:
+        if fh.read() != state_bytes:
+            raise AssertionError(f"{plan.name}: the checkpoint's bytes "
+                                 "changed under a read-only serving fleet")
+    shard = os.path.join(d, f"serve_manifest.r{victim}.json")
+    if os.path.exists(shard):
+        raise AssertionError(f"{plan.name}: the SIGKILLed replica left a "
+                             "manifest shard — it was not killed mid-drain")
+    fman = json.load(open(os.path.join(d, "fleet_manifest.json")))
+    fleet = fman["fleet"]
+    lost = [r["replica"] for r in fleet["replicas"] if r["lost"]]
+    if lost != [victim]:
+        raise AssertionError(f"{plan.name}: merged manifest counts lost "
+                             f"replicas {lost}, expected [{victim}]")
+    if not fleet["audit"]["consistent"]:
+        raise AssertionError(
+            f"{plan.name}: delivery audit broken — delivered "
+            f"{fleet['audit']['delivered_total']} (replicas "
+            f"{fleet['audit']['replica_outcomes_sum']} + frontend-local "
+            f"{fleet['audit']['frontend_local_total']}) of "
+            f"{fleet['audit']['accepted_total']} accepted requests (the "
+            "re-dispatch dropped the dead replica's batch)")
+
+    # single-process replay: the fleet's answers must be its prefix-free
+    # equal — same ids, same floats, same order
+    clean_cmd = [sys.executable, "-m", "mfm_tpu.cli", "serve", path,
+                 "--input", req, "--output", os.path.join(d, "resp_clean.jsonl"),
+                 "--batch-max", "8", "--deadline-s", "600", "--gulp"]
+    proc2 = subprocess.run(clean_cmd, env=env, capture_output=True,
+                           text=True, timeout=600)
+    if proc2.returncode != 0:
+        raise AssertionError(f"{plan.name}: single-process replay failed "
+                             f"rc={proc2.returncode}\n{proc2.stderr[-2000:]}")
+    with open(os.path.join(d, "resp_fleet.jsonl")) as fh:
+        fleet_resp = [ln for ln in fh.read().splitlines() if ln]
+    with open(os.path.join(d, "resp_clean.jsonl")) as fh:
+        clean_resp = [ln for ln in fh.read().splitlines() if ln]
+    if len(fleet_resp) != 48:
+        raise AssertionError(f"{plan.name}: fleet answered "
+                             f"{len(fleet_resp)}/48 requests")
+    if fleet_resp != clean_resp:
+        diverge = sum(1 for a, b in zip(fleet_resp, clean_resp) if a != b)
+        raise AssertionError(
+            f"{plan.name}: {diverge} fleet responses diverge from the "
+            "single-process replay — re-dispatch is not deterministic")
+    doc = subprocess.run([sys.executable, "-m", "mfm_tpu.cli", "doctor", d,
+                          "--serve"],
+                         env=env, capture_output=True, text=True, timeout=600)
+    if doc.returncode != 0:
+        raise AssertionError(f"{plan.name}: doctor --serve rejects the "
+                             f"post-kill directory\n{doc.stdout[-2000:]}")
+    return {"killed_replica": victim, "killed_at": plan.param("match"),
+            "survivors": n_replicas - 1, "responses": len(fleet_resp),
+            "replay": "bitwise", "doctor": "green"}
+
+
 RUNNERS = {"truncate": run_byte_fault, "corrupt": run_byte_fault,
            "kill": run_kill, "kill_manifest": run_kill_manifest,
            "nan_slab": run_poison, "outlier_slab": run_poison,
@@ -1265,7 +1361,8 @@ RUNNERS = {"truncate": run_byte_fault, "corrupt": run_byte_fault,
            "scenario_kill": run_scenario_kill,
            "scenario_poison": run_scenario_poison,
            "trace_kill": run_trace_kill, "eigen_kill": run_eigen_kill,
-           "shard_kill": run_shard_kill, "grad_kill": run_grad_kill}
+           "shard_kill": run_shard_kill, "grad_kill": run_grad_kill,
+           "fleet_kill": run_fleet_kill}
 
 
 def main(argv=None) -> int:

@@ -59,6 +59,9 @@ METRIC_SPECS = {
     "minvol_portfolios_per_sec_b100": ("higher", 0.20, None),
     "minvol_portfolios_per_sec_b10000": ("higher", 0.20, None),
     "reverse_scenarios_per_sec": ("higher", 0.20, None),
+    "fleet_qps": ("higher", 0.20, None),
+    "fleet_p99_latency_s": ("lower", 0.30, 0.05),
+    "coalesce_batch_fill_frac": ("higher", 0.20, None),
 }
 
 
@@ -88,6 +91,10 @@ def extract_metrics(rec) -> dict:
         for k in ("minvol_portfolios_per_sec_b100",
                   "minvol_portfolios_per_sec_b10000",
                   "reverse_scenarios_per_sec"):
+            out[k] = rec.get(k)
+    elif metric == "fleet_serving_throughput":
+        for k in ("fleet_qps", "fleet_p99_latency_s",
+                  "coalesce_batch_fill_frac"):
             out[k] = rec.get(k)
     return {k: v for k, v in out.items()
             if isinstance(v, (int, float)) and v == v}

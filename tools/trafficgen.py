@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Deterministic traffic generator for the serving fleet.
+
+Produces seeded JSONL request streams with a mixed shape — plain risk
+queries, benchmark (active-risk) queries, scenario-tagged queries and
+construction solves — and drives them at a target arrival rate:
+
+- **open loop**: requests arrive on a fixed schedule (``i / rate``)
+  regardless of how fast the service answers — the honest way to measure
+  sustained QPS and tail latency (a closed loop self-throttles and hides
+  queueing collapse).
+- **closed loop**: N virtual clients each keep exactly one request in
+  flight — the throughput ceiling under coordinated back-pressure.
+
+Everything is seeded: the same (seed, n, k, mix) produces byte-identical
+request lines, which is what lets ``bench.py --config fleet`` prove the
+coalesced responses bitwise-equal against the sequential loop, and lets
+the ``fleet-kill-replica`` chaos drill replay deterministically.
+
+As a script, writes the request stream to stdout (pipe into
+``mfm-tpu serve`` or a socket with ``nc``):
+
+    python tools/trafficgen.py --seed 7 --n 1000 --k 42 > req.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+#: request-shape mix: (plain query, benchmark query, scenario-tagged,
+#: construct) — must sum to 1
+DEFAULT_MIX = (0.55, 0.20, 0.15, 0.10)
+
+
+def gen_requests(seed: int, n: int, k: int, *, mix=DEFAULT_MIX,
+                 benchmark: str = "idx", scenario: str | None = None,
+                 deadline_s: float = 600.0) -> list:
+    """``n`` seeded JSONL request lines (ids ``t0..t{n-1}``), mixed per
+    ``mix``.  ``scenario=None`` drops the scenario slice into plain
+    queries (for servers without a scenario table).  Weights round to 6
+    decimals so lines are platform-stable."""
+    if abs(sum(mix) - 1.0) > 1e-9 or len(mix) != 4:
+        raise ValueError(f"mix must be 4 fractions summing to 1, got {mix}")
+    rng = np.random.default_rng(seed)
+    kinds = rng.choice(4, size=n, p=np.asarray(mix, dtype=np.float64))
+    lines = []
+    for i in range(n):
+        req = {"id": f"t{i}",
+               "weights": np.round(0.2 * rng.standard_normal(k), 6).tolist(),
+               "deadline_s": deadline_s}
+        kind = int(kinds[i])
+        if kind == 1:
+            req["benchmark"] = benchmark
+        elif kind == 2 and scenario is not None:
+            req["scenario"] = scenario
+        elif kind == 3:
+            req["construct"] = {"solver": "min_vol" if i % 2 else
+                                "risk_parity"}
+        lines.append(json.dumps(req, sort_keys=True))
+    return lines
+
+
+def open_loop(submit, lines, rate: float, *,
+              clock=time.monotonic, sleep=time.sleep) -> dict:
+    """Drive ``submit(line, ordinal)`` on the fixed arrival schedule
+    ``t0 + i/rate``.  Never skips a request when behind — a too-slow
+    service sees the backlog, which is the point of open loop.  Returns
+    the schedule: ``{"t0", "arrivals": [...], "offered_rate"}`` (arrival
+    = the scheduled time, the honest latency origin)."""
+    t0 = clock()
+    arrivals = []
+    for i, line in enumerate(lines):
+        due = t0 + i / rate
+        now = clock()
+        if due > now:
+            sleep(due - now)
+        arrivals.append(due)
+        submit(line, i)
+    return {"t0": t0, "arrivals": arrivals, "offered_rate": float(rate)}
+
+
+def closed_loop(submit_and_wait, lines, concurrency: int) -> dict:
+    """``concurrency`` virtual clients, one request in flight each.
+    ``submit_and_wait(line, ordinal)`` must block until the response.
+    Returns ``{"wall_s", "qps", "n"}``."""
+    it = iter(enumerate(lines))
+    lock = threading.Lock()
+
+    def worker():
+        while True:
+            with lock:
+                try:
+                    i, line = next(it)
+                except StopIteration:
+                    return
+            submit_and_wait(line, i)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return {"wall_s": wall, "qps": len(lines) / wall if wall else 0.0,
+            "n": len(lines)}
+
+
+def latency_stats(arrivals, completions) -> dict:
+    """p50/p99/max of (completion - arrival) for matched ordinals.
+    ``completions`` maps ordinal -> completion clock time; unanswered
+    ordinals are excluded (and counted)."""
+    lats = sorted(completions[i] - arrivals[i]
+                  for i in completions if i < len(arrivals))
+    if not lats:
+        return {"n": 0, "unanswered": len(arrivals)}
+
+    def q(p):
+        return lats[min(len(lats) - 1, int(p * len(lats)))]
+    return {"n": len(lats),
+            "unanswered": len(arrivals) - len(lats),
+            "p50_s": round(q(0.50), 6),
+            "p99_s": round(q(0.99), 6),
+            "max_s": round(lats[-1], 6)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="emit a seeded mixed JSONL request stream")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--n", type=int, default=1000)
+    ap.add_argument("--k", type=int, required=True,
+                    help="factor count of the served engine (weights "
+                         "length)")
+    ap.add_argument("--mix", default=",".join(str(m) for m in DEFAULT_MIX),
+                    help="plain,benchmark,scenario,construct fractions "
+                         f"(default {DEFAULT_MIX})")
+    ap.add_argument("--benchmark", default="idx")
+    ap.add_argument("--scenario", default=None,
+                    help="scenario tag for the scenario slice (default: "
+                         "fold into plain queries)")
+    ap.add_argument("--deadline-s", type=float, default=600.0)
+    args = ap.parse_args(argv)
+    mix = tuple(float(x) for x in args.mix.split(","))
+    for line in gen_requests(args.seed, args.n, args.k, mix=mix,
+                             benchmark=args.benchmark,
+                             scenario=args.scenario,
+                             deadline_s=args.deadline_s):
+        sys.stdout.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
