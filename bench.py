@@ -1341,6 +1341,223 @@ def bench_fleet():
             "closed_loop_concurrency": 32}
 
 
+def bench_cache():
+    """Config 10 (cache): the content-addressed response cache under
+    repeat-heavy Zipf traffic, plus the construct warm-start tier.
+
+    Measurements (tools/trafficgen.py --zipf drives the stream):
+
+    - **cached_qps / cache_hit_rate**: Zipf(1.0) over a 150-body pool at
+      a seeded open-loop schedule through a cache-fronted
+      :class:`Coalescer`.  ~99% of arrivals are repeats; hits answer
+      from the cache without touching admission, misses ride the normal
+      coalesced path and populate on delivery.  The timed window runs
+      under ``assert_max_compiles(0)`` — the cache is host-side dict
+      work, and every kernel shape was compiled in warmup.
+    - **bitwise proof**: every delivered response, stripped of the two
+      per-caller identity keys (id, trace_id), must be BYTE-identical to
+      a cache-off server's answer for the same request body — reuse is
+      exact, not approximate.
+    - **delivery audit**: delivered == computed (misses) + hits.
+    - **warm_start_solver_iters_saved**: near-miss construct books seed
+      the solver's warm-start blend at ``steps/4`` budget; parity deltas
+      (|dvol|, max |dw|) vs full-budget cold solves of the SAME books
+      are recorded — the documented "seeded, not bitwise" contract.
+    """
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import io
+    import threading
+
+    import trafficgen
+    from mfm_tpu.obs.instrument import cache_summary_from_registry
+    from mfm_tpu.serve import (
+        Coalescer, QueryEngine, QueryServer, ResponseCache, ServePolicy,
+        WarmStartIndex,
+    )
+    from mfm_tpu.utils.contracts import assert_max_compiles
+
+    K = 1 + 31 + 10          # country + industries + styles (config-1 shape)
+    rng = np.random.default_rng(0)
+    A = (rng.standard_normal((K, K)) / np.sqrt(K)).astype(np.float32)
+    cov = (A @ A.T + 1e-3 * np.eye(K, dtype=np.float32)) * 1e-4
+    bench_map = {"idx": 0.1 * rng.standard_normal(K)}
+    stressed = (cov * 1.21).astype(np.float32)
+
+    def mk_server(batch_max=256, warm_index=None):
+        eng = QueryEngine(cov, benchmarks=bench_map)
+        scen = {"stress": QueryEngine(stressed, benchmarks=bench_map)}
+        return QueryServer(eng, ServePolicy(batch_max=batch_max,
+                                            queue_max=65536,
+                                            default_deadline_s=600.0),
+                           health="ok", scenarios=scen,
+                           warm_index=warm_index)
+
+    wrng = np.random.default_rng(99)
+
+    def _wline(kind, i):
+        req = {"id": f"w{kind}{i}",
+               "weights": np.round(
+                   0.2 * wrng.standard_normal(K), 6).tolist(),
+               "deadline_s": 600.0}
+        if kind == "s":
+            req["scenario"] = "stress"
+        elif kind == "b":
+            req["benchmark"] = "idx"
+        elif kind == "mv":
+            req["construct"] = {"solver": "min_vol"}
+        elif kind == "rp":
+            req["construct"] = {"solver": "risk_parity"}
+        return json.dumps(req, sort_keys=True)
+
+    def warm(server, buckets):
+        for kind in ("q", "b", "s", "mv", "rp"):
+            for b in buckets:
+                for i in range(b):
+                    server.submit_line_routed(_wline(kind, b * 1000 + i),
+                                              origin=None)
+                while server._queue:
+                    server.drain_routed()
+
+    # -- Zipf(1.0) repeat-heavy stream ---------------------------------------
+    mix = (0.45, 0.20, 0.15, 0.20)
+    n, distinct, alpha = 40000, 150, 1.0
+    rate, linger = 14000.0, 0.05
+    lines = trafficgen.gen_zipf_requests(7, n, K, alpha=alpha,
+                                         distinct=distinct,
+                                         scenario="stress", mix=mix)
+
+    def _body_key(line):
+        o = json.loads(line)
+        o.pop("id", None)
+        o.pop("trace_id", None)
+        return json.dumps(o, sort_keys=True)
+
+    body_keys = [_body_key(ln) for ln in lines]
+
+    # -- cache-off reference: each unique BODY computed once ----------------
+    first = {}
+    for ln, bk in zip(lines, body_keys):
+        first.setdefault(bk, ln)
+    ref_buf = io.StringIO()
+    mk_server().run(list(first.values()), ref_buf, gulp=True)
+    id2key = {json.loads(ln)["id"]: bk for bk, ln in first.items()}
+    ref_body = {}
+    for ln in ref_buf.getvalue().splitlines():
+        o = json.loads(ln)
+        bk = id2key[o["id"]]
+        for ik in ("id", "trace_id"):
+            o.pop(ik, None)
+        ref_body[bk] = json.dumps(o, sort_keys=True)
+
+    # -- cached open loop ----------------------------------------------------
+    server = mk_server()
+    warm(server, (8, 32, 128, 512))
+    cache = ResponseCache(8192, 64 << 20)
+    completions, delivered = {}, {}
+    done = threading.Event()
+
+    def deliver(pairs):
+        now = time.monotonic()
+        for origin, resp in pairs:
+            completions[origin] = now
+            delivered[origin] = resp
+        if len(delivered) >= n:
+            done.set()
+
+    co = Coalescer(server, linger_s=linger, deliver=deliver, cache=cache)
+    co.start()
+    with assert_max_compiles(0, "cache steady state (post-warmup)"):
+        sched = trafficgen.open_loop(
+            lambda line, i: co.submit(line, origin=i), lines, rate)
+        done.wait(timeout=180.0)
+        co.stop()
+    if completions:
+        t_last = max(completions.values())
+        cached_qps = len(delivered) / max(t_last - sched["t0"], 1e-9)
+    else:
+        cached_qps = 0.0
+    lat = trafficgen.latency_stats(sched["arrivals"], completions)
+    cstats = cache.stats()
+    hit_rate = (cstats["hits"] / max(cstats["hits"] + cstats["misses"], 1))
+
+    mismatched = [i for i, resp in delivered.items()
+                  if json.dumps({k: v for k, v in resp.items()
+                                 if k not in ("id", "trace_id")},
+                                sort_keys=True) != ref_body[body_keys[i]]]
+
+    # -- construct warm-start tier -------------------------------------------
+    wi = WarmStartIndex(tol=0.05)
+    wserver = mk_server(batch_max=64, warm_index=wi)
+    cserver = mk_server(batch_max=64)          # cold parity reference
+    warm(wserver, (8,))
+    warm(cserver, (8,))
+    prng = np.random.default_rng(4242)
+    base = np.round(0.2 * prng.standard_normal(K), 6)
+    parity_dvol, parity_dw = 0.0, 0.0
+    for solver in ("min_vol", "risk_parity"):
+        seed_line = json.dumps(
+            {"id": f"seed-{solver}", "weights": base.tolist(),
+             "deadline_s": 600.0, "construct": {"solver": solver}},
+            sort_keys=True)
+        wserver.submit_line_routed(seed_line, origin=None)
+        wserver.drain_routed()                 # cold solve feeds the index
+        for t in range(4):
+            book = np.round(base + 0.002 * prng.standard_normal(K), 6)
+            wline = json.dumps(
+                {"id": f"wm-{solver}-{t}", "weights": book.tolist(),
+                 "deadline_s": 600.0, "construct": {"solver": solver}},
+                sort_keys=True)
+            wserver.submit_line_routed(wline, origin=None)
+            (_, wresp), = wserver.drain_routed()
+            assert wresp.get("warm_start", {}).get("used"), \
+                f"warm start did not fire for {solver} book {t}"
+            cserver.submit_line_routed(wline, origin=None)
+            (_, cresp), = cserver.drain_routed()
+            parity_dvol = max(parity_dvol,
+                              abs(wresp["total_vol"] - cresp["total_vol"]))
+            parity_dw = max(parity_dw, float(np.max(np.abs(
+                np.asarray(wresp["weights"])
+                - np.asarray(cresp["weights"])))))
+    wstats = wi.stats()
+
+    obs_cache = cache_summary_from_registry()
+    try:
+        with open(os.path.join(REPO, "BENCH_r07.json"),
+                  encoding="utf-8") as fh:
+            r07_qps = json.load(fh)["parsed"]["fleet_qps"]
+    except (OSError, ValueError, KeyError, TypeError):
+        r07_qps = None
+
+    return {"metric": "cache_serving_throughput",
+            "value": round(cached_qps),
+            "unit": "requests/s",
+            "vs_baseline": (round(cached_qps / r07_qps, 2)
+                            if r07_qps else None),
+            "k_factors": K, "n_requests": n,
+            "zipf_alpha": alpha, "distinct_bodies": distinct,
+            "offered_rate_rps": rate, "linger_s": linger,
+            "cached_qps": round(cached_qps, 1),
+            "cache_hit_rate": round(hit_rate, 4),
+            "cache_hits": cstats["hits"],
+            "cache_misses": cstats["misses"],
+            "cache_entries": cstats["entries"],
+            "cache_resident_bytes": cstats["resident_bytes"],
+            "baseline_fleet_qps_r07": r07_qps,
+            "cache_p50_latency_s": lat.get("p50_s"),
+            "cache_p99_latency_s": lat.get("p99_s"),
+            "cache_max_latency_s": lat.get("max_s"),
+            "hit_p99_latency_s": obs_cache.get("hit_p99_latency_s"),
+            "bitwise_identical_modulo_identity": not mismatched,
+            "bitwise_mismatches": len(mismatched),
+            "unanswered": lat.get("unanswered"),
+            "delivery_audit_ok": (len(delivered)
+                                  == cstats["hits"] + cstats["misses"]),
+            "warm_start_uses": wstats["uses"],
+            "warm_start_solver_iters_saved": wstats["steps_saved"],
+            "warm_start_parity_max_dvol": round(parity_dvol, 9),
+            "warm_start_parity_max_dw": round(parity_dw, 9)}
+
+
 CONFIGS = {
     "riskmodel": bench_riskmodel,
     "chunk_sweep": bench_chunk_sweep,
@@ -1353,6 +1570,7 @@ CONFIGS = {
     "scenario": bench_scenario,
     "grad": bench_grad,
     "fleet": bench_fleet,
+    "cache": bench_cache,
 }
 
 

@@ -1456,6 +1456,26 @@ def _doctor(args):
                             f"load shedding occurred (shed_total="
                             f"{serve.get('shed_total')}, shed_rate="
                             f"{serve.get('shed_rate')})")
+                    cb = serve.get("cache")
+                    if isinstance(cb, dict) and cb.get("delivered_total"):
+                        # delivery audit: every delivered response was
+                        # either computed (a recorded outcome) or a
+                        # cache hit — anything else means responses
+                        # were fabricated or lost around the cache
+                        rec["cache_hits_total"] = cb.get("hits_total")
+                        rec["cache_hit_rate"] = cb.get("hit_rate")
+                        rec["cache_delivered_total"] = cb.get(
+                            "delivered_total")
+                        computed = serve.get("requests_total") or 0
+                        expect = computed + (cb.get("hits_total") or 0)
+                        if cb["delivered_total"] != expect:
+                            rec["warnings"].append(
+                                "response-cache delivery audit is off: "
+                                f"delivered {cb['delivered_total']} != "
+                                f"computed {computed} + cache hits "
+                                f"{cb.get('hits_total')} — responses "
+                                "bypassed or double-counted the cache "
+                                "seat")
                     ckpt = man.get("checkpoint")
                     if ckpt and ckpt not in metas:
                         rec["warnings"].append(
@@ -1632,6 +1652,7 @@ def _serve(args):
     )
     from mfm_tpu.obs.metrics import REGISTRY
     from mfm_tpu.obs.trace import end_span
+    from mfm_tpu.serve.cache import ResponseCache, WarmStartIndex
     from mfm_tpu.serve.query import QueryEngine
     from mfm_tpu.serve.server import QueryServer, ServePolicy
 
@@ -1695,6 +1716,30 @@ def _serve(args):
         weight_mad_k=args.weight_mad_k,
         fsync_emits=args.fsync_emits)
 
+    def _scenario_hashes_beside() -> dict | None:
+        # the cache fences scenario-tagged requests on the served spec
+        # hash; absent manifest -> name-keyed fallback inside the cache
+        from mfm_tpu.scenario.manifest import (
+            ScenarioManifestError, read_scenario_manifest,
+            scenario_manifest_path_for,
+        )
+        try:
+            m = read_scenario_manifest(scenario_manifest_path_for(
+                os.path.dirname(state_path) or "."))
+        except (ScenarioManifestError, OSError):
+            return None
+        return {str(e.get("name")): str(e.get("spec_hash"))
+                for e in m.get("scenarios", []) if e.get("spec_hash")}
+
+    cache = None
+    if not (args.no_cache or getattr(args, "worker", False)):
+        cache = ResponseCache(
+            args.cache_entries, args.cache_bytes,
+            generation=int((meta or {}).get("generation") or 0),
+            scenario_hashes=_scenario_hashes_beside())
+    warm_index = (WarmStartIndex(tol=args.warm_tol)
+                  if args.warm_tol > 0 else None)
+
     reload_fn = None
     if args.watch:
         seen = {"gen": (read_pointer(state_path) or {}).get("generation")}
@@ -1717,13 +1762,19 @@ def _serve(args):
                       file=sys.stderr)
                 return None
             seen["gen"] = gen
+            if cache is not None:
+                # bump the fence BEFORE the engine swap lands: stale
+                # entries become unreachable, no sweep needed
+                cache.set_fence(
+                    generation=int(gen or 0),
+                    scenario_hashes=_scenario_hashes_beside())
             return {"engine": QueryEngine.from_risk_state(
                         st, mt, benchmarks=benchmarks),
                     "health": _health_beside()}
 
     server = QueryServer(engine, policy, health=_health_beside(),
                          dead_letter_path=args.dead_letter,
-                         reload_fn=reload_fn)
+                         reload_fn=reload_fn, warm_index=warm_index)
     man_dir = os.path.dirname(state_path) or "."
 
     def _finish(summary: dict, manifest_name: str, extra: dict) -> None:
@@ -1756,7 +1807,8 @@ def _serve(args):
         return
 
     if args.replicas or args.listen:
-        _serve_fleet(args, server, state_path, man_dir, _finish)
+        _serve_fleet(args, server, state_path, man_dir, _finish,
+                     cache=cache)
         return
 
     in_fp = (sys.stdin if args.input in (None, "-")
@@ -1764,7 +1816,7 @@ def _serve(args):
     out_fp = (sys.stdout if args.output in (None, "-")
               else open(args.output, "w", encoding="utf-8"))
     try:
-        summary = server.run(in_fp, out_fp, gulp=args.gulp)
+        summary = server.run(in_fp, out_fp, gulp=args.gulp, cache=cache)
     finally:
         if in_fp is not sys.stdin:
             in_fp.close()
@@ -1773,7 +1825,8 @@ def _serve(args):
     _finish(summary, SERVE_MANIFEST_NAME, {})
 
 
-def _serve_fleet(args, server, state_path, man_dir, _finish) -> None:
+def _serve_fleet(args, server, state_path, man_dir, _finish,
+                 cache=None) -> None:
     """The fleet/coalescing serve paths: ``--replicas N`` dispatches
     batches to worker subprocesses; ``--listen`` accepts concurrent
     socket (or ``--http``) connections; either alone also works —
@@ -1798,7 +1851,8 @@ def _serve_fleet(args, server, state_path, man_dir, _finish) -> None:
             "--deadline-s", str(args.deadline_s),
             "--breaker-failures", str(args.breaker_failures),
             "--breaker-cooldown-s", str(args.breaker_cooldown_s),
-            "--weight-mad-k", str(args.weight_mad_k)]
+            "--weight-mad-k", str(args.weight_mad_k),
+            "--warm-tol", str(args.warm_tol)]
         if args.benchmarks:
             policy_args += ["--benchmarks", args.benchmarks]
         if args.watch:
@@ -1814,8 +1868,9 @@ def _serve_fleet(args, server, state_path, man_dir, _finish) -> None:
     def make_backend(deliver=None):
         if args.replicas:
             return FleetServer(server, replicas, linger_s=args.linger_s,
-                               deliver=deliver)
-        return Coalescer(server, linger_s=args.linger_s, deliver=deliver)
+                               deliver=deliver, cache=cache)
+        return Coalescer(server, linger_s=args.linger_s, deliver=deliver,
+                         cache=cache)
 
     if args.listen:
         host, _, port = args.listen.rpartition(":")
@@ -2866,6 +2921,23 @@ def main(argv=None):
                     help="coalescer max-linger budget: the oldest "
                          "admitted request flushes after this wait even "
                          "if its bucket has not filled (default 0.01)")
+    sv.add_argument("--cache-entries", type=int, default=4096,
+                    help="response-cache entry bound: repeated request "
+                         "bodies answer from a content-addressed cache "
+                         "fenced on checkpoint generation + scenario "
+                         "spec hash (default 4096; docs/SERVING.md §9)")
+    sv.add_argument("--cache-bytes", type=int, default=64 << 20,
+                    help="response-cache resident-byte bound "
+                         "(default 64 MiB; LRU evicts past either bound)")
+    sv.add_argument("--no-cache", action="store_true",
+                    help="kill switch: disable the response cache "
+                         "entirely (every request computes)")
+    sv.add_argument("--warm-tol", type=float, default=0.0,
+                    help="construct warm-start tolerance: relative-L2 "
+                         "exposure distance under which a solved book "
+                         "seeds the next solve's warm-start blend "
+                         "(0 = off; warmed responses carry a "
+                         "warm_start parity stanza)")
     sv.add_argument("--worker", action="store_true",
                     help=argparse.SUPPRESS)   # internal: fleet replica
     sv.add_argument("--worker-id", type=int, default=0,

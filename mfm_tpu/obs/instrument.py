@@ -155,6 +155,42 @@ FLEET_REDISPATCH_TOTAL = REGISTRY.counter(
     "mfm_fleet_redispatch_total",
     "request lines re-dispatched after a replica death or quarantine")
 
+# -- response cache (serve/cache.py content-addressed reuse) ------------------
+
+CACHE_HITS_TOTAL = REGISTRY.counter(
+    "mfm_cache_hits_total",
+    "response-cache hits (cached body re-stamped with the caller's "
+    "id/trace_id)")
+CACHE_MISSES_TOTAL = REGISTRY.counter(
+    "mfm_cache_misses_total",
+    "response-cache misses (request rode the cold path)")
+CACHE_EVICTIONS_TOTAL = REGISTRY.counter(
+    "mfm_cache_evictions_total",
+    "entries evicted (LRU) by the entry/byte bounds — includes entries "
+    "stranded behind an old generation fence")
+CACHE_BYTES_TOTAL = REGISTRY.counter(
+    "mfm_cache_bytes_total",
+    "cumulative response-body bytes inserted into the cache")
+CACHE_ENTRIES = REGISTRY.gauge(
+    "mfm_cache_entries", "resident response-cache entries")
+CACHE_RESIDENT_BYTES = REGISTRY.gauge(
+    "mfm_cache_resident_bytes", "resident response-cache body bytes")
+CACHE_HIT_LATENCY_SECONDS = REGISTRY.histogram(
+    "mfm_cache_hit_latency_seconds",
+    "lookup-to-restamped-response wall time on a cache hit",
+    buckets=(0.000005, 0.00001, 0.000025, 0.00005, 0.0001, 0.00025,
+             0.0005, 0.001, 0.0025, 0.01))
+RESPONSES_DELIVERED_TOTAL = REGISTRY.counter(
+    "mfm_responses_delivered_total",
+    "responses delivered through the caching layer (hits + computed); "
+    "doctor --serve audits delivered == computed + hits")
+CONSTRUCT_WARM_STARTS_TOTAL = REGISTRY.counter(
+    "mfm_construct_warm_starts_total",
+    "construction solves seeded from a near-miss cached solution")
+CONSTRUCT_WARM_STEPS_SAVED_TOTAL = REGISTRY.counter(
+    "mfm_construct_warm_steps_saved_total",
+    "solver iterations saved by warm-started construction solves")
+
 # -- scenario engine (scenario/engine.py batched stress tests) ----------------
 
 SCENARIOS_RUN_TOTAL = REGISTRY.counter(
@@ -314,6 +350,7 @@ def serve_summary_from_registry() -> dict:
         "breaker_state": _BREAKER_CODE_STATE.get(state_code, "closed"),
         "query_p50_latency_s": (None if p50 != p50 else round(p50, 6)),
         "query_p99_latency_s": (None if p99 != p99 else round(p99, 6)),
+        "cache": cache_summary_from_registry(),
     }
 
 
@@ -330,6 +367,63 @@ def record_coalesce_flush(n_true: int, capacity: int, trigger: str,
 
 def record_frontend_connection(n: int = 1) -> None:
     FRONTEND_CONNECTIONS_TOTAL.inc(int(n))
+
+
+def record_cache_hit(latency_s: float) -> None:
+    CACHE_HITS_TOTAL.inc()
+    CACHE_HIT_LATENCY_SECONDS.observe(max(0.0, float(latency_s)))
+
+
+def record_cache_miss() -> None:
+    CACHE_MISSES_TOTAL.inc()
+
+
+def record_cache_store(size_bytes: int, evicted: int,
+                       entries_now: int, resident_now: int) -> None:
+    """Tally one cache insertion: bytes added, entries it displaced, and
+    the resulting occupancy gauges."""
+    CACHE_BYTES_TOTAL.inc(int(size_bytes))
+    if evicted:
+        CACHE_EVICTIONS_TOTAL.inc(int(evicted))
+    CACHE_ENTRIES.set_value(int(entries_now))
+    CACHE_RESIDENT_BYTES.set_value(int(resident_now))
+
+
+def record_responses_delivered(n: int = 1) -> None:
+    RESPONSES_DELIVERED_TOTAL.inc(int(n))
+
+
+def record_warm_start(steps_saved: int) -> None:
+    CONSTRUCT_WARM_STARTS_TOTAL.inc()
+    CONSTRUCT_WARM_STEPS_SAVED_TOTAL.inc(int(steps_saved))
+
+
+def cache_summary_from_registry() -> dict:
+    """The manifest's response-cache block, off the live counters.
+
+    ``delivered_total`` counts every response that left through the
+    caching layer; when a cache was active, ``mfm-tpu doctor --serve``
+    checks ``delivered_total == requests_total + hits_total`` (every
+    delivered response is exactly one of: computed with a recorded
+    outcome, or served from cache)."""
+    hits = int(CACHE_HITS_TOTAL.value())
+    misses = int(CACHE_MISSES_TOTAL.value())
+    looked = hits + misses
+    p99 = CACHE_HIT_LATENCY_SECONDS.quantile_est(0.99)
+    return {
+        "hits_total": hits,
+        "misses_total": misses,
+        "hit_rate": (round(hits / looked, 6) if looked else 0.0),
+        "evictions_total": int(CACHE_EVICTIONS_TOTAL.value()),
+        "entries": int(CACHE_ENTRIES.value()),
+        "resident_bytes": int(CACHE_RESIDENT_BYTES.value()),
+        "inserted_bytes_total": int(CACHE_BYTES_TOTAL.value()),
+        "delivered_total": int(RESPONSES_DELIVERED_TOTAL.value()),
+        "hit_p99_latency_s": (None if p99 != p99 else round(p99, 9)),
+        "warm_starts_total": int(CONSTRUCT_WARM_STARTS_TOTAL.value()),
+        "warm_steps_saved_total": int(
+            CONSTRUCT_WARM_STEPS_SAVED_TOTAL.value()),
+    }
 
 
 def record_fleet_dispatch(replica: int, n: int = 1) -> None:

@@ -16,11 +16,14 @@ layers share (MAD outliers, reason-bitmask plumbing) so they cannot
 drift.
 
 The fleet layer stacks on top of the single loop:
-:mod:`mfm_tpu.serve.coalesce` merges concurrent submissions into the
-bucket ladder under a linger budget, :mod:`mfm_tpu.serve.frontend`
-accepts concurrent socket/HTTP connections, and
-:mod:`mfm_tpu.serve.replica` runs N worker processes behind the fenced
-checkpoint store (docs/SERVING.md §"Fleet").
+:mod:`mfm_tpu.serve.cache` answers repeated request bodies from a
+bounded content-addressed response cache fenced on checkpoint
+generation + scenario spec hash, :mod:`mfm_tpu.serve.coalesce` merges
+concurrent submissions into the bucket ladder under a linger budget,
+:mod:`mfm_tpu.serve.frontend` accepts concurrent socket/HTTP
+connections, and :mod:`mfm_tpu.serve.replica` runs N worker processes
+behind the fenced checkpoint store (docs/SERVING.md §"Fleet", §9
+"Response cache").
 """
 
 from mfm_tpu.serve.guard import (  # noqa: F401
@@ -46,6 +49,12 @@ from mfm_tpu.serve.server import (  # noqa: F401
     ServePolicy,
     parse_request,
     req_reason_names,
+)
+from mfm_tpu.serve.cache import (  # noqa: F401
+    CacheFill,
+    ResponseCache,
+    WarmStartIndex,
+    cacheable_response,
 )
 from mfm_tpu.serve.coalesce import Coalescer  # noqa: F401
 from mfm_tpu.serve.frontend import SocketFrontend  # noqa: F401

@@ -39,6 +39,7 @@ import time
 from typing import Callable
 
 from mfm_tpu.obs import instrument as _obs
+from mfm_tpu.serve.cache import CacheFill
 from mfm_tpu.serve.query import bucket_for
 
 
@@ -56,20 +57,30 @@ class Coalescer:
         of ``(origin, resp)`` pairs as it is produced.  When set, submit/
         flush deliver through it and return ``[]``; when None, they return
         the pairs to the caller (the single-threaded test mode).
+      cache: optional :class:`~mfm_tpu.serve.cache.ResponseCache` sitting
+        between admission and the queue.  A hit answers from the cached
+        body (re-stamped with the caller's id/trace id) without touching
+        admission; a miss rides the unchanged path with its origin
+        wrapped in a ``CacheFill`` so delivery populates the entry.  The
+        cache is bypassed whenever the breaker is not closed — reject-
+        with-retry-after is the documented degraded behavior, and a
+        cache must never argue with the breaker.
     """
 
     def __init__(self, server, *, linger_s: float = 0.01,
                  clock: Callable[[], float] = time.monotonic,
-                 deliver=None):
+                 deliver=None, cache=None):
         if linger_s < 0:
             raise ValueError(f"linger_s must be >= 0, got {linger_s}")
         self.server = server
         self.linger_s = float(linger_s)
         self._clock = clock
         self._deliver = deliver
+        self.cache = cache
         self._lock = threading.RLock()
         self._wake = threading.Condition(self._lock)
         self._oldest_t: float | None = None   # enqueue time of queue head
+        self._last_poll = -float("inf")       # hit-path reload-poll stamp
         self._flusher: threading.Thread | None = None
         self._stopping = False
 
@@ -77,6 +88,10 @@ class Coalescer:
     def _emit(self, pairs):
         if not pairs:
             return []
+        if self.cache is not None:
+            # unwrap CacheFill origins (populating the cache from
+            # cacheable responses) and count every delivered response
+            pairs = self.cache.absorb(pairs)
         if self._deliver is not None:
             self._deliver(pairs)
             return []
@@ -104,6 +119,24 @@ class Coalescer:
         (rejections, dead-letter acks, shed notices) come back right away;
         admitted requests answer at the next flush.  Returns/delivers
         ``(origin, resp)`` pairs."""
+        if self.cache is not None:
+            # drains poll the checkpoint watch, but an all-hits streak
+            # never drains — without this throttled poll a pure repeat
+            # stream would keep answering from a retired generation
+            # forever.  The linger budget bounds hit-path fence
+            # staleness exactly as it bounds response latency.
+            now = self._clock()
+            if now - self._last_poll >= self.linger_s:
+                self._last_poll = now
+                with self._lock:
+                    self.server.poll_reload()
+            if self.server.breaker.state == "closed":
+                resp, token = self.cache.lookup(line)
+                if resp is not None:
+                    with self._lock:
+                        return self._emit([(origin, resp)])
+                if token is not None:
+                    origin = CacheFill(origin, token)
         with self._lock:
             was_empty = not self.server._queue
             pairs = list(self.server.submit_line_routed(line, origin))

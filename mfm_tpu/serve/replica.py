@@ -256,10 +256,11 @@ class FleetServer(Coalescer):
     pipe round trip)."""
 
     def __init__(self, server, replicas: list, *, linger_s: float = 0.01,
-                 clock=None, deliver=None):
+                 clock=None, deliver=None, cache=None):
         import time
         super().__init__(server, linger_s=linger_s,
-                         clock=clock or time.monotonic, deliver=deliver)
+                         clock=clock or time.monotonic, deliver=deliver,
+                         cache=cache)
         self.replicas = list(replicas)
         self.accepted_total = 0   # requests popped for dispatch
         #: outcome -> responses the FRONT END answered locally (deadline
@@ -276,6 +277,12 @@ class FleetServer(Coalescer):
         now = self._clock()
         lingered = (now - self._oldest_t) if self._oldest_t is not None else 0.0
         while self.server._queue:
+            # poll the checkpoint pointer HERE too (workers reload on
+            # their own): the admission engine, health stamp, and the
+            # response-cache fence must move with the fleet, or the
+            # front-end cache would keep answering from a retired
+            # generation after a hot reload
+            self.server.poll_reload()
             batch = []
             while (self.server._queue
                    and len(batch) < self.server.policy.batch_max):

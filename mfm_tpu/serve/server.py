@@ -470,12 +470,19 @@ class QueryServer:
         ``"scenario": name`` is answered from that engine; requests with
         no tag run the exact baseline path (bitwise-unchanged), and tags
         outside the table dead-letter with ``unknown_scenario``.
+      warm_index: optional :class:`~mfm_tpu.serve.cache.WarmStartIndex`.
+        When set, a construct request whose book is a near miss of a
+        previously solved one seeds the solver's warm-start blend with
+        the cached solution at a reduced step budget; the response
+        records the parity contract (``warm_start``).  Cold solves are
+        byte-for-byte unchanged (no extra field), so every bitwise
+        contract holds whenever the index finds nothing.
     """
 
     def __init__(self, engine, policy: ServePolicy | None = None, *,
                  health: str = "unknown", dead_letter_path=None,
                  clock: Callable[[], float] = time.monotonic,
-                 reload_fn=None, scenarios=None):
+                 reload_fn=None, scenarios=None, warm_index=None):
         self.engine = engine
         self.scenarios: dict = dict(scenarios or {})
         self.policy = policy or ServePolicy()
@@ -489,6 +496,7 @@ class QueryServer:
         self._dead_path = dead_letter_path
         self._dead_fp = None
         self._reload_fn = reload_fn
+        self.warm_index = warm_index
         if self.health == "degraded" and self.policy.breaker_on_degraded:
             self.breaker.force_open("health_degraded")
 
@@ -766,8 +774,15 @@ class QueryServer:
         jit call (the grad/construct.py kernels, padded to the portfolio
         bucket — <= 1 compile per (solver, bucket) in steady state), with
         the query path's breaker / outcome / span semantics.
-        Returns routed ``(origin, resp)`` pairs."""
-        from mfm_tpu.grad.engine import GradEngine
+
+        With a :attr:`warm_index`, requests whose books are near misses
+        of previously solved ones split into a second solve seeded from
+        the cached solutions at a reduced step budget (same kernel,
+        ``steps`` is a traced operand — no new compile).  Cold results
+        feed the index; warm results never do (no warm-from-warm
+        chaining).  Returns routed ``(origin, resp)`` pairs."""
+        from mfm_tpu.grad.engine import GradEngine, MINVOL_STEPS, \
+            RISKPARITY_STEPS
         out = []
         head = grp[0]
         bsp = _trace.start_span(
@@ -775,19 +790,44 @@ class QueryServer:
             parent_id=(head.span.span_id if head.span else None),
             batch=self._batch_i, scenario=scen, solver=solver, n=len(grp),
             trace_ids=[r.trace_id for r in grp[:32]])
+        full_steps = {"min_vol": MINVOL_STEPS,
+                      "risk_parity": RISKPARITY_STEPS}.get(solver)
+        seeds = [None] * len(grp)
+        if self.warm_index is not None and full_steps is not None:
+            for j, r in enumerate(grp):
+                seeds[j] = self.warm_index.nearest(solver, hmax, r.weights)
+        cold = [j for j in range(len(grp)) if seeds[j] is None]
+        warm = [j for j in range(len(grp)) if seeds[j] is not None]
+        warm_steps = (max(1, full_steps // self.warm_index.STEPS_DIVISOR)
+                      if warm else None)
         t0 = time.perf_counter()
         try:
             ge = GradEngine(np.asarray(engine._cov),
                             factor_names=engine.factor_names,
                             staleness=engine.staleness, dtype=engine.dtype)
-            W = np.stack([r.weights for r in grp]).astype(engine.dtype)
-            hmask = None
-            if solver == "hedge":
-                hmask = np.stack([
-                    r.construct["hedge_mask"]
-                    if r.construct["hedge_mask"] is not None
-                    else np.ones(ge.K) for r in grp]).astype(engine.dtype)
-            res = ge.construct_solve(solver, W, hedge_mask=hmask, hmax=hmax)
+            results: dict = {}
+            if cold:
+                W = np.stack([grp[j].weights
+                              for j in cold]).astype(engine.dtype)
+                hmask = None
+                if solver == "hedge":
+                    hmask = np.stack([
+                        grp[j].construct["hedge_mask"]
+                        if grp[j].construct["hedge_mask"] is not None
+                        else np.ones(ge.K) for j in cold]).astype(engine.dtype)
+                res = ge.construct_solve(solver, W, hedge_mask=hmask,
+                                         hmax=hmax)
+                for i, j in enumerate(cold):
+                    results[j] = (res["weights"][i], res["vols"][i],
+                                  res["diag"][i], False)
+            if warm:
+                Wseed = np.stack([seeds[j]
+                                  for j in warm]).astype(engine.dtype)
+                res = ge.construct_solve(solver, Wseed, hmax=hmax,
+                                         steps=warm_steps)
+                for i, j in enumerate(warm):
+                    results[j] = (res["weights"][i], res["vols"][i],
+                                  res["diag"][i], True)
         except Exception as e:   # noqa: BLE001 — any batch failure trips
             _trace.end_span(bsp, outcome="error")
             self.breaker.record_failure()
@@ -813,34 +853,56 @@ class QueryServer:
             _obs.record_query_latency(max(0.0, done - r.enq_t))
             if r.span is not None:
                 _trace.end_span(r.span, outcome="ok", batch=self._batch_i)
+            w_i, vol_i, diag_i, warmed = results[i]
             resp = {"id": r.rid, "ok": True, "outcome": "ok",
                     "kind": "construct", "solver": solver,
-                    "weights": np.asarray(res["weights"][i]).tolist(),
-                    "total_vol": float(res["vols"][i])}
-            diag = np.asarray(res["diag"][i])
+                    "weights": np.asarray(w_i).tolist(),
+                    "total_vol": float(vol_i)}
+            diag = np.asarray(diag_i)
             resp["diag"] = diag.tolist() if diag.ndim else float(diag)
+            if warmed:
+                # the parity contract: a seeded solve converged to the
+                # same optimum statistically, not bitwise — recorded,
+                # never silently passed off as an exact computation
+                resp["warm_start"] = {"used": True, "steps": warm_steps,
+                                      "steps_saved": full_steps - warm_steps,
+                                      "parity": "seeded"}
+                self.warm_index.record_use(warm_steps,
+                                           full_steps - warm_steps)
+            elif self.warm_index is not None and full_steps is not None:
+                self.warm_index.add(solver, hmax, r.weights,
+                                    np.asarray(w_i))
             out.append((r.origin,
                         self._stamp(resp, scenario_id=scen, engine=engine,
                                     trace_id=r.trace_id)))
         return out
 
     # -- the loop ------------------------------------------------------------
-    def run(self, lines, out_fp, *, gulp: bool = False) -> dict:
+    def run(self, lines, out_fp, *, gulp: bool = False, cache=None) -> dict:
         """Serve a JSONL stream: one request per line in, one response per
         event out.  ``gulp`` reads ALL input before the first drain — the
         deterministic overload mode (queue-overflow chaos plans and tests
         need shedding to depend only on the input, not on drain timing).
-        Returns the final serve summary (also the manifest block)."""
+        ``cache`` (a :class:`~mfm_tpu.serve.cache.ResponseCache`) answers
+        repeat bodies from the cached response re-stamped with the
+        caller's id/trace id, skipping admission — same semantics as the
+        coalescer's cache seat, bypassed whenever the breaker is not
+        closed.  Returns the final serve summary (the manifest block)."""
+        if cache is not None:
+            # deferred: serve/cache.py imports this module (no cycle)
+            from mfm_tpu.serve.cache import CacheFill
 
-        def emit(resps):
+        def emit(pairs):
             # flush per event batch: an emitted response is durable even if
             # the process is SIGKILLed before the next drain (the chaos
             # kill plans assert the survivor prefix replays bitwise).
             # fsync_emits extends that durability through the OS page
             # cache — flush alone only empties the Python-level buffer.
-            for r in resps:
+            if cache is not None:
+                pairs = cache.absorb(pairs)
+            for _, r in pairs:
                 out_fp.write(json.dumps(r, sort_keys=True) + "\n")
-            if resps:
+            if pairs:
                 out_fp.flush()
                 if self.policy.fsync_emits:
                     try:
@@ -848,17 +910,34 @@ class QueryServer:
                     except (OSError, ValueError):
                         pass  # not a real file (StringIO, closed pipe)
 
+        last_poll = -float("inf")
         for line in lines:
             line = line.strip()
             if not line:
                 continue
-            emit(self.submit_line(line))
+            origin = None
+            if cache is not None:
+                # drains poll the watch, but an all-hits streak never
+                # drains — bound the hit path's fence staleness too
+                # (0.05 s: the coalescer's default linger scale)
+                now = self._clock()
+                if now - last_poll >= 0.05:
+                    last_poll = now
+                    self.poll_reload()
+            if cache is not None and self.breaker.state == "closed":
+                resp, token = cache.lookup(line)
+                if resp is not None:
+                    emit([(None, resp)])
+                    continue
+                if token is not None:
+                    origin = CacheFill(None, token)
+            emit(self.submit_line_routed(line, origin))
             if not gulp and len(self._queue) >= self.policy.batch_max:
                 self.poll_reload()
-                emit(self.drain())
+                emit(self.drain_routed())
         while self._queue:
             self.poll_reload()
-            emit(self.drain())
+            emit(self.drain_routed())
         out_fp.flush()
         self.close()
         return _obs.serve_summary_from_registry()

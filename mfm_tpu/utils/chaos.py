@@ -292,4 +292,12 @@ def plan_suite(seed: int = 0) -> tuple:
         FaultPlan("fleet-kill-replica", "fleet_kill", s + 22,
                   (("point", "serve.after_batch"), ("match", "batch1"),
                    ("replica", 1), ("replicas", 3))),
+        # response cache (PR 16): hot-reload the checkpoint mid-stream
+        # under a cache-fronted server — no post-reload response may
+        # equal a pre-reload cached body (the generation fence makes the
+        # old entries unreachable), and a SIGKILL mid-reload replays
+        # bitwise against a cache-off run of the same stream
+        FaultPlan("cache-stale-generation", "cache_stale", s + 23,
+                  (("point", "save_artifact.after_tmp"),
+                   ("repeats", 6))),
     )

@@ -1,0 +1,462 @@
+"""Response cache (mfm_tpu/serve/cache.py): hits byte-identical to cold
+computation modulo the identity keys, uncacheable-outcome exclusion, the
+LRU entry/byte bounds + eviction accounting under a thread hammer, the
+generation/scenario fence-in-key invalidation, the hit-path reload poll
+(an all-hits stream must still move the fence), per-replica coherence
+through the fleet front end, and construct warm-start parity vs the cold
+solve."""
+
+import io
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from mfm_tpu.serve import (
+    CacheFill,
+    Coalescer,
+    FleetServer,
+    QueryEngine,
+    QueryServer,
+    ReplicaDeadError,
+    ResponseCache,
+    ServePolicy,
+    WarmStartIndex,
+    cacheable_response,
+)
+
+K = 4
+
+
+def _engine(scale=1.0):
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((K, K)) / 2
+    cov = (a @ a.T + 1e-3 * np.eye(K)) * 1e-4 * scale
+    return QueryEngine(cov, factor_names=["country", "ind0", "size", "mom"],
+                       benchmarks={"idx": rng.standard_normal(K)})
+
+
+def _server(batch_max=64, health="ok", **kw):
+    policy = ServePolicy(batch_max=batch_max, queue_max=4096,
+                        default_deadline_s=600.0)
+    return QueryServer(_engine(), policy, health=health,
+                       scenarios={"stress": _engine(scale=1.44)}, **kw)
+
+
+def _line(i, body_seed, **extra):
+    rng = np.random.default_rng(body_seed)
+    req = {"id": f"c{i}",
+           "weights": np.round(0.2 * rng.standard_normal(K), 6).tolist(),
+           "deadline_s": 600.0, **extra}
+    return json.dumps(req, sort_keys=True)
+
+
+def _strip(resp: dict) -> str:
+    return json.dumps({k: v for k, v in resp.items()
+                       if k not in ("id", "trace_id")}, sort_keys=True)
+
+
+def _ok_resp(i):
+    return {"id": f"r{i}", "ok": True, "outcome": "ok", "degraded": False,
+            "trace_id": f"t{i}", "total_vol": float(i)}
+
+
+# -- key derivation and cacheability ------------------------------------------
+
+def test_key_excludes_identity_keys():
+    cache = ResponseCache(8, 1 << 20)
+    k1, rid1, _ = cache.key_for(_line(0, body_seed=7))
+    k2, rid2, _ = cache.key_for(_line(1, body_seed=7))
+    assert k1 == k2 and (rid1, rid2) == ("c0", "c1")
+    k3, _, _ = cache.key_for(_line(2, body_seed=8))
+    assert k3 != k1
+
+
+def test_key_caller_trace_id_round_trips():
+    cache = ResponseCache(8, 1 << 20)
+    _, _, tid = cache.key_for(json.dumps(
+        {"id": "a", "trace_id": "mine", "weights": [0.1] * K}))
+    assert tid == "mine"
+    # no caller trace id -> the deterministic line hash the cold path stamps
+    from mfm_tpu.serve.server import _line_trace_id
+    line = json.dumps({"id": "a", "weights": [0.1] * K})
+    _, _, tid2 = cache.key_for(line)
+    assert tid2 == _line_trace_id(line)
+
+
+def test_unparseable_lines_uncacheable():
+    cache = ResponseCache(8, 1 << 20)
+    for bad in ('{"id": "x", "weights": [0.1,', '[1, 2, 3]', '"str"'):
+        assert cache.key_for(bad) is None
+        assert cache.lookup(bad) == (None, None)
+    assert cache.stats()["misses"] == 0   # uncacheable is not a miss
+
+
+def test_cacheable_response_predicate():
+    assert cacheable_response(_ok_resp(0))
+    assert not cacheable_response(dict(_ok_resp(0), degraded=True))
+    assert not cacheable_response(dict(_ok_resp(0), ok=False))
+    assert not cacheable_response(dict(_ok_resp(0), outcome="rejected"))
+    assert not cacheable_response(dict(_ok_resp(0), outcome="dead_letter"))
+    assert not cacheable_response(None)
+
+
+# -- hit == cold, byte for byte -----------------------------------------------
+
+@pytest.mark.parametrize("extra", [{}, {"benchmark": "idx"},
+                                   {"scenario": "stress"},
+                                   {"construct": {"solver": "min_vol"}}])
+def test_hit_bitwise_equal_to_cold_modulo_identity(extra):
+    """A hit re-stamped with the second caller's id/trace id must encode
+    byte-identically to what a cold server would compute for that exact
+    line — across every request type."""
+    cache = ResponseCache(64, 1 << 20)
+    co = Coalescer(_server(batch_max=8), linger_s=100.0, cache=cache)
+    first = _line(0, body_seed=5, **extra)
+    second = _line(1, body_seed=5, **extra)   # same body, different caller
+    cold_pairs = co.submit(first) + co.flush()
+    assert len(cold_pairs) == 1 and cold_pairs[0][1]["outcome"] == "ok"
+    hit_pairs = co.submit(second)             # answered without a drain
+    assert len(hit_pairs) == 1
+    assert cache.stats() == dict(cache.stats(), hits=1, misses=1)
+
+    out = io.StringIO()
+    _server(batch_max=8).run([second], out, gulp=True)
+    want = out.getvalue().splitlines()[0]
+    assert json.dumps(hit_pairs[0][1], sort_keys=True) == want
+
+
+def test_uncacheable_outcomes_never_stored():
+    # degraded stamps (health != ok) must not freeze into cached answers
+    cache = ResponseCache(64, 1 << 20)
+    co = Coalescer(_server(batch_max=8, health="unknown"), linger_s=100.0,
+                   cache=cache)
+    line = _line(0, body_seed=5)
+    pairs = co.submit(line) + co.flush()
+    assert pairs[0][1]["degraded"] is True
+    assert len(cache) == 0
+    again = co.submit(_line(1, body_seed=5)) + co.flush()
+    assert again[0][1]["outcome"] == "ok"     # still served, just not cached
+    assert cache.stats()["hits"] == 0 and cache.stats()["misses"] == 2
+
+    # dead-letter acks ride a CacheFill origin too and must be refused
+    cache2 = ResponseCache(64, 1 << 20)
+    co2 = Coalescer(_server(batch_max=8), linger_s=100.0, cache=cache2)
+    bad = json.dumps({"id": "bad", "weights": [float("nan")] * K})
+    acks = co2.submit(bad)
+    assert acks and acks[0][1]["outcome"] != "ok"
+    assert len(cache2) == 0
+
+
+def test_absorb_unwraps_cachefill_and_populates():
+    cache = ResponseCache(8, 1 << 20)
+    key, _, _ = cache.key_for(_line(0, body_seed=1))
+    pairs = cache.absorb([(CacheFill("conn7", key), _ok_resp(0)),
+                          ("conn8", _ok_resp(1))])
+    assert [o for o, _ in pairs] == ["conn7", "conn8"]   # wrapper never leaks
+    assert len(cache) == 1
+    resp, token = cache.lookup(_line(9, body_seed=1))
+    assert resp is not None and resp["id"] == "c9"
+    assert _strip(resp) == _strip(_ok_resp(0))
+
+
+# -- bounds and eviction ------------------------------------------------------
+
+def test_lru_entry_bound_and_recency():
+    cache = ResponseCache(4, 1 << 20)
+    lines = [_line(i, body_seed=100 + i) for i in range(6)]
+    for i, ln in enumerate(lines):
+        key, _, _ = cache.key_for(ln)
+        assert cache.put(key, _ok_resp(i))
+    assert len(cache) == 4 and cache.stats()["evictions"] == 2
+    assert cache.lookup(lines[0])[0] is None   # oldest two evicted
+    assert cache.lookup(lines[1])[0] is None
+    assert cache.lookup(lines[2])[0] is not None
+    # a hit refreshes recency: line 3 is touched, so inserting one more
+    # evicts line 4, not line 3
+    assert cache.lookup(lines[3])[0] is not None
+    key, _, _ = cache.key_for(_line(9, body_seed=900))
+    cache.put(key, _ok_resp(9))
+    assert cache.lookup(lines[3])[0] is not None
+    assert cache.lookup(lines[4])[0] is None
+
+
+def test_byte_bound_evicts_and_accounts():
+    one = len(json.dumps({k: v for k, v in _ok_resp(0).items()
+                          if k not in ("id", "trace_id")}, sort_keys=True))
+    cache = ResponseCache(100, max_bytes=2 * one + 1)
+    for i in range(5):
+        key, _, _ = cache.key_for(_line(i, body_seed=200 + i))
+        cache.put(key, _ok_resp(i))
+    assert len(cache) == 2 and cache.resident_bytes <= 2 * one + 1
+    assert cache.stats()["evictions"] == 3
+    # a body larger than the whole budget cannot become resident
+    tiny = ResponseCache(100, max_bytes=one - 1)
+    key, _, _ = tiny.key_for(_line(0, body_seed=0))
+    tiny.put(key, _ok_resp(0))
+    assert len(tiny) == 0 and tiny.resident_bytes == 0
+
+
+def test_thread_hammer_bounds_hold():
+    """8 threads hammer lookup/put over more distinct bodies than the
+    cache can hold: no exceptions, both bounds hold, the hit/miss tally
+    balances, and the resident-byte count matches the entries exactly."""
+    cache = ResponseCache(16, 8 << 10)
+    lines = [_line(i, body_seed=300 + i) for i in range(48)]
+    per_thread = 200
+    errors = []
+
+    def hammer(tid):
+        rng = np.random.default_rng(tid)
+        try:
+            for n in range(per_thread):
+                ln = lines[int(rng.integers(len(lines)))]
+                resp, token = cache.lookup(ln)
+                if resp is None and token is not None:
+                    cache.put(token, _ok_resp(n))
+        except Exception as e:   # noqa: BLE001 — surfaced via errors
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    s = cache.stats()
+    assert s["hits"] + s["misses"] == 8 * per_thread
+    assert s["entries"] <= 16 and s["resident_bytes"] <= 8 << 10
+    assert s["resident_bytes"] == sum(len(body) for body, _
+                                      in cache._entries.values())
+
+
+# -- fences -------------------------------------------------------------------
+
+def test_generation_fence_in_key():
+    cache = ResponseCache(8, 1 << 20, generation=3)
+    line = _line(0, body_seed=5)
+    key, _, _ = cache.key_for(line)
+    cache.put(key, _ok_resp(0))
+    assert cache.lookup(line)[0] is not None
+    cache.set_fence(generation=4)
+    assert cache.lookup(line)[0] is None       # invalidated without a sweep
+    key4, _, _ = cache.key_for(line)
+    cache.put(key4, _ok_resp(4))
+    assert len(cache) == 2                     # both generations resident
+    cache.set_fence(generation=3)
+    resp, _ = cache.lookup(line)
+    assert resp is not None and _strip(resp) == _strip(_ok_resp(0))
+
+
+def test_scenario_fence_invalidates_exactly_that_scenario():
+    cache = ResponseCache(8, 1 << 20, scenario_hashes={"stress": "h1"})
+    tagged = _line(0, body_seed=5, scenario="stress")
+    plain = _line(1, body_seed=5)
+    unknown = _line(2, body_seed=5, scenario="other")
+    for i, ln in enumerate((tagged, plain, unknown)):
+        key, _, _ = cache.key_for(ln)
+        cache.put(key, _ok_resp(i))
+    cache.set_fence(scenario_hashes={"stress": "h2"})
+    assert cache.lookup(tagged)[0] is None     # spec hash moved
+    assert cache.lookup(plain)[0] is not None  # untagged untouched
+    # names absent from the map fence on the name itself
+    assert cache.lookup(unknown)[0] is not None
+
+
+def test_hit_path_reload_poll_moves_fence():
+    """A pure repeat stream is all hits and never drains — the throttled
+    hit-path poll is the only thing that can run the reload.  Without it
+    the stream would answer from a retired generation forever."""
+    gen_b = _engine(scale=2.25)
+    cache = ResponseCache(8, 1 << 20, generation=0)
+    flips = {"armed": False}
+
+    def reload_fn():
+        if not flips["armed"]:
+            return None
+        flips["armed"] = False
+        cache.set_fence(generation=1)
+        return {"engine": gen_b, "health": "ok"}
+
+    t = {"now": 0.0}
+    server = QueryServer(_engine(), ServePolicy(batch_max=8,
+                                                default_deadline_s=600.0),
+                         health="ok", reload_fn=reload_fn)
+    co = Coalescer(server, linger_s=1.0, clock=lambda: t["now"], cache=cache)
+    pre = [(co.submit(_line(i, body_seed=5)) + co.flush())[0][1]
+           for i in range(4)]
+    assert cache.stats()["hits"] == 3
+    flips["armed"] = True
+    t["now"] = 5.0                    # past the linger budget: next submit polls
+    post = [(co.submit(_line(10 + i, body_seed=5)) + co.flush())[0][1]
+            for i in range(4)]
+    stale = {_strip(r) for r in pre}
+    assert all(_strip(r) not in stale for r in post)
+    assert post[0]["total_vol"] != pre[0]["total_vol"]
+    assert {_strip(r) for r in post[1:]} == {_strip(post[0])}  # re-warmed
+
+
+# -- coalescer / fleet coherence ----------------------------------------------
+
+def test_coalesced_cache_bitwise_vs_sequential():
+    """Mixed request types, each body submitted twice: the second round is
+    all hits, and every response — hit or cold — is byte-identical per id
+    to the plain sequential no-cache loop."""
+    kinds = [{}, {"benchmark": "idx"}, {"scenario": "stress"},
+             {"construct": {"solver": "min_vol"}},
+             {"construct": {"solver": "risk_parity"}}]
+    round1 = [_line(i, body_seed=400 + i, **kinds[i % 5]) for i in range(10)]
+    round2 = [_line(100 + i, body_seed=400 + i, **kinds[i % 5])
+              for i in range(10)]
+    cache = ResponseCache(64, 1 << 20)
+    co = Coalescer(_server(batch_max=16), linger_s=100.0, cache=cache)
+    got = {}
+    for ln in round1:
+        for _, r in co.submit(ln) + co.flush():
+            got[r["id"]] = r
+    for ln in round2:
+        for _, r in co.submit(ln) + co.flush():
+            got[r["id"]] = r
+    assert cache.stats()["hits"] == 10
+
+    out = io.StringIO()
+    _server(batch_max=16).run(round1 + round2, out, gulp=True)
+    ref = {json.loads(ln)["id"]: ln for ln in out.getvalue().splitlines()}
+    assert set(got) == set(ref)
+    for rid, r in got.items():
+        assert json.dumps(r, sort_keys=True) == ref[rid]
+
+
+class _StubProc:
+    def __init__(self):
+        self.rc = None
+
+    def poll(self):
+        return self.rc
+
+
+class _StubReplica:
+    """Duck-typed replica answering through a real in-process server, so
+    fleet responses stay bitwise-comparable to the sequential loop."""
+
+    def __init__(self, idx):
+        self.idx = idx
+        self.quarantined = False
+        self.delivered = {}
+        self.proc = _StubProc()
+        self._wserver = _server(batch_max=64)
+
+    @property
+    def alive(self):
+        return not self.quarantined and self.proc.poll() is None
+
+    def run_batch(self, lines):
+        resps = {}
+        for i, ln in enumerate(lines):
+            for o, r in self._wserver.submit_line_routed(ln, origin=i):
+                resps[o] = r
+        while self._wserver._queue:
+            for o, r in self._wserver.drain_routed():
+                resps[o] = r
+        return resps
+
+    def close(self, timeout=None):
+        if self.proc.rc is None:
+            self.proc.rc = 0
+        return self.proc.rc
+
+
+def test_fleet_cache_coherent_across_replicas():
+    """The cache sits in the front end, so which replica computed a miss
+    is invisible: a repeat round over a 2-replica fleet is all hits and
+    every response matches the single-process no-cache loop per id."""
+    cache = ResponseCache(64, 1 << 20)
+    fleet = FleetServer(_server(batch_max=4),
+                        [_StubReplica(0), _StubReplica(1)],
+                        linger_s=10.0, cache=cache)
+    round1 = [_line(i, body_seed=500 + i) for i in range(8)]
+    round2 = [_line(100 + i, body_seed=500 + i) for i in range(8)]
+    got = {}
+    for i, ln in enumerate(round1):
+        for _, r in fleet.submit(ln, origin=i):
+            got[r["id"]] = r
+    for _, r in fleet.flush():
+        got[r["id"]] = r
+    for i, ln in enumerate(round2):
+        for _, r in fleet.submit(ln, origin=100 + i):
+            got[r["id"]] = r
+    for _, r in fleet.stop():
+        got[r["id"]] = r
+    fleet.close_replicas()
+    assert cache.stats()["hits"] == 8
+
+    out = io.StringIO()
+    _server(batch_max=4).run(round1 + round2, out, gulp=True)
+    ref = {json.loads(ln)["id"]: ln for ln in out.getvalue().splitlines()}
+    assert set(got) == set(ref)
+    for rid, r in got.items():
+        assert json.dumps(r, sort_keys=True) == ref[rid]
+
+
+# -- warm-start tier ----------------------------------------------------------
+
+def test_warm_index_nearest_tolerance():
+    idx = WarmStartIndex(tol=0.05, per_solver=4)
+    base = np.full(K, 0.5)
+    solved = np.full(K, 0.25)
+    idx.add("min_vol", 0.0, base, solved)
+    near = base + 0.01
+    got = idx.nearest("min_vol", 0.0, near)
+    assert got is not None and np.array_equal(got, solved)
+    got[:] = -1.0                                  # callers get a copy
+    assert np.array_equal(idx.nearest("min_vol", 0.0, near), solved)
+    assert idx.nearest("min_vol", 0.0, base + 10.0) is None   # outside tol
+    assert idx.nearest("risk_parity", 0.0, near) is None      # other solver
+    assert idx.nearest("min_vol", 0.5, near) is None          # other hmax
+
+
+def test_warm_start_parity_vs_cold():
+    """A near-miss construct solve seeds from the cached solution at the
+    reduced step budget, records the parity contract on the response, and
+    converges to the cold optimum within tolerance; a far book stays cold
+    and byte-identical to the no-index server."""
+    from mfm_tpu.grad.engine import MINVOL_STEPS
+
+    warm_idx = WarmStartIndex(tol=0.05)
+    ws = _server(batch_max=8, warm_index=warm_idx)
+    cs = _server(batch_max=8)
+    rng = np.random.default_rng(4242)
+    base = np.round(0.2 * rng.standard_normal(K), 6)
+
+    def solve(server, book, rid):
+        server.submit_line(json.dumps(
+            {"id": rid, "weights": book.tolist(), "deadline_s": 600.0,
+             "construct": {"solver": "min_vol"}}, sort_keys=True))
+        (resp,) = server.drain()
+        assert resp["outcome"] == "ok"
+        return resp
+
+    seed = solve(ws, base, "seed")
+    assert "warm_start" not in seed                # cold solves unmarked
+
+    near = np.round(base + 0.002 * rng.standard_normal(K), 6)
+    warm = solve(ws, near, "warm")
+    cold = solve(cs, near, "cold")
+    steps = max(1, MINVOL_STEPS // WarmStartIndex.STEPS_DIVISOR)
+    assert warm["warm_start"] == {"used": True, "steps": steps,
+                                  "steps_saved": MINVOL_STEPS - steps,
+                                  "parity": "seeded"}
+    assert "warm_start" not in cold
+    assert abs(warm["total_vol"] - cold["total_vol"]) <= 1e-5
+    assert np.max(np.abs(np.array(warm["weights"])
+                         - np.array(cold["weights"]))) <= 0.01
+    assert warm_idx.stats()["uses"] == 1
+    assert warm_idx.stats()["steps_saved"] == MINVOL_STEPS - steps
+
+    far = np.round(base + np.linspace(1.0, 2.0, K), 6)
+    far_ws = solve(ws, far, "far")
+    far_cs = solve(cs, far, "far")
+    assert "warm_start" not in far_ws
+    assert json.dumps(far_ws, sort_keys=True) == \
+        json.dumps(far_cs, sort_keys=True)

@@ -6,8 +6,8 @@
 #   tools/bench_all.sh [OUTDIR]
 #
 # Configs (bench.py): default = config 1 (risk model e2e, the driver metric),
-# beta, factors, alla, alpha, query, scenario, grad, fleet.  Each prints ONE
-# JSON line; a
+# beta, factors, alla, alpha, query, scenario, grad, fleet, cache.  Each
+# prints ONE JSON line; a
 # dead TPU tunnel falls back to CPU with an `errors` field rather than
 # hanging.
 #
@@ -51,6 +51,7 @@ python bench.py --config query   "${plat[@]}" | tail -1 > "$out/config6_query.js
 python bench.py --config scenario "${plat[@]}" | tail -1 > "$out/config7_scenario.json"
 python bench.py --config grad    "${plat[@]}" | tail -1 > "$out/config8_grad.json"
 python bench.py --config fleet   "${plat[@]}" | tail -1 > "$out/config9_fleet.json"
+python bench.py --config cache   "${plat[@]}" | tail -1 > "$out/config10_cache.json"
 
 # universe-scaling smoke (slow; skip with MFM_SKIP_UNIVERSE_SMOKE=1): the
 # full A-share universe (N=5000) on an 8-device host mesh, time-bounded by
@@ -83,7 +84,7 @@ python tools/profile_eigen.py --json "$out/eigen_sweep.json" \
 # numbers are a finding, not evidence to file.
 for rec in "$out/config1_risk.json" "$out/config6_query.json" \
            "$out/config7_scenario.json" "$out/config8_grad.json" \
-           "$out/config9_fleet.json"; do
+           "$out/config9_fleet.json" "$out/config10_cache.json"; do
   python tools/perfgate.py "$rec" \
     || { echo "perfgate: $rec regressed vs the BENCH_r*.json trajectory" >&2
          exit 1; }
@@ -103,10 +104,15 @@ done
 # rename must tear neither report nor checkpoint (config 8's evidence),
 # and the serving fleet: SIGKILL 1 of 3 worker replicas mid-drain — the
 # survivors keep answering, every response bitwise the single-process
-# replay's, the merged fleet manifest counts the loss (config 9's evidence)
+# replay's, the merged fleet manifest counts the loss (config 9's evidence),
+# and the response cache: a hot reload mid repeat-stream must move the
+# generation fence (no post-reload answer equals a pre-reload cached
+# body), and after a SIGKILL-torn checkpoint publish a cache-on serve
+# must replay byte-for-byte against a cache-off run (config 10's
+# evidence)
 python tools/faultinject.py --plans \
-  query-kill-mid-batch,query-poison-slab,query-overflow-storm,query-ckpt-swap,query-steady-state,scenario-kill-mid-batch,scenario-poison-spec,trace-kill-mid-flush,eigen-kill-mid-update,shard-kill-mid-append,grad-kill-mid-solve,fleet-kill-replica \
-  || { echo "query/scenario/trace/grad/fleet chaos plans failed — config6/7/8/9 numbers are not evidence" >&2
+  query-kill-mid-batch,query-poison-slab,query-overflow-storm,query-ckpt-swap,query-steady-state,scenario-kill-mid-batch,scenario-poison-spec,trace-kill-mid-flush,eigen-kill-mid-update,shard-kill-mid-append,grad-kill-mid-solve,fleet-kill-replica,cache-stale-generation \
+  || { echo "query/scenario/trace/grad/fleet/cache chaos plans failed — config6/7/8/9/10 numbers are not evidence" >&2
        exit 1; }
 
 cat "$out"/config*.json

@@ -1351,6 +1351,213 @@ def run_fleet_kill(plan, base: Baseline, root: str) -> dict:
             "replay": "bitwise", "doctor": "green"}
 
 
+def run_cache_stale(plan, base: Baseline, root: str) -> dict:
+    """cache-stale-generation: the response cache must never outlive its
+    checkpoint generation.  Phase 1 (in-process): a pure repeat stream is
+    all hits — it never drains, so the fence can only move via the
+    throttled hit-path reload poll.  After a hot swap to gen B no response
+    body may equal the pre-reload cached body, and the stream must re-warm
+    on gen B (exactly one miss, then hits again under the new fence).
+    Phase 2 (subprocess): SIGKILL a real `risk --update` after the tmp
+    write (torn publish — the pointer never flipped), then a cache-ON
+    ``--watch`` serve over a repeat stream must replay byte-for-byte per
+    request id against a cache-OFF run: the torn tmp moves neither the
+    fence nor a single float."""
+    from mfm_tpu.data.artifacts import read_pointer
+    from mfm_tpu.obs.manifest import read_run_manifest
+    from mfm_tpu.serve import (
+        Coalescer, QueryServer, ResponseCache, ServePolicy,
+    )
+
+    repeats = int(plan.param("repeats", 6))
+    d = _fresh_workdir(root, plan.name, base.snaps[0])             # gen A
+    d2 = _fresh_workdir(root, plan.name + "-next", base.snaps[1])  # gen B
+    path_a = os.path.join(d, "state.npz")
+    path_b = os.path.join(d2, "state.npz")
+    engine_a = _query_engine(path_a)
+    engine_b = _query_engine(path_b)
+    k = engine_a.K
+    w = np.round(np.random.default_rng(plan.seed).normal(0.0, 1.0, k), 6)
+    ref_a = _query_engine(path_a).query(w[None].astype(engine_a.dtype))
+    ref_b = _query_engine(path_b).query(w[None].astype(engine_b.dtype))
+    # the reference must be discriminating: if both generations answer the
+    # repeat body identically, a stale hit would be invisible
+    if np.array_equal(np.asarray(ref_a.total_vol),
+                      np.asarray(ref_b.total_vol)):
+        raise AssertionError(f"{plan.name}: generations A and B answer "
+                             "identically — the staleness check proves "
+                             "nothing")
+
+    gen_a = int((read_pointer(path_a) or {}).get("generation") or 0)
+    cache = ResponseCache(64, 1 << 20, generation=gen_a)
+    flips = {"armed": False, "done": False}
+
+    def reload_fn():
+        if not flips["armed"] or flips["done"]:
+            return None
+        flips["done"] = True
+        # what the CLI's watch closure does: bump the fence BEFORE the
+        # engine swap lands
+        cache.set_fence(generation=gen_a + 1)
+        return {"engine": engine_b, "health": "ok"}
+
+    t = {"now": 0.0}
+    server = QueryServer(engine_a,
+                         ServePolicy(batch_max=8, default_deadline_s=600.0),
+                         health="ok", reload_fn=reload_fn)
+    co = Coalescer(server, linger_s=1.0, clock=lambda: t["now"], cache=cache)
+    wlist = w.tolist()
+
+    def ask(tag, i):
+        line = json.dumps({"id": f"{tag}{i}", "weights": wlist,
+                           "deadline_s": 600.0}, sort_keys=True)
+        pairs = co.submit(line) + co.flush()
+        if len(pairs) != 1 or pairs[0][1].get("outcome") != "ok":
+            raise AssertionError(f"{plan.name}: {tag}{i} answered "
+                                 f"{[p[1] for p in pairs]}, expected one ok")
+        return pairs[0][1]
+
+    def body(r):
+        return json.dumps({f: v for f, v in r.items()
+                           if f not in ("id", "trace_id")}, sort_keys=True)
+
+    pre = [ask("pre", i) for i in range(repeats)]
+    s0 = cache.stats()
+    if (s0["misses"], s0["hits"]) != (1, repeats - 1):
+        raise AssertionError(f"{plan.name}: pre-reload repeat stream was "
+                             f"not 1 miss + {repeats - 1} hits: {s0}")
+    for i, r in enumerate(pre):
+        if r["total_vol"] != float(ref_a.total_vol[0]):
+            raise AssertionError(f"{plan.name}: pre{i} not served bitwise "
+                                 "from gen A")
+
+    # arm the swap and advance the fake clock past the linger budget: the
+    # FIRST post submit's throttled hit-path poll must perform the reload
+    # (the stream is all-hits — nothing else ever drains)
+    flips["armed"] = True
+    t["now"] = 5.0
+    post = [ask("post", i) for i in range(repeats)]
+    if not flips["done"]:
+        raise AssertionError(f"{plan.name}: the hit-path poll never ran "
+                             "the reload — an all-hits stream would serve "
+                             "a retired generation forever")
+    stale = {body(r) for r in pre}
+    for i, r in enumerate(post):
+        if body(r) in stale:
+            raise AssertionError(f"{plan.name}: post{i} served the "
+                                 "pre-reload cached body after the "
+                                 "generation fence moved")
+        if r["total_vol"] != float(ref_b.total_vol[0]):
+            raise AssertionError(f"{plan.name}: post{i} not served bitwise "
+                                 "from gen B")
+    s1 = cache.stats()
+    if (s1["misses"] - s0["misses"],
+            s1["hits"] - s0["hits"]) != (1, repeats - 1):
+        raise AssertionError(f"{plan.name}: post-reload stream did not "
+                             f"re-warm under the new fence (want 1 miss + "
+                             f"{repeats - 1} hits): {s1} vs {s0}")
+
+    # -- phase 2: torn publish under a cache-fronted --watch serve -----------
+    point = plan.param("point")
+    dk = _fresh_workdir(root, plan.name + "-kill", base.snaps[0])
+    path = os.path.join(dk, "state.npz")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo_root}
+
+    def _update_cmd(slab_idx):
+        table_csv = os.path.join(dk, f"slab{slab_idx}.csv")
+        base.slabs[slab_idx].to_csv(table_csv, index=False)
+        return [sys.executable, "-m", "mfm_tpu.cli", "risk",
+                "--barra", table_csv, "--update", path, "--quarantine",
+                "--eigen-sims", str(EIGEN_SIMS),
+                "--eigen-sim-length", str(T_TOTAL),
+                "--out", os.path.join(dk, "tables")]
+
+    # a clean slab-0 update first: it leaves a healthy run manifest beside
+    # the checkpoint, so the serve below stamps health=ok (an "unknown"
+    # verdict marks every response degraded, hence uncacheable)
+    ok_upd = subprocess.run(_update_cmd(0), env=env, capture_output=True,
+                            text=True, timeout=600)
+    if ok_upd.returncode != 0:
+        raise AssertionError(f"{plan.name}: the healthy slab-0 update "
+                             f"failed rc={ok_upd.returncode}\n"
+                             f"{ok_upd.stderr[-2000:]}")
+    # the tiny synthetic panel legitimately trips factor_ret_outlier_frac,
+    # which would stamp every response degraded (uncacheable) and open the
+    # breaker — overwrite the verdict through the real manifest API so the
+    # serve below sees the healthy-shop precondition this plan is about
+    from mfm_tpu.obs.manifest import write_run_manifest
+    rman = read_run_manifest(dk)
+    rman["health"] = {"status": "ok", "checks": {}}
+    write_run_manifest(dk, rman)
+    with open(path, "rb") as fh:
+        state_bytes = fh.read()
+    upd = subprocess.run(_update_cmd(1),
+                         env={**env, "MFM_CHAOS_KILL": point},
+                         capture_output=True, text=True, timeout=600)
+    if upd.returncode != -signal.SIGKILL:
+        raise AssertionError(f"{plan.name}: expected the update to die by "
+                             f"SIGKILL at {point}, got rc={upd.returncode}\n"
+                             f"{upd.stderr[-2000:]}")
+    with open(path, "rb") as fh:
+        if fh.read() != state_bytes:
+            raise AssertionError(f"{plan.name}: the torn publish mutated "
+                                 "the live checkpoint's bytes")
+
+    rng = np.random.default_rng(plan.seed + 1)
+    bodies = [np.round(rng.normal(0.0, 1.0, k), 6).tolist()
+              for _ in range(4)]
+    n = 4 * repeats
+    lines = [json.dumps({"id": f"r{i}", "weights": bodies[i % 4],
+                         "deadline_s": 600.0}, sort_keys=True)
+             for i in range(n)]
+    req = os.path.join(dk, "req.jsonl")
+    with open(req, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+
+    def _serve(out_name, *extra):
+        # no --gulp: gulp mode admits ALL lines before the first drain,
+        # so nothing would ever hit — batch-max 8 over 4 distinct bodies
+        # computes the first two batches' worth and hits the rest
+        cmd = [sys.executable, "-m", "mfm_tpu.cli", "serve", path,
+               "--input", req, "--output", os.path.join(dk, out_name),
+               "--batch-max", "8", "--deadline-s", "600", "--watch",
+               *extra]
+        proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                              timeout=600)
+        if proc.returncode != 0:
+            raise AssertionError(f"{plan.name}: serve "
+                                 f"{extra or ('cache on',)} failed "
+                                 f"rc={proc.returncode}\n"
+                                 f"{proc.stderr[-2000:]}")
+        with open(os.path.join(dk, out_name)) as fh:
+            return {json.loads(ln)["id"]: ln
+                    for ln in fh.read().splitlines() if ln}
+
+    on = _serve("resp_cache_on.jsonl")
+    # read the cache-on manifest NOW — the cache-off replay overwrites it
+    man = read_run_manifest(os.path.join(dk, "serve_manifest.json"))
+    cb = (man.get("serve") or {}).get("cache") or {}
+    if not cb.get("hits_total"):
+        raise AssertionError(f"{plan.name}: the cache-on run recorded no "
+                             "hits — the bitwise replay proves nothing")
+    off = _serve("resp_cache_off.jsonl", "--no-cache")
+    ids = {f"r{i}" for i in range(n)}
+    if set(on) != ids or set(off) != ids:
+        raise AssertionError(f"{plan.name}: answered {len(on)} cached / "
+                             f"{len(off)} uncached of {n} requests")
+    diverged = [i for i in sorted(ids) if on[i] != off[i]]
+    if diverged:
+        raise AssertionError(f"{plan.name}: {len(diverged)} responses "
+                             f"diverge between the cache-on and cache-off "
+                             f"runs (first: {diverged[0]}) — the torn "
+                             "publish perturbed the cache-fronted replay")
+    return {"reload": "fence moved via hit-path poll",
+            "pre_hits": repeats - 1, "rewarm_misses": 1,
+            "killed_at": point, "cache_on_hits": int(cb["hits_total"]),
+            "replay": "bitwise per id", "responses": n}
+
+
 RUNNERS = {"truncate": run_byte_fault, "corrupt": run_byte_fault,
            "kill": run_kill, "kill_manifest": run_kill_manifest,
            "nan_slab": run_poison, "outlier_slab": run_poison,
@@ -1362,7 +1569,7 @@ RUNNERS = {"truncate": run_byte_fault, "corrupt": run_byte_fault,
            "scenario_poison": run_scenario_poison,
            "trace_kill": run_trace_kill, "eigen_kill": run_eigen_kill,
            "shard_kill": run_shard_kill, "grad_kill": run_grad_kill,
-           "fleet_kill": run_fleet_kill}
+           "fleet_kill": run_fleet_kill, "cache_stale": run_cache_stale}
 
 
 def main(argv=None) -> int:

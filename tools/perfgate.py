@@ -62,6 +62,9 @@ METRIC_SPECS = {
     "fleet_qps": ("higher", 0.20, None),
     "fleet_p99_latency_s": ("lower", 0.30, 0.05),
     "coalesce_batch_fill_frac": ("higher", 0.20, None),
+    "cached_qps": ("higher", 0.20, None),
+    "cache_hit_rate": ("higher", 0.05, None),
+    "cache_p99_latency_s": ("lower", 0.30, 0.05),
 }
 
 
@@ -95,6 +98,10 @@ def extract_metrics(rec) -> dict:
     elif metric == "fleet_serving_throughput":
         for k in ("fleet_qps", "fleet_p99_latency_s",
                   "coalesce_batch_fill_frac"):
+            out[k] = rec.get(k)
+    elif metric == "cache_serving_throughput":
+        for k in ("cached_qps", "cache_hit_rate",
+                  "cache_p99_latency_s"):
             out[k] = rec.get(k)
     return {k: v for k, v in out.items()
             if isinstance(v, (int, float)) and v == v}

@@ -17,10 +17,16 @@ request lines, which is what lets ``bench.py --config fleet`` prove the
 coalesced responses bitwise-equal against the sequential loop, and lets
 the ``fleet-kill-replica`` chaos drill replay deterministically.
 
+``--zipf ALPHA --distinct N`` switches to repeat-heavy traffic: bodies
+draw Zipf(ALPHA) from a pool of N unique requests (ids stay unique) —
+the stream shape the content-addressed response cache is built for.
+
 As a script, writes the request stream to stdout (pipe into
 ``mfm-tpu serve`` or a socket with ``nc``):
 
     python tools/trafficgen.py --seed 7 --n 1000 --k 42 > req.jsonl
+    python tools/trafficgen.py --seed 7 --n 20000 --k 42 \\
+        --zipf 1.0 --distinct 150 > zipf.jsonl
 """
 
 from __future__ import annotations
@@ -62,6 +68,41 @@ def gen_requests(seed: int, n: int, k: int, *, mix=DEFAULT_MIX,
         elif kind == 3:
             req["construct"] = {"solver": "min_vol" if i % 2 else
                                 "risk_parity"}
+        lines.append(json.dumps(req, sort_keys=True))
+    return lines
+
+
+def gen_zipf_requests(seed: int, n: int, k: int, *, alpha: float = 1.0,
+                      distinct: int = 100, mix=DEFAULT_MIX,
+                      benchmark: str = "idx", scenario: str | None = None,
+                      deadline_s: float = 600.0) -> list:
+    """``n`` seeded lines drawn Zipf(``alpha``) from a pool of
+    ``distinct`` unique request BODIES (all four request kinds, per
+    ``mix``).  Every emitted line keeps a unique id ``t{i}`` — only the
+    id differs between repeats, which is exactly the shape the
+    content-addressed response cache keys on (identity excluded).
+    ``alpha=1.0, distinct=100`` sends ~19% of traffic to rank 1; the
+    same (seed, n, k, alpha, distinct, mix) is byte-identical."""
+    if distinct < 1:
+        raise ValueError(f"distinct must be >= 1, got {distinct}")
+    if alpha < 0:
+        raise ValueError(f"alpha must be >= 0, got {alpha}")
+    pool = [json.loads(line) for line in
+            gen_requests(seed, distinct, k, mix=mix, benchmark=benchmark,
+                         scenario=scenario, deadline_s=deadline_s)]
+    for body in pool:
+        body.pop("id", None)
+    ranks = np.arange(1, distinct + 1, dtype=np.float64)
+    p = ranks ** -float(alpha)
+    p /= p.sum()
+    # a separate stream from the pool's so adding draws never perturbs
+    # the pool bodies themselves
+    draws = np.random.default_rng((seed, 0x21F)).choice(
+        distinct, size=n, p=p)
+    lines = []
+    for i, d in enumerate(draws):
+        req = dict(pool[int(d)])
+        req["id"] = f"t{i}"
         lines.append(json.dumps(req, sort_keys=True))
     return lines
 
@@ -147,12 +188,28 @@ def main(argv=None) -> int:
                     help="scenario tag for the scenario slice (default: "
                          "fold into plain queries)")
     ap.add_argument("--deadline-s", type=float, default=600.0)
+    ap.add_argument("--zipf", type=float, default=None, metavar="ALPHA",
+                    help="draw bodies Zipf(ALPHA) from a --distinct pool "
+                         "instead of all-unique traffic (repeat-heavy "
+                         "streams for the response cache; ids stay "
+                         "unique)")
+    ap.add_argument("--distinct", type=int, default=100,
+                    help="unique request bodies in the Zipf pool "
+                         "(default 100; only with --zipf)")
     args = ap.parse_args(argv)
     mix = tuple(float(x) for x in args.mix.split(","))
-    for line in gen_requests(args.seed, args.n, args.k, mix=mix,
+    if args.zipf is not None:
+        lines = gen_zipf_requests(args.seed, args.n, args.k,
+                                  alpha=args.zipf, distinct=args.distinct,
+                                  mix=mix, benchmark=args.benchmark,
+                                  scenario=args.scenario,
+                                  deadline_s=args.deadline_s)
+    else:
+        lines = gen_requests(args.seed, args.n, args.k, mix=mix,
                              benchmark=args.benchmark,
                              scenario=args.scenario,
-                             deadline_s=args.deadline_s):
+                             deadline_s=args.deadline_s)
+    for line in lines:
         sys.stdout.write(line + "\n")
     return 0
 
