@@ -419,6 +419,107 @@ def _scenario_cells():
     return cells
 
 
+#: sweep-cell fixed sizes: the carry is shape-static in (books, top_k,
+#: bins) — the audit declares one representative configuration (the
+#: bench's own), the ladder varies only the chunk axis
+_SWEEP_B, _SWEEP_TOPK, _SWEEP_BINS, _SWEEP_LIB = 2, 16, 64, 2
+
+
+def _sweep_carry_avals():
+    th = 2 * _K + 2
+    return (
+        _sds((_SWEEP_B, _SWEEP_TOPK), jnp.float32),        # top_vol
+        _sds((_SWEEP_B, _SWEEP_TOPK, th), jnp.float32),    # top_theta
+        _sds((_SWEEP_B, _SWEEP_TOPK), jnp.int32),          # top_src
+        _sds((_SWEEP_B, _SWEEP_TOPK), jnp.int32),          # top_base
+        _sds((_SWEEP_B, _SWEEP_BINS), jnp.int32),          # hist
+        _sds((3,), jnp.int32),                             # counts
+    )
+
+
+def _sweep_mesh_cells(make_args, meshes=((2, 4),)):
+    """role='mesh' cells for the sweep jits: every operand replicated
+    (the chunk axis is placed by the engine's NamedSharding at run time;
+    the audit proves the replicated lowering stays collective-clean —
+    same skip-with-warn contract as _replicated_mesh_cells, which this
+    mirrors because the sweep carry is a nested tuple its flat ``for a
+    in args`` cannot walk)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    from mfm_tpu.parallel.mesh import make_mesh
+
+    cells = []
+    for nd, ns in meshes:
+        if jax.device_count() < nd * ns:
+            cells.append(Cell(f"mesh{nd}x{ns}", (), {}, role="mesh",
+                              mesh=(nd, ns)))
+            continue
+        mesh = make_mesh(nd, ns)
+        rep = NamedSharding(mesh, PartitionSpec())
+        args = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=rep),
+            make_args())
+        cells.append(Cell(f"mesh{nd}x{ns}", args, {}, role="mesh",
+                          mesh=(nd, ns)))
+    return cells
+
+
+def _sweep_chunk_cells():
+    from mfm_tpu.serve.query import bucket_for
+
+    th = 2 * _K + 2
+
+    def make(c):
+        return (
+            _sweep_carry_avals(),                          # carry (donated)
+            _sds((_SWEEP_LIB, _K, _K), jnp.float32),       # base_lib
+            _sds((_SWEEP_B, _K), jnp.float32),             # xs
+            _sds((c, th), jnp.float32),                    # thetas
+            _sds((c,), jnp.int32),                         # base_idx
+            _sds((c,), jnp.int32),                         # src
+            _sds((c,), jnp.bool_),                         # take
+            _sds((c,), jnp.bool_),                         # reject
+            _sds((c,), jnp.bool_),                         # passthrough
+            _sds((_SWEEP_B,), jnp.float32),                # lo
+            _sds((_SWEEP_B,), jnp.float32),                # width
+        )
+
+    c0 = _QUERY_BUCKETS[0]
+    cells = [Cell(f"bucket{c0}", make(c0), {}, bucket=c0)]
+    for c in _QUERY_BUCKETS:
+        assert bucket_for(c) == c
+        cells.append(Cell(f"bucket{c}", make(c), {}, role="ladder",
+                          bucket=c))
+    return cells + _sweep_mesh_cells(lambda: make(c0))
+
+
+def _sweep_merge_cells():
+    from mfm_tpu.serve.query import bucket_for
+
+    th = 2 * _K + 2
+
+    def make(m):
+        return (
+            _sweep_carry_avals(),                          # carry (donated)
+            _sds((m, _K, _K), jnp.float32),                # covs (exact path)
+            _sds((_SWEEP_B, _K), jnp.float32),             # xs
+            _sds((m, th), jnp.float32),                    # thetas
+            _sds((m,), jnp.int32),                         # src
+            _sds((m,), jnp.int32),                         # base_idx
+            _sds((m,), jnp.bool_),                         # take
+            _sds((m,), jnp.bool_),                         # projected
+            _sds((_SWEEP_B,), jnp.float32),                # lo
+            _sds((_SWEEP_B,), jnp.float32),                # width
+        )
+
+    m0 = _QUERY_BUCKETS[0]
+    cells = [Cell(f"bucket{m0}", make(m0), {}, bucket=m0)]
+    for m in _QUERY_BUCKETS:
+        assert bucket_for(m) == m
+        cells.append(Cell(f"bucket{m}", make(m), {}, role="ladder",
+                          bucket=m))
+    return cells + _sweep_mesh_cells(lambda: make(m0))
+
+
 def _replicated_mesh_cells(args, meshes=((2, 4),)):
     """role='mesh' cells with EVERY operand replicated — the grad
     entrypoints' wire layout: their batches are portfolio/scenario lanes
@@ -612,6 +713,24 @@ def _build_registry() -> tuple:
             build_cells=_scenario_cells,
             ladder="scenario",
             notes="S-lane covariance shocks, query-engine bucket ladder"),
+        Entrypoint(
+            name="scenario.sweep_chunk",
+            qualname="mfm_tpu.scenario.kernel:sweep_chunk",
+            fn=_sk.sweep_chunk,
+            donate=(0,),
+            build_cells=_sweep_chunk_cells,
+            ladder="scenario",
+            notes="streaming sweep fold: C certified lanes -> donated "
+                  "top-k/histogram carry, in-jit sub-chunk scan"),
+        Entrypoint(
+            name="scenario.sweep_merge",
+            qualname="mfm_tpu.scenario.kernel:sweep_merge",
+            fn=_sk.sweep_merge,
+            donate=(0,),
+            build_cells=_sweep_merge_cells,
+            ladder="scenario",
+            notes="offender-lane merge: exact-path covariances folded "
+                  "into the same donated sweep carry"),
         Entrypoint(
             name="grad.reverse",
             qualname="mfm_tpu.grad.reverse:reverse_stress_batch",

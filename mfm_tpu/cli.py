@@ -1560,15 +1560,30 @@ def _doctor(args):
             scenario_manifest_path_for,
         )
 
+        from mfm_tpu.scenario.sweep import (
+            SweepManifestError, audit_sweep_manifest, read_sweep_manifest,
+            sweep_manifest_path_for,
+        )
+
         scpath = scenario_manifest_path_for(man_dir)
+        swpath = sweep_manifest_path_for(man_dir)
         rec = {"file": scpath, "kind": "scenario_manifest", "status": "ok",
                "problems": [], "warnings": []}
         records.append(rec)
         if not os.path.exists(scpath):
-            rec["status"] = "missing"
-            rec["problems"].append(
-                "no scenario_manifest.json beside the artifacts — has "
-                "`mfm-tpu scenario run` run against this checkpoint dir?")
+            if os.path.exists(swpath):
+                # a sweep ran here but no preset drill did — fine for the
+                # artifacts, just worth flagging
+                rec["warnings"].append(
+                    "no scenario_manifest.json beside the artifacts "
+                    "(only a sweep manifest) — run `mfm-tpu scenario "
+                    "run` for the preset drill record")
+            else:
+                rec["status"] = "missing"
+                rec["problems"].append(
+                    "no scenario_manifest.json beside the artifacts — has "
+                    "`mfm-tpu scenario run` run against this checkpoint "
+                    "dir?")
         else:
             try:
                 problems, warnings = audit_scenario_manifest(scpath)
@@ -1588,6 +1603,28 @@ def _doctor(args):
                         "(pre-tracing build, or tracing disabled)")
                 if rec["problems"]:
                     rec["status"] = "unhealthy"
+        # sweep manifests are optional — audit one only when present, so
+        # checkpoints that never ran a sweep stay green
+        if os.path.exists(swpath):
+            swrec = {"file": swpath, "kind": "sweep_manifest",
+                     "status": "ok", "problems": [], "warnings": []}
+            records.append(swrec)
+            try:
+                problems, warnings = audit_sweep_manifest(swpath)
+            except SweepManifestError as err:
+                swrec["status"] = "corrupt"
+                swrec["problems"].append(str(err))
+            else:
+                swrec["problems"].extend(problems)
+                swrec["warnings"].extend(warnings)
+                summary = read_sweep_manifest(swpath).get("summary") or {}
+                if not summary.get("trace_id"):
+                    swrec["warnings"].append(
+                        "sweep manifest carries no root trace_id — "
+                        "this run cannot be joined to its trace "
+                        "(pre-tracing build, or tracing disabled)")
+                if swrec["problems"]:
+                    swrec["status"] = "unhealthy"
     # --audit: verify the committed static-audit snapshot (AUDIT_r*.json)
     # — torn writes, broken seals, non-clean runs, and staleness against
     # the live registry/budget file all fail, same contract as the
@@ -1949,6 +1986,9 @@ def _scenario(args):
                    for n in sorted(PRESETS)]
         print(json.dumps({"presets": catalog}, indent=1))
         return
+    if args.scmd == "sweep":
+        _scenario_sweep(args)
+        return
 
     from mfm_tpu.data.artifacts import (
         ArtifactCorruptError, ArtifactStaleError, load_risk_state,
@@ -2026,6 +2066,97 @@ def _scenario(args):
                       "trace_id": root.trace_id},
                      indent=1), file=sys.stderr)
     if manifest["n_ok"] == 0:
+        raise SystemExit(1)
+
+
+def _scenario_sweep(args):
+    """Streaming million-scenario sweep over a guarded checkpoint
+    (scenario/sweep.py): a sampler generates shock lanes host-side,
+    chunks stream through the donated aggregate carry, the coarse top-k
+    seeds a reverse-stress refinement, and the fixed-size answer lands
+    in an atomic ``sweep_manifest.json`` audited by ``doctor
+    --scenarios``."""
+    import sys
+
+    import numpy as np
+
+    from mfm_tpu.data.artifacts import (
+        ArtifactCorruptError, ArtifactStaleError, load_risk_state,
+    )
+    from mfm_tpu.grad.engine import ShockBall
+    from mfm_tpu.obs.instrument import sweep_summary_from_registry
+    from mfm_tpu.obs.trace import end_span
+    from mfm_tpu.scenario import (
+        GridSampler, SobolSampler, SweepEngine, UniformSampler,
+        build_sweep_manifest, write_sweep_manifest,
+    )
+
+    _metrics_init(args)
+    root = _root_span(args)
+    try:
+        state, meta = load_risk_state(args.state)
+    except (ArtifactCorruptError, ArtifactStaleError) as e:
+        # same refusal as `serve` / `scenario run`: a checkpoint past its
+        # fence audit is not a world worth sweeping
+        raise SystemExit(f"scenario: checkpoint failed its fence audit: {e}")
+    except OSError as e:
+        raise SystemExit(f"scenario: cannot load {args.state}: {e}")
+
+    try:
+        engine = SweepEngine.from_risk_state(state, meta)
+    except ValueError as e:
+        raise SystemExit(f"scenario: {e}")
+    W = _grad_portfolios(args, engine)
+
+    if not (args.n >= 1):
+        raise SystemExit("scenario sweep: --n must be >= 1")
+    ball = ShockBall(shift_max=args.shift_max,
+                     scale_range=args.scale_range,
+                     vol_mult_hi=args.vol_mult_max,
+                     corr_beta_hi=args.corr_beta_max)
+    try:
+        if args.sampler == "grid":
+            side = max(int(np.sqrt(args.n)), 1)
+            sampler = GridSampler(ball, engine.K, n_vol=side, n_corr=side)
+        elif args.sampler == "sobol":
+            sampler = SobolSampler(ball, engine.K, args.n, seed=args.seed)
+        else:
+            sampler = UniformSampler(ball, engine.K, args.n,
+                                     seed=args.seed)
+        refine = None if args.no_refine else {"seed": args.seed}
+        result = engine.sweep(W, sampler, chunk=args.chunk,
+                              top_k=args.top_k, bins=args.bins,
+                              hist_span=args.hist_span, ball=ball,
+                              refine=refine)
+    except ValueError as e:
+        raise SystemExit(f"scenario sweep: {e}")
+    dominance = engine.preset_dominance(result, W)
+
+    out_dir = args.out or (os.path.dirname(args.state) or ".")
+    os.makedirs(out_dir, exist_ok=True)
+    # trace id rides in the summary block — the ONE volatile manifest
+    # field — so seeded re-runs stay byte-equal modulo summary (the
+    # sweep-kill-mid-stream replay contract)
+    summary = sweep_summary_from_registry()
+    summary["trace_id"] = root.trace_id
+    manifest = build_sweep_manifest(
+        result, stamp_json=meta.get("stamp"), backend=jax_backend_name(),
+        staleness=engine.staleness, dominance=dominance, summary=summary)
+    mpath = write_sweep_manifest(out_dir, manifest)
+    for book, dom in zip(result.books, dominance):
+        top = book["top"][0] if book["top"] else None
+        line = {"book": book["label"], "vol_base": book["vol_base"],
+                "vol_worst": top["vol"] if top else None,
+                "worst_spec_hash": top["spec_hash"] if top else None,
+                "dominates_presets": dom["dominates_all"]}
+        print(json.dumps(line, sort_keys=True))
+    end_span(root)
+    _metrics_flush(args)
+    print(json.dumps({"manifest": mpath, "counts": result.counts,
+                      "sampler": result.sampler,
+                      "trace_id": root.trace_id},
+                     indent=1), file=sys.stderr)
+    if result.counts["n_ok"] == 0:
         raise SystemExit(1)
 
 
@@ -2978,6 +3109,51 @@ def main(argv=None):
                      help="explicit pad bucket >= the number of scenarios "
                           "(default: the geometric bucket for S)")
     scr.add_argument("--metrics-dir", default=None, help=_metrics_dir_help)
+    scw = scs.add_parser(
+        "sweep", help="stream a sampler-generated scenario sweep through "
+                      "fixed-size aggregates (top-k worst, quantile "
+                      "sketch), refine with reverse-stress gradients, "
+                      "write sweep_manifest.json beside the checkpoint")
+    scw.add_argument("state", help="risk-state .npz saved with quarantine "
+                                   "enabled (the sweep stresses its "
+                                   "last_good_cov)")
+    scw.add_argument("--sampler", choices=("uniform", "sobol", "grid"),
+                     default="uniform",
+                     help="spec generator over the shock ball "
+                          "(default: uniform)")
+    scw.add_argument("--n", type=int, default=65536,
+                     help="scenarios to stream (default: 65536; grid "
+                          "rounds to a square)")
+    scw.add_argument("--seed", type=int, default=0,
+                     help="sampler + refinement seed (default: 0)")
+    scw.add_argument("--chunk", type=int, default=8192,
+                     help="scenarios per donated jit call (default: 8192)")
+    scw.add_argument("--top-k", type=int, default=16,
+                     help="worst entries kept per book (default: 16)")
+    scw.add_argument("--bins", type=int, default=64,
+                     help="quantile-sketch histogram bins (default: 64)")
+    scw.add_argument("--hist-span", type=float, default=8.0,
+                     help="sketch upper edge as a multiple of each "
+                          "book's base vol (default: 8.0)")
+    scw.add_argument("--portfolio", default=None,
+                     help="JSON portfolio file: one K-vector, a list of "
+                          "them, or factor-name-keyed dicts (default: "
+                          "one equal-weight portfolio)")
+    scw.add_argument("--shift-max", type=float, default=0.01,
+                     help="shock-ball |vol shift| cap (default: 0.01)")
+    scw.add_argument("--scale-range", type=float, default=0.5,
+                     help="shock-ball vol-scale half-range "
+                          "(default: 0.5)")
+    scw.add_argument("--vol-mult-max", type=float, default=3.5,
+                     help="shock-ball vol_mult ceiling (default: 3.5)")
+    scw.add_argument("--corr-beta-max", type=float, default=0.95,
+                     help="shock-ball corr_beta ceiling (default: 0.95)")
+    scw.add_argument("--no-refine", action="store_true",
+                     help="skip the reverse-stress refinement loop")
+    scw.add_argument("--out", default=None,
+                     help="directory for sweep_manifest.json (default: "
+                          "beside the checkpoint)")
+    scw.add_argument("--metrics-dir", default=None, help=_metrics_dir_help)
     sc.set_defaults(fn=_scenario)
 
     gr = sub.add_parser(
@@ -3055,7 +3231,7 @@ def main(argv=None):
     if args.cmd in ("risk", "factors", "demo", "prepare", "pipeline",
                     "alpha", "serve", "grad") \
             or (args.cmd == "scenario"
-                and getattr(args, "scmd", None) == "run"):
+                and getattr(args, "scmd", None) in ("run", "sweep")):
         from mfm_tpu.utils.cache import enable_persistent_compilation_cache
 
         enable_persistent_compilation_cache()

@@ -207,6 +207,29 @@ SCENARIO_PSD_PROJECTIONS_TOTAL = REGISTRY.counter(
     "lanes whose stressed covariance went indefinite and was projected "
     "back to PSD (corr stress past the feasible cone)")
 
+# -- streaming sweeps (scenario/sweep.py) -------------------------------------
+
+SWEEP_SCENARIOS_TOTAL = REGISTRY.counter(
+    "mfm_sweep_scenarios_total",
+    "sweep lanes streamed by admission outcome",
+    labelnames=("status",))   # ok | rejected
+SWEEP_CHUNKS_TOTAL = REGISTRY.counter(
+    "mfm_sweep_chunks_total",
+    "donated chunk-kernel calls dispatched by sweeps (hot path + "
+    "offender flushes)")
+SWEEP_SECONDS = REGISTRY.histogram(
+    "mfm_sweep_seconds",
+    "host wall time per full sweep (coarse + refinement, carry pull "
+    "included)")
+SWEEP_OFFENDER_LANES_TOTAL = REGISTRY.counter(
+    "mfm_sweep_offender_lanes_total",
+    "lanes the host inertia certificate could not vouch for, routed "
+    "through the exact per-lane eigh path")
+SWEEP_PSD_PROJECTIONS_TOTAL = REGISTRY.counter(
+    "mfm_sweep_psd_projections_total",
+    "offender lanes whose stressed covariance was projected back to PSD "
+    "before merging")
+
 
 # -- recording helpers --------------------------------------------------------
 
@@ -490,6 +513,41 @@ def record_scenario_outcome(status: str, n: int = 1) -> None:
 
 def record_psd_projections(n: int = 1) -> None:
     SCENARIO_PSD_PROJECTIONS_TOTAL.inc(int(n))
+
+
+def record_sweep(n_ok: int, n_rejected: int, n_chunks: int,
+                 seconds: float) -> None:
+    """Tally one full sweep: admitted/rejected lanes, chunk-kernel calls
+    and host wall."""
+    if n_ok:
+        SWEEP_SCENARIOS_TOTAL.inc(int(n_ok), status="ok")
+    if n_rejected:
+        SWEEP_SCENARIOS_TOTAL.inc(int(n_rejected), status="rejected")
+    SWEEP_CHUNKS_TOTAL.inc(int(n_chunks))
+    SWEEP_SECONDS.observe(float(seconds))
+
+
+def record_sweep_offenders(n: int = 1) -> None:
+    SWEEP_OFFENDER_LANES_TOTAL.inc(int(n))
+
+
+def record_sweep_projections(n: int = 1) -> None:
+    SWEEP_PSD_PROJECTIONS_TOTAL.inc(int(n))
+
+
+def sweep_summary_from_registry() -> dict:
+    """The sweep manifest's ``summary`` block, off the live counters (the
+    one VOLATILE manifest field — wall quantiles don't replay)."""
+    statuses = {k[0]: int(v) for k, v in SWEEP_SCENARIOS_TOTAL.series().items()}
+    p50 = SWEEP_SECONDS.quantile_est(0.5)
+    return {
+        "sweep_lanes": statuses,
+        "sweep_lanes_total": sum(statuses.values()),
+        "chunks_total": int(SWEEP_CHUNKS_TOTAL.value()),
+        "offender_lanes_total": int(SWEEP_OFFENDER_LANES_TOTAL.value()),
+        "psd_projections_total": int(SWEEP_PSD_PROJECTIONS_TOTAL.value()),
+        "sweep_p50_wall_s": (None if p50 != p50 else round(p50, 6)),
+    }
 
 
 def scenario_summary_from_registry() -> dict:

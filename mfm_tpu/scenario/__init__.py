@@ -26,6 +26,23 @@ from mfm_tpu.scenario.manifest import (
     scenario_manifest_path_for,
     write_scenario_manifest,
 )
+from mfm_tpu.scenario.sweep import (
+    GridSampler,
+    ReplaySampler,
+    SobolSampler,
+    SWEEP_MANIFEST_NAME,
+    SweepEngine,
+    SweepManifestError,
+    SweepResult,
+    UniformSampler,
+    audit_sweep_manifest,
+    build_sweep_manifest,
+    monthly_replay_windows,
+    read_sweep_manifest,
+    sweep_manifest_path_for,
+    theta_to_spec,
+    write_sweep_manifest,
+)
 from mfm_tpu.scenario.spec import (
     PRESET_NOTES,
     PRESETS,
@@ -36,24 +53,39 @@ from mfm_tpu.scenario.spec import (
 )
 
 __all__ = [
+    "GridSampler",
     "PRESETS",
     "PRESET_NOTES",
+    "ReplaySampler",
     "SCENARIO_MANIFEST_NAME",
+    "SWEEP_MANIFEST_NAME",
     "ScenarioBuilder",
     "ScenarioEngine",
     "ScenarioManifestError",
     "ScenarioResult",
     "ScenarioSpec",
+    "SobolSampler",
+    "SweepEngine",
+    "SweepManifestError",
+    "SweepResult",
+    "UniformSampler",
     "audit_scenario_manifest",
+    "audit_sweep_manifest",
     "build_scenario_manifest",
+    "build_sweep_manifest",
     "clone_state",
     "make_counterfactual_fn",
     "make_replay_lookup",
+    "monthly_replay_windows",
     "preset",
     "read_scenario_manifest",
+    "read_sweep_manifest",
     "replay_lookup_from_result",
     "scenario_batch",
     "scenario_manifest_path_for",
+    "sweep_manifest_path_for",
+    "theta_to_spec",
     "validate_spec",
     "write_scenario_manifest",
+    "write_sweep_manifest",
 ]

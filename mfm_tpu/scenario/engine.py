@@ -213,7 +213,6 @@ class ScenarioEngine:
         if B < S:
             raise ValueError(f"bucket {B} < batch size {S}")
 
-        base = np.broadcast_to(self.cov, (B, self.K, self.K)).copy()
         shift = np.zeros((B, self.K), self.dtype)
         scale = np.ones((B, self.K), self.dtype)
         vol_mult = np.ones((B,), self.dtype)
@@ -221,18 +220,30 @@ class ScenarioEngine:
         passthrough = np.ones((B,), bool)   # pad lanes stay passthrough
 
         lane_problems: list = []
+        base_rows: dict = {}   # lane -> base override (replay / cf lanes)
         for i, spec in enumerate(specs):
             cov_i, problems = self._resolve(spec)
             lane_problems.append(tuple(problems))
             if problems:
                 continue   # rejected: the lane stays a passthrough no-op
-            base[i] = cov_i
+            if cov_i is not self.cov:
+                base_rows[i] = cov_i
             shift[i], scale[i] = self._shock_vectors(spec)
             vol_mult[i] = spec.vol_mult
             corr_beta[i] = spec.corr_beta
             # identity TRANSFORM lanes pass the base through bitwise (the
             # correctness anchor); shocked lanes compute
             passthrough[i] = spec.shocks_identity
+
+        # the common batch shares self.cov on every lane: keep the base a
+        # broadcast VIEW (jnp.array below copies host->device regardless,
+        # so the dense (B, K, K) host materialization was pure waste) and
+        # only densify when a replay/counterfactual lane overrides its row
+        base = np.broadcast_to(self.cov, (B, self.K, self.K))
+        if base_rows:
+            base = base.copy()
+            for i, cov_i in base_rows.items():
+                base[i] = cov_i
 
         base_vols = np.sqrt(np.maximum(
             np.diagonal(base[:S], axis1=1, axis2=2), 0)).astype(self.dtype)
@@ -242,10 +253,22 @@ class ScenarioEngine:
             jnp.array(vol_mult), jnp.array(corr_beta),
             jnp.array(passthrough))
         # materialize before closing the span: np.asarray forces the
-        # async dispatch, so the histogram measures compute, not enqueue
-        covs = np.asarray(covs)
-        projected = np.asarray(projected)
-        min_eig = np.asarray(min_eig)
+        # async dispatch, so the histogram measures compute, not enqueue.
+        # Crop BEFORE the host transfer so a batch pinned into an
+        # oversized bucket doesn't ship the full pad — but crop to the
+        # LADDER rung covering S, not S itself: the device-side slice is
+        # itself a tiny lowered program keyed on its output shape, so an
+        # exact-S crop would retrace per distinct S and break the <= 1
+        # compile/bucket steady state.  Rung-quantized crops key on
+        # (bucket, rung) pairs only, and the default-bucket path (B ==
+        # bucket_for(S)) never slices at all.
+        S_q = min(bucket_for(S), B)
+        if S_q < B:
+            covs, projected, min_eig = (covs[:S_q], projected[:S_q],
+                                        min_eig[:S_q])
+        covs = np.asarray(covs)[:S]
+        projected = np.asarray(projected)[:S]
+        min_eig = np.asarray(min_eig)[:S]
         dt = time.perf_counter() - t0
 
         results = []
@@ -272,7 +295,7 @@ class ScenarioEngine:
             _obs.record_scenario_outcome("ok", n_ok)
         if n_rejected:
             _obs.record_scenario_outcome("rejected", n_rejected)
-        n_proj = int(projected[:S].sum())
+        n_proj = int(projected.sum())
         if n_proj:
             _obs.record_psd_projections(n_proj)
         return results

@@ -172,3 +172,190 @@ def scenario_batch(base_cov, shift, scale, vol_mult, corr_beta, passthrough):
     """
     return jax.vmap(_one_scenario)(base_cov, shift, scale, vol_mult,
                                    corr_beta, passthrough)
+
+
+# -- streaming sweep kernels (scenario/sweep.py) ------------------------------
+#
+# The sweep engine answers "worst portfolio vol over 10^6 shock worlds"
+# WITHOUT ever materializing an (S, K, K) stack: each donated call folds a
+# chunk of C stressed lanes into a fixed-size aggregate carry (per-book
+# top-k worst table + fixed-bin vol histogram + counters).  The decisive
+# perf property is that the hot chunk kernel does NO eigendecomposition:
+# PSD-ness of the stressed matrix ``diag(sigma_s) C'(cb) diag(sigma_s)`` is
+# congruence-invariant (Sylvester's law of inertia) whenever sigma_s is
+# strictly positive, so it depends ONLY on the clipped stressed correlation
+# ``C'(cb)`` — a pure function of the scalar corr_beta.  The host quantizes
+# corr_beta to a small lattice, certifies each (base, level) pair once with
+# a cheap K x K eigh, and routes the rare uncertified lanes ("offenders")
+# through the exact :func:`scenario_batch` path + :func:`sweep_merge`.
+
+
+def book_vols(covs, xs):
+    """(B, C) portfolio vols of every book against every lane covariance.
+
+    Deliberately un-jitted (like ``portfolio_vol`` itself): both sweep
+    jits inline it, and the parity tests jit it standalone over
+    MATERIALIZED engine covs — the double-vmapped contraction lowers to
+    the same dot either way, which is what makes the streaming top-k
+    bitwise-comparable to the materializing reference."""
+    from mfm_tpu.models.risk_model import portfolio_vol
+    return jax.vmap(lambda x: jax.vmap(
+        lambda c: portfolio_vol(c, x))(covs))(xs)
+
+
+def _init_sweep_carry(n_books: int, top_k: int, n_theta: int, bins: int,
+                      dtype):
+    """Fresh aggregate carry for one sweep (host helper, not jitted).
+
+    The carry is a flat tuple (a pytree jax donates whole):
+
+    - ``top_vol (B, k)``: per-book worst vols, descending; -inf = empty.
+    - ``top_theta (B, k, TH)``: the dense theta behind each entry
+      (``[shift(K) | scale(K) | vol_mult | corr_beta]`` — the grad
+      subsystem's layout, so seeds feed ``reverse_stress_batch`` as-is).
+    - ``top_src (B, k) i32``: global scenario index (replayable identity).
+    - ``top_base (B, k) i32``: base-library row the lane stressed.
+    - ``hist (B, bins) i32``: fixed-bin vol histogram (the quantile
+      sketch; bin edges live host-side, deterministic per sweep).
+    - ``counts (3,) i32``: [n_ok, n_rejected, n_projected].
+    """
+    neg = jnp.finfo(dtype).min
+    return (jnp.full((n_books, top_k), neg, dtype=dtype),
+            jnp.zeros((n_books, top_k, n_theta), dtype=dtype),
+            jnp.full((n_books, top_k), -1, dtype=jnp.int32),
+            jnp.full((n_books, top_k), -1, dtype=jnp.int32),
+            jnp.zeros((n_books, bins), dtype=jnp.int32),
+            jnp.zeros((3,), dtype=jnp.int32))
+
+
+def _merge_into_carry(carry, vols, thetas, src, base_idx, take, reject,
+                      projected, lo, width):
+    """Fold one chunk's lane vols into the carry (shared by both sweep
+    jits).  ``vols (B, C)``; lane masks are (C,) — a lane is merged for
+    every book or none.
+
+    The top-k merge is a fixed-size ``lax.top_k`` over the concatenation
+    [carried k | C chunk lanes]: ties keep the LOWER index, so carried
+    (older) entries win over chunk lanes and earlier lanes win within a
+    chunk — fully deterministic, order-independent only up to the
+    documented first-seen tie rule.  No (B, C, TH) broadcast is ever
+    built: thetas gather through the chunk-lane index only.
+    """
+    top_vol, top_theta, top_src, top_base, hist, counts = carry
+    dtype = top_vol.dtype
+    k = top_vol.shape[1]
+    C = vols.shape[1]
+    neg = jnp.finfo(dtype).min
+    masked = jnp.where(take[None, :], vols, neg)
+
+    allv = jnp.concatenate([top_vol, masked], axis=1)       # (B, k + C)
+    new_vol, sel = lax.top_k(allv, k)                        # (B, k)
+    from_chunk = sel >= k
+    chunk_i = jnp.clip(sel - k, 0, C - 1)                    # (B, k)
+    old_i = jnp.clip(sel, 0, k - 1)
+
+    new_theta = jnp.where(
+        from_chunk[:, :, None], thetas[chunk_i],
+        jnp.take_along_axis(top_theta, old_i[:, :, None], axis=1))
+    new_src = jnp.where(from_chunk, src[chunk_i],
+                        jnp.take_along_axis(top_src, old_i, axis=1))
+    new_base = jnp.where(from_chunk, base_idx[chunk_i],
+                         jnp.take_along_axis(top_base, old_i, axis=1))
+
+    # quantile sketch: per-book fixed bins [lo, lo + bins * width); the
+    # open top edge clips into the last bin (documented saturating bin)
+    bins = hist.shape[1]
+    bi = jnp.clip(((vols - lo[:, None]) / width[:, None]).astype(jnp.int32),
+                  0, bins - 1)
+    n_books = hist.shape[0]
+    hist = hist.at[jnp.arange(n_books, dtype=jnp.int32)[:, None], bi].add(
+        take[None, :].astype(jnp.int32))
+
+    # pin the accumulation dtype: under x64 jnp.sum of i32 follows NumPy
+    # up to i64, which would flip the scan-carry type between modes
+    counts = counts + jnp.stack([
+        jnp.sum(take, dtype=jnp.int32),
+        jnp.sum(reject, dtype=jnp.int32),
+        jnp.sum(projected & take, dtype=jnp.int32)])
+    return (new_vol, new_theta, new_src, new_base, hist, counts)
+
+
+#: in-jit sub-chunk length: sweep_chunk folds a C-lane chunk as a
+#: lax.scan over C / SWEEP_SUBCHUNK slices so each slice's (sub, K, K)
+#: stressed stack stays cache-resident (measured ~3x over one C-wide
+#: pass once C * K * K spills the LLC) while the HOST still pays one
+#: dispatch + one transfer per C lanes.  Scanning slices in order makes
+#: the fold bitwise-identical to C / sub sequential small chunks — the
+#: merge sees the same lanes in the same order.
+SWEEP_SUBCHUNK = 2048
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def sweep_chunk(carry, base_lib, xs, thetas, base_idx, src,
+                take, reject, passthrough, lo, width):
+    """Fold one chunk of C HOST-CERTIFIED lanes into the donated carry.
+
+    Every ``take`` lane is pre-certified PSD by the host inertia gate
+    (sweep.py), so the lane math is stress + quadratic form only — no
+    eigh anywhere on this path.  Lane vols reuse the exact serving
+    building blocks (:func:`stress_cov` + ``portfolio_vol``) so small-S
+    streaming results are BITWISE-comparable to the materializing
+    reference; passthrough (identity-theta) lanes select the precomputed
+    per-base vols instead, mirroring the serving kernel's untouched-base
+    passthrough guarantee.  Chunks larger than :data:`SWEEP_SUBCHUNK`
+    fold as an in-jit scan over cache-sized slices (see above) — same
+    lanes, same order, same bits.
+
+    Args:
+      carry: aggregate tuple from :func:`_init_sweep_carry` (donated).
+      base_lib: (L, K, K) resolved base covariances (row 0 = served cov,
+        rows 1.. = replay library; per-book vols of the UNSTRESSED bases
+        are recomputed in-jit — L is tiny next to C and keeping the
+        computation inside preserves the bitwise contract).
+      xs: (B, K) book exposure vectors.
+      thetas: (C, 2K + 2) dense shock lanes (grad layout).
+      base_idx: (C,) i32 base-library row per lane.
+      src: (C,) i32 global scenario index per lane.
+      take / reject / passthrough: (C,) bool lane masks (pad lanes are
+        neither taken nor rejected).
+      lo / width: (B,) histogram bin origin / width at compute dtype.
+    """
+    K = base_lib.shape[-1]
+    C = thetas.shape[0]
+    base_vols = book_vols(base_lib, xs)                      # (B, L)
+
+    def fold(carry, blk):
+        th, bi, s, tk, rj, pt = blk
+        bases = base_lib[bi]                                 # (sub, K, K)
+        covs = jax.vmap(stress_cov)(bases, th[:, :K], th[:, K:2 * K],
+                                    th[:, 2 * K], th[:, 2 * K + 1])
+        vols = book_vols(covs, xs)                           # (B, sub)
+        vols = jnp.where(pt[None, :], base_vols[:, bi], vols)
+        projected = jnp.zeros(th.shape[0], dtype=bool)       # certified PSD
+        return _merge_into_carry(carry, vols, th, s, bi, tk, rj,
+                                 projected, lo, width), None
+
+    sub = SWEEP_SUBCHUNK if C % SWEEP_SUBCHUNK == 0 else C
+    n = max(C // sub, 1)
+    blocks = (thetas.reshape(n, sub, -1), base_idx.reshape(n, sub),
+              src.reshape(n, sub), take.reshape(n, sub),
+              reject.reshape(n, sub), passthrough.reshape(n, sub))
+    carry, _ = lax.scan(fold, carry, blocks)
+    return carry
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def sweep_merge(carry, covs, xs, thetas, src, base_idx, take, projected,
+                lo, width):
+    """Fold M OFFENDER lanes (already shocked + PSD-gated by
+    :func:`scenario_batch`) into the donated carry.
+
+    ``covs (M, K, K)`` are the exact-path outputs; this jit only takes
+    the quadratic forms and runs the identical merge, so offender lanes
+    land in the same top-k/histogram/counters as certified ones — with
+    their true post-projection vols and their ``projected`` flags
+    counted."""
+    vols = book_vols(covs, xs)                               # (B, M)
+    reject = jnp.zeros(thetas.shape[0], dtype=bool)
+    return _merge_into_carry(carry, vols, thetas, src, base_idx, take,
+                             reject, projected, lo, width)

@@ -158,6 +158,11 @@ class ResponseCache:
         rid = obj.pop("id", None)
         raw_tid = obj.pop("trace_id", None)
         tid = str(raw_tid) if raw_tid is not None else _line_trace_id(line)
+        if obj.get("sweep"):
+            # sweep responses summarize a whole streaming batch run —
+            # cache-exempt by contract (ISSUE 17): every sweep streams
+            # against the live fenced checkpoint, never a stored answer
+            return None
         scen = obj.get("scenario")
         with self._lock:
             gen = self._generation

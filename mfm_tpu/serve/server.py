@@ -35,6 +35,7 @@ import collections
 import dataclasses
 import hashlib
 import json
+import math
 import os
 import threading
 import time
@@ -62,6 +63,8 @@ REQ_REASON_WEIGHT_OUTLIER = 64   # |w - med| > mad_k * MAD (policy-gated)
 REQ_REASON_UNKNOWN_SCENARIO = 128  # scenario tag not in the served table
 REQ_REASON_BAD_CONSTRUCT = 256   # construct solver unknown / unsupported
                                  # space / bad hedge factors or hmax
+REQ_REASON_BAD_SWEEP = 512       # sweep spec unknown sampler / out-of-bound
+                                 # n, chunk, top_k or bins
 
 _REQ_REASON_NAMES = (
     (REQ_REASON_SCHEMA, "schema"),
@@ -73,7 +76,17 @@ _REQ_REASON_NAMES = (
     (REQ_REASON_WEIGHT_OUTLIER, "weight_outlier"),
     (REQ_REASON_UNKNOWN_SCENARIO, "unknown_scenario"),
     (REQ_REASON_BAD_CONSTRUCT, "bad_construct"),
+    (REQ_REASON_BAD_SWEEP, "bad_sweep"),
 )
+
+#: sweep request bounds — a sweep is a whole streaming batch job riding
+#: one request, so admission caps every size knob (the CLI is the road
+#: for million-scenario runs; serving answers bounded exploratory sweeps)
+SWEEP_SAMPLERS = ("uniform", "sobol", "grid")
+SWEEP_MAX_N = 262144
+SWEEP_MAX_CHUNK = 16384
+SWEEP_MAX_TOP_K = 64
+SWEEP_MAX_BINS = 256
 
 #: construct request vocabulary (mfm_tpu/grad/construct.py solvers); the
 #: import is deferred to keep this host-only module's import cost flat —
@@ -238,11 +251,11 @@ class CircuitBreaker:
 
 class _Request:
     __slots__ = ("rid", "weights", "bidx", "enq_t", "deadline_t", "scenario",
-                 "trace_id", "span", "construct", "origin", "line")
+                 "trace_id", "span", "construct", "sweep", "origin", "line")
 
     def __init__(self, rid, weights, bidx, enq_t, deadline_t, scenario=None,
-                 trace_id=None, span=None, construct=None, origin=None,
-                 line=None):
+                 trace_id=None, span=None, construct=None, sweep=None,
+                 origin=None, line=None):
         self.rid = rid
         self.weights = weights
         self.bidx = bidx
@@ -252,6 +265,7 @@ class _Request:
         self.trace_id = trace_id
         self.span = span
         self.construct = construct
+        self.sweep = sweep
         # origin: an opaque routing token (connection handle, replica
         # dispatch ordinal) stamped by the fleet layer; None on the plain
         # single-stream loop.  line: the raw admitted request bytes — the
@@ -316,13 +330,53 @@ def _parse_construct(raw, engine):
     return spec, 0, ""
 
 
+def _parse_sweep(raw, engine):
+    """Decode + guard a request's ``sweep`` block.  Accepts ``true`` (all
+    defaults) or an object with ``sampler`` / ``n`` / ``seed`` / ``chunk``
+    / ``top_k`` / ``bins``.  Every size knob is bounded at admission — a
+    sweep is a streaming batch job riding one request line, and the
+    drain must stay O(bounded) per request.  Returns ``(spec_dict_or_None,
+    reason_bits, detail)``."""
+    if raw is True:
+        raw = {}
+    if not isinstance(raw, dict):
+        return None, REQ_REASON_BAD_SWEEP, \
+            "sweep must be true or an object"
+    if engine.space != "factor":
+        return None, REQ_REASON_BAD_SWEEP, \
+            f"sweeps run in factor space (engine serves {engine.space!r})"
+    sampler = str(raw.get("sampler", "uniform"))
+    if sampler not in SWEEP_SAMPLERS:
+        return None, REQ_REASON_BAD_SWEEP, \
+            f"unknown sweep sampler {sampler!r}; have {list(SWEEP_SAMPLERS)}"
+    spec = {"sampler": sampler}
+    for key, default, lo, hi in (("n", 4096, 1, SWEEP_MAX_N),
+                                 ("chunk", 1024, 1, SWEEP_MAX_CHUNK),
+                                 ("top_k", 8, 1, SWEEP_MAX_TOP_K),
+                                 ("bins", 64, 8, SWEEP_MAX_BINS),
+                                 ("seed", 0, 0, 2 ** 31 - 1)):
+        v = raw.get(key, default)
+        try:
+            iv = int(v)
+            if isinstance(v, float) and v != iv:
+                raise ValueError(v)
+            if not (lo <= iv <= hi):
+                raise ValueError(iv)
+        except (TypeError, ValueError):
+            return None, REQ_REASON_BAD_SWEEP, \
+                f"bad sweep {key} {v!r} (need int in [{lo}, {hi}])"
+        spec[key] = iv
+    return spec, 0, ""
+
+
 def parse_request(line: str, engine, policy: ServePolicy, scenarios=None):
     """Decode + guard one JSONL request.
 
     Returns ``(fields_or_None, reason_mask, detail)``: a zero mask means
     the request is admissible and ``fields`` is ``(rid, weights (D,)
     float, bidx int, deadline_s float, scenario str|None, trace_id
-    str|None, construct dict|None)``; a nonzero mask means dead-letter
+    str|None, construct dict|None, sweep dict|None)``; a nonzero mask
+    means dead-letter
     (``detail`` says what tripped, ``rid`` may still be recoverable and
     is returned inside ``detail``-bearing fields as None).  ``trace_id``
     is the caller's own when the request JSON carries one, else None (the
@@ -349,12 +403,12 @@ def parse_request(line: str, engine, policy: ServePolicy, scenarios=None):
     if trace_id is not None:
         trace_id = str(trace_id)
     if FLEET_CONTROL_KEY in obj:
-        return (rid, None, 0, 0.0, scenario, trace_id, None), \
+        return (rid, None, 0, 0.0, scenario, trace_id, None, None), \
             REQ_REASON_SCHEMA, \
             f"reserved key {FLEET_CONTROL_KEY!r} (fleet control namespace)"
     raw_w = obj.get("weights")
     if raw_w is None:
-        return (rid, None, 0, 0.0, scenario, trace_id, None), \
+        return (rid, None, 0, 0.0, scenario, trace_id, None, None), \
             REQ_REASON_SCHEMA, "missing 'weights'"
 
     detail = ""
@@ -370,6 +424,18 @@ def parse_request(line: str, engine, policy: ServePolicy, scenarios=None):
         if c_bits:
             mask |= c_bits
             detail = detail or c_detail
+    sweep = None
+    raw_s = obj.get("sweep")
+    if raw_s is not None and raw_s is not False:
+        sweep, s_bits, s_detail = _parse_sweep(raw_s, engine)
+        if s_bits:
+            mask |= s_bits
+            detail = detail or s_detail
+        elif construct is not None:
+            sweep = None
+            mask |= REQ_REASON_BAD_SWEEP
+            detail = detail or \
+                "a request is a sweep OR a construct solve, not both"
     if isinstance(raw_w, dict):
         # name-keyed weights: map onto the engine's own axis order.  In
         # factor space the keys are factor names; in stock space stock ids.
@@ -377,7 +443,7 @@ def parse_request(line: str, engine, policy: ServePolicy, scenarios=None):
                  else engine.factor_names if engine.space == "factor"
                  else None)
         if names is None:
-            return (rid, None, 0, 0.0, scenario, trace_id, None), \
+            return (rid, None, 0, 0.0, scenario, trace_id, None, None), \
                 REQ_REASON_SCHEMA, \
                 "dict weights need a named axis (engine has no stock ids)"
         index = (engine.factor_index if engine.space == "factor"
@@ -445,8 +511,8 @@ def parse_request(line: str, engine, policy: ServePolicy, scenarios=None):
         mask |= REQ_REASON_SCHEMA
         detail = detail or f"bad deadline_s {obj.get('deadline_s')!r}"
         deadline_s = policy.default_deadline_s
-    return (rid, w, bidx, deadline_s, scenario, trace_id, construct), \
-        int(mask), detail
+    return (rid, w, bidx, deadline_s, scenario, trace_id, construct,
+            sweep), int(mask), detail
 
 
 class QueryServer:
@@ -593,7 +659,7 @@ class QueryServer:
                                           "reasons": req_reason_names(mask),
                                           "detail": detail}, scenario_id=scen,
                                          trace_id=tid))]
-        rid, w, bidx, deadline_s, scen, tid, construct = fields
+        rid, w, bidx, deadline_s, scen, tid, construct, sweep = fields
         if tid is None:
             tid = _line_trace_id(line)
         now = self._clock()
@@ -603,8 +669,8 @@ class QueryServer:
                                request_id=rid, scenario=scen)
         self._queue.append(_Request(rid, w, bidx, now, now + deadline_s,
                                     scenario=scen, trace_id=tid, span=sp,
-                                    construct=construct, origin=origin,
-                                    line=line))
+                                    construct=construct, sweep=sweep,
+                                    origin=origin, line=line))
         # bounded queue: shedding drops the OLDEST queued work first —
         # under overload the head of the queue is the request whose
         # deadline is nearest death; the freshest work is the most useful
@@ -696,7 +762,9 @@ class QueryServer:
             # each (solver, hmax) construct sub-batch runs its own donated
             # grad kernel against the SAME engine's covariance (so
             # scenario-tagged construction solves against the stressed world)
-            qgrp = [r for r in grp if r.construct is None]
+            qgrp = [r for r in grp
+                    if r.construct is None and r.sweep is None]
+            sgrp = [r for r in grp if r.sweep is not None]
             cgrps: dict = {}
             for r in grp:
                 if r.construct is not None:
@@ -707,6 +775,8 @@ class QueryServer:
             for (solver, hmax), cg in cgrps.items():
                 out.extend(self._drain_construct(engine, scen, solver,
                                                  hmax, cg))
+            if sgrp:
+                out.extend(self._drain_sweep(engine, scen, sgrp))
         chaos_point("serve.after_batch", f"batch{self._batch_i}")
         self._batch_i += 1
         return out
@@ -872,6 +942,89 @@ class QueryServer:
             elif self.warm_index is not None and full_steps is not None:
                 self.warm_index.add(solver, hmax, r.weights,
                                     np.asarray(w_i))
+            out.append((r.origin,
+                        self._stamp(resp, scenario_id=scen, engine=engine,
+                                    trace_id=r.trace_id)))
+        return out
+
+    def _drain_sweep(self, engine, scen, grp) -> list[tuple]:
+        """Answer one scenario group's sweep requests.  Requests sharing
+        an identical (admission-bounded) sweep spec batch their books
+        into ONE streaming sweep — the chunk kernel already carries B
+        books per lane, so co-sweeping is free; distinct specs run
+        sequentially.  Scenario-tagged sweeps stream against the stressed
+        engine's covariance (the same world their queries answer from).
+        No refinement in the serving path — bounded exploratory sweeps
+        only; the CLI owns the gradient-refined deep runs.  Returns
+        routed ``(origin, resp)`` pairs."""
+        from mfm_tpu.grad.engine import ShockBall
+        from mfm_tpu.scenario.sweep import (
+            GridSampler, SobolSampler, SweepEngine, UniformSampler,
+        )
+        out = []
+        head = grp[0]
+        bsp = _trace.start_span(
+            "serve.sweep", trace_id=head.trace_id,
+            parent_id=(head.span.span_id if head.span else None),
+            batch=self._batch_i, scenario=scen, n=len(grp),
+            trace_ids=[r.trace_id for r in grp[:32]])
+        by_spec: dict = {}
+        for r in grp:
+            by_spec.setdefault(tuple(sorted(r.sweep.items())), []).append(r)
+        t0 = time.perf_counter()
+        try:
+            se = SweepEngine(np.asarray(engine._cov),
+                             factor_names=engine.factor_names,
+                             staleness=engine.staleness, dtype=engine.dtype)
+            results: dict = {}
+            for key, rs in by_spec.items():
+                spec = dict(key)
+                ball = ShockBall()
+                if spec["sampler"] == "grid":
+                    side = max(2, int(math.isqrt(spec["n"])))
+                    sampler = GridSampler(ball, se.K, n_vol=side,
+                                          n_corr=side)
+                elif spec["sampler"] == "sobol":
+                    sampler = SobolSampler(ball, se.K, spec["n"],
+                                           seed=spec["seed"])
+                else:
+                    sampler = UniformSampler(ball, se.K, spec["n"],
+                                             seed=spec["seed"])
+                W = np.stack([r.weights for r in rs])
+                res = se.sweep(W, sampler, chunk=spec["chunk"],
+                               top_k=spec["top_k"], bins=spec["bins"],
+                               ball=ball, refine=None)
+                for i, r in enumerate(rs):
+                    results[id(r)] = (res.books[i], res.counts, res.sampler)
+        except Exception as e:   # noqa: BLE001 — any batch failure trips
+            _trace.end_span(bsp, outcome="error")
+            self.breaker.record_failure()
+            for r in grp:
+                _obs.record_query_outcome("error")
+                if r.span is not None:
+                    _trace.end_span(r.span, outcome="error")
+                out.append((r.origin,
+                            self._stamp({"id": r.rid, "ok": False,
+                                         "outcome": "error",
+                                         "kind": "sweep",
+                                         "detail": str(e)[:500]},
+                                        scenario_id=scen, engine=engine,
+                                        trace_id=r.trace_id)))
+            return out
+        dt = time.perf_counter() - t0
+        _trace.end_span(bsp, outcome="ok")
+        self.breaker.record_success()
+        _obs.record_query_batch(len(grp), dt)
+        done = self._clock()
+        for r in grp:
+            book, counts, sampler_d = results[id(r)]
+            _obs.record_query_outcome("ok")
+            _obs.record_query_latency(max(0.0, done - r.enq_t))
+            if r.span is not None:
+                _trace.end_span(r.span, outcome="ok", batch=self._batch_i)
+            resp = {"id": r.rid, "ok": True, "outcome": "ok",
+                    "kind": "sweep", "book": book, "counts": counts,
+                    "sampler": sampler_d}
             out.append((r.origin,
                         self._stamp(resp, scenario_id=scen, engine=engine,
                                     trace_id=r.trace_id)))

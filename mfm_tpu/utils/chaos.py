@@ -56,6 +56,8 @@ CRASH_POINTS = (
                                    # trace.json not yet renamed (obs/trace.py)
     "grad_report.after_tmp",       # grad solve done, grad_report.json tmp
                                    # not yet renamed (grad/report.py)
+    "sweep_manifest.after_tmp",    # streaming sweep done, sweep_manifest
+                                   # tmp not yet renamed (scenario/sweep.py)
 )
 
 
@@ -300,4 +302,11 @@ def plan_suite(seed: int = 0) -> tuple:
         FaultPlan("cache-stale-generation", "cache_stale", s + 23,
                   (("point", "save_artifact.after_tmp"),
                    ("repeats", 6))),
+        # streaming sweeps (PR 17): SIGKILL a real `scenario sweep`
+        # between the sweep manifest's tmp write and its rename — no
+        # torn sweep_manifest.json, checkpoint bytes untouched, and a
+        # clean seeded re-run lands byte-equal modulo the volatile obs
+        # summary block
+        FaultPlan("sweep-kill-mid-stream", "sweep_kill", s + 24,
+                  (("point", "sweep_manifest.after_tmp"),)),
     )

@@ -195,7 +195,8 @@ def test_plan_suite_is_deterministic():
                                    "scenario_kill", "scenario_poison",
                                    "trace_kill", "eigen_kill",
                                    "shard_kill", "grad_kill",
-                                   "fleet_kill", "cache_stale"}
+                                   "fleet_kill", "cache_stale",
+                                   "sweep_kill"}
     assert len({p.seed for p in a}) == len(a)
 
 

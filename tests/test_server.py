@@ -91,9 +91,10 @@ def test_parse_request_dict_weights_and_benchmark():
                 deadline_s=2.5)
     fields, mask, _ = parse_request(line, _engine(), ServePolicy())
     assert mask == 0
-    rid, w, bidx, deadline_s, scenario, trace_id, construct = fields
+    rid, w, bidx, deadline_s, scenario, trace_id, construct, sweep = fields
     assert rid == "x" and bidx == 1 and deadline_s == 2.5
     assert scenario is None and trace_id is None and construct is None
+    assert sweep is None
     np.testing.assert_array_equal(w, [0.0, 0.0, 0.7, 0.3])
 
 

@@ -1,0 +1,440 @@
+"""Streaming scenario sweeps (mfm_tpu/scenario/sweep.py).
+
+The subsystem's contracts:
+
+- **Streaming == materializing.** A sweep keeps only a fixed-size carry
+  (top-k worst per book, histogram sketch, counters) yet its answer is
+  BITWISE the materializing engine's: for the same sampler and chunk,
+  the streamed top-k (vol, scenario-index) table equals the reference
+  built from ``ScenarioEngine.run``'s (S, K, K) covariances through the
+  identical ``book_vols`` math — certified lanes and offender
+  (exact-path) lanes alike.
+- **Rejected lanes contaminate nothing.** A poisoned lane (NaN theta,
+  corr_beta at the -1 pole) is counted rejected and excluded from the
+  top-k, the histogram and n_ok; healthy batchmates' bytes don't move.
+- **Steady state.** After one warm chunk per rung, further chunks
+  compile NOTHING (the serving discipline: <= 1 compile per bucket).
+- **The manifest is atomic and audited.** Round trip, torn-file
+  detection, and ``audit_sweep_manifest`` rejecting hash drift.
+- **Samplers are seeded generators.** Byte-deterministic per (seed, n,
+  chunk); the replay library sweeps identity lanes over resolved
+  windows.
+- **Serving.** ``sweep`` is a guarded request kind with its own reason
+  bit, and sweep lines are cache-exempt by contract.
+"""
+
+import json
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from mfm_tpu.grad.engine import ShockBall
+from mfm_tpu.scenario import (
+    ScenarioEngine,
+    ScenarioSpec,
+    GridSampler,
+    ReplaySampler,
+    SobolSampler,
+    SweepEngine,
+    SweepManifestError,
+    UniformSampler,
+    audit_sweep_manifest,
+    build_sweep_manifest,
+    monthly_replay_windows,
+    read_sweep_manifest,
+    sweep_manifest_path_for,
+    theta_to_spec,
+    write_sweep_manifest,
+)
+from mfm_tpu.scenario.kernel import book_vols
+from mfm_tpu.utils.contracts import assert_max_compiles
+
+K = 10
+
+
+def _base_cov(seed=0, k=K, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    a = rng.normal(size=(k, k))
+    return ((a @ a.T + 1e-2 * np.eye(k)) * 1e-4).astype(dtype)
+
+
+def _names(k=K):
+    return [f"f{i}" for i in range(k)]
+
+
+def _books(n=2, seed=5, k=K):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((n, k)) / np.sqrt(k)).astype(np.float32)
+
+
+def _ball():
+    # deliberately spicy: corr_beta up to 0.9 pushes some lanes past the
+    # certificate so the offender exact path is exercised, not idle
+    return ShockBall(shift_max=5e-3, scale_range=0.4, vol_mult_lo=1.0,
+                     vol_mult_hi=3.0, corr_beta_lo=0.0, corr_beta_hi=0.9)
+
+
+def _reference_table(engine, sampler, chunk, xs, top_k):
+    """The materializing reference: every theta through
+    ``ScenarioEngine.run`` (the exact forward path, PSD gate included),
+    vols via the IDENTICAL ``book_vols`` math, top-k by descending vol
+    with the stream's merge tie-break (earlier scenario index wins)."""
+    import jax
+
+    ths = np.concatenate([th for th, _, _ in sampler.blocks(chunk)])
+    specs = [theta_to_spec(t, engine.factor_names, f"sweep-{i}")
+             for i, t in enumerate(ths)]
+    results = engine._scen.run(specs)
+    ok = [i for i, r in enumerate(results) if r.ok]
+    covs = np.stack([results[i].cov for i in ok])
+    vols = np.asarray(jax.jit(book_vols)(jnp.asarray(covs),
+                                         jnp.asarray(xs)))
+    tables = []
+    for b in range(xs.shape[0]):
+        order = sorted(range(len(ok)), key=lambda j: (-vols[b, j], ok[j]))
+        tables.append([(float(vols[b, j]), int(ok[j]))
+                       for j in order[:top_k]])
+    n_proj = sum(results[i].psd_projected for i in ok)
+    return tables, vols, ok, n_proj
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return SweepEngine(_base_cov(), factor_names=_names())
+
+
+# -- streaming == materializing parity ---------------------------------------
+
+def test_streaming_top_k_bitwise_matches_materializing(engine):
+    xs = _books()
+    sampler = UniformSampler(_ball(), K, 600, seed=3)
+    res = engine.sweep(xs, sampler, chunk=128, top_k=12, bins=64,
+                      refine=None)
+    assert res.counts["n_ok"] == 600 and res.counts["n_rejected"] == 0
+    # the spicy ball must actually exercise the offender exact path
+    assert res.counts["n_offenders"] > 0
+
+    ref_sampler = UniformSampler(_ball(), K, 600, seed=3)
+    ref_tables, vols, ok, n_proj = _reference_table(
+        engine, ref_sampler, 128, xs, 12)
+    for b, book in enumerate(res.books):
+        got = [(e["vol"], e["src"]) for e in book["top"]]
+        assert got == ref_tables[b], f"book {b} top-k diverged"
+    assert res.counts["n_psd_projected"] == n_proj
+
+
+def test_streaming_histogram_matches_materializing(engine):
+    xs = _books()
+    sampler = UniformSampler(_ball(), K, 384, seed=9)
+    res = engine.sweep(xs, sampler, chunk=128, top_k=4, bins=32,
+                      hist_span=8.0, refine=None)
+    ref_sampler = UniformSampler(_ball(), K, 384, seed=9)
+    _, vols, ok, _ = _reference_table(engine, ref_sampler, 128, xs, 4)
+    for b, book in enumerate(res.books):
+        lo, w = book["hist"]["lo"], book["hist"]["bin_width"]
+        bins = len(book["hist"]["counts"])
+        # the kernel's exact binning: clip into [0, bins-1]
+        bi = np.clip(((vols[b] - np.float32(lo)) / np.float32(w))
+                     .astype(np.int32), 0, bins - 1)
+        want = np.bincount(bi, minlength=bins)
+        np.testing.assert_array_equal(book["hist"]["counts"], want)
+        assert sum(book["hist"]["counts"]) == len(ok)
+
+
+def test_top1_spec_round_trips_through_materializing_engine(engine):
+    """The worst case is REPLAYABLE: its embedded spec re-runs through
+    the ordinary forward engine and lands on the identical vol."""
+    import jax
+
+    xs = _books()
+    res = engine.sweep(xs, UniformSampler(_ball(), K, 256, seed=1),
+                      chunk=128, top_k=4, refine=None)
+    for b, book in enumerate(res.books):
+        top = book["top"][0]
+        spec = ScenarioSpec.from_dict(top["spec"])
+        [r] = engine._scen.run([spec])
+        assert r.ok, r.problems
+        v = np.asarray(jax.jit(book_vols)(
+            jnp.asarray(r.cov[None]), jnp.asarray(xs[b:b + 1])))[0, 0]
+        assert float(v) == top["vol"]
+
+
+# -- rejected-lane exclusion ---------------------------------------------------
+
+class _PoisonSampler:
+    """Wraps a sampler, overwriting chosen lanes with inadmissible
+    thetas (NaN shift / corr_beta past the -1 pole)."""
+
+    kind = "poison"
+
+    def __init__(self, inner, poison_every=7):
+        self.inner = inner
+        self.cb_values = inner.cb_values
+        self.windows = inner.windows
+        self.n = inner.n
+        self.every = poison_every
+
+    def blocks(self, chunk):
+        i = 0
+        for th, bidx, lv in self.inner.blocks(chunk):
+            th = th.copy()
+            for j in range(len(th)):
+                if (i + j) % self.every == 0:
+                    if (i + j) % (2 * self.every) == 0:
+                        th[j, 0] = np.nan
+                    else:
+                        th[j, -1] = -1.5
+            i += len(th)
+            yield th, bidx, lv
+
+    def describe(self):
+        return {"kind": self.kind, "n": self.n}
+
+
+def test_rejected_lanes_excluded_and_counted(engine):
+    xs = _books()
+    inner = UniformSampler(_ball(), K, 256, seed=4)
+    poisoned = _PoisonSampler(inner, poison_every=7)
+    n_poison = len([i for i in range(256) if i % 7 == 0])
+    res = engine.sweep(xs, poisoned, chunk=64, top_k=8, refine=None)
+    assert res.counts["n_rejected"] == n_poison
+    assert res.counts["n_ok"] == 256 - n_poison
+    assert res.counts["n_scenarios"] == 256
+    poisoned_src = {i for i in range(256) if i % 7 == 0}
+    for book in res.books:
+        assert not ({e["src"] for e in book["top"]} & poisoned_src)
+        assert sum(book["hist"]["counts"]) == 256 - n_poison
+
+
+def test_healthy_lanes_unmoved_by_poisoned_batchmates(engine):
+    """The poisoned run's surviving top-k equals a clean run of ONLY the
+    healthy lanes — per-lane isolation, streamed."""
+    xs = _books()
+    res_p = engine.sweep(xs, _PoisonSampler(
+        UniformSampler(_ball(), K, 256, seed=4), 7), chunk=64, top_k=8,
+        refine=None)
+    ths = np.concatenate([
+        th for th, _, _ in UniformSampler(_ball(), K, 256,
+                                          seed=4).blocks(64)])
+    healthy = [i for i in range(256) if i % 7 != 0]
+    import jax
+    specs = [theta_to_spec(ths[i], engine.factor_names, f"sweep-{i}")
+             for i in healthy]
+    results = engine._scen.run(specs)
+    covs = np.stack([r.cov for r in results])
+    vols = np.asarray(jax.jit(book_vols)(jnp.asarray(covs),
+                                         jnp.asarray(xs)))
+    for b, book in enumerate(res_p.books):
+        order = sorted(range(len(healthy)),
+                       key=lambda j: (-vols[b, j], healthy[j]))
+        want = [(float(vols[b, j]), healthy[j]) for j in order[:8]]
+        assert [(e["vol"], e["src"]) for e in book["top"]] == want
+
+
+# -- steady-state compile discipline ------------------------------------------
+
+def test_steady_state_zero_compiles_across_two_rungs(engine):
+    xs = _books()
+    ball = ShockBall(shift_max=1e-3, scale_range=0.2, vol_mult_hi=2.0,
+                     corr_beta_hi=0.2)
+    # warm both chunk rungs (and the merge path) once
+    engine.sweep(xs, UniformSampler(ball, K, 64, seed=0), chunk=32,
+                 refine=None)
+    engine.sweep(xs, UniformSampler(ball, K, 256, seed=0), chunk=128,
+                 refine=None)
+    with assert_max_compiles(0, "sweep steady state, two chunk rungs"):
+        r1 = engine.sweep(xs, UniformSampler(ball, K, 64, seed=8),
+                          chunk=32, refine=None)
+        r2 = engine.sweep(xs, UniformSampler(ball, K, 256, seed=8),
+                          chunk=128, refine=None)
+    assert r1.counts["n_ok"] == 64 and r2.counts["n_ok"] == 256
+
+
+# -- samplers ------------------------------------------------------------------
+
+def test_uniform_sampler_byte_deterministic():
+    a = UniformSampler(_ball(), K, 300, seed=12)
+    b = UniformSampler(_ball(), K, 300, seed=12)
+    for (ta, ia, la), (tb, ib, lb) in zip(a.blocks(64), b.blocks(64)):
+        assert ta.tobytes() == tb.tobytes()
+        np.testing.assert_array_equal(la, lb)
+        np.testing.assert_array_equal(ia, ib)
+    # a different seed moves the draws
+    c = UniformSampler(_ball(), K, 300, seed=13)
+    assert next(iter(c.blocks(64)))[0].tobytes() != \
+        next(iter(a.blocks(64)))[0].tobytes()
+
+
+def test_grid_sampler_covers_the_plane():
+    g = GridSampler(_ball(), K, n_vol=5, n_corr=7)
+    ths = np.concatenate([th for th, _, _ in g.blocks(8)])
+    assert len(ths) == 35
+    assert len(np.unique(ths[:, 2 * K])) == 5
+    assert len(np.unique(ths[:, 2 * K + 1])) == 7
+    # vol shifts/scales stay neutral on the grid slice
+    assert (ths[:, :K] == 0).all() and (ths[:, K:2 * K] == 1).all()
+
+
+def test_sobol_sampler_records_its_engine():
+    s = SobolSampler(_ball(), K, 64, seed=2)
+    d = s.describe()
+    assert d["kind"] == "sobol"
+    assert d["qmc"] in ("sobol", "uniform-fallback")
+    ths = np.concatenate([th for th, _, _ in s.blocks(32)])
+    assert ths.shape == (64, 2 * K + 2)
+    lo, hi = _ball().bounds(K)
+    assert (ths >= np.asarray(lo) - 1e-12).all()
+    assert (ths <= np.asarray(hi) + 1e-12).all()
+
+
+def test_monthly_replay_windows_and_sampler():
+    dates = (list(np.arange("2024-01-03", "2024-01-20",
+                            dtype="datetime64[D]"))
+             + list(np.arange("2024-02-01", "2024-02-15",
+                              dtype="datetime64[D]")))
+    wins = monthly_replay_windows(dates)
+    assert wins == [("2024-01-03", "2024-01-19"),
+                    ("2024-02-01", "2024-02-14")]
+    rs = ReplaySampler(wins, K)
+    blocks = list(rs.blocks(8))
+    th, bidx, lv = blocks[0]
+    assert len(th) == 2
+    np.testing.assert_array_equal(bidx, [1, 2])     # rows into the library
+    assert (th[:, :K] == 0).all() and (th[:, 2 * K] == 1).all()
+
+
+def test_replay_sweep_serves_windows_identity(engine):
+    """A replay sweep resolves windows through replay_lookup and serves
+    each window's covariance back through the identity transform."""
+    import jax
+
+    win_cov = _base_cov(seed=7)
+
+    def lookup(start, end):
+        assert (start, end) == ("2024-01-02", "2024-01-31")
+        return win_cov
+
+    eng = SweepEngine(_base_cov(), factor_names=_names(),
+                      replay_lookup=lookup)
+    xs = _books(1)
+    res = eng.sweep(xs, ReplaySampler([("2024-01-02", "2024-01-31")], K),
+                    chunk=8, top_k=2, refine=None)
+    assert res.counts["n_ok"] == 1
+    top = res.books[0]["top"][0]
+    assert top["base_window"] == ["2024-01-02", "2024-01-31"]
+    v = np.asarray(jax.jit(book_vols)(
+        jnp.asarray(win_cov.astype(np.float32)[None]),
+        jnp.asarray(xs)))[0, 0]
+    assert top["vol"] == float(v)
+    spec = ScenarioSpec.from_dict(top["spec"])
+    assert spec.replay == ("2024-01-02", "2024-01-31")
+
+
+def test_unresolvable_window_rejects_its_lanes(engine):
+    eng = SweepEngine(_base_cov(), factor_names=_names(),
+                      replay_lookup=lambda s, e: None)
+    xs = _books(1)
+    res = eng.sweep(xs, ReplaySampler([("1999-01-01", "1999-01-31")], K),
+                    chunk=8, top_k=2, refine=None)
+    assert res.counts["n_ok"] == 0 and res.counts["n_rejected"] == 1
+    assert res.sampler.get("window_problems")
+
+
+# -- manifest ------------------------------------------------------------------
+
+def _small_result(engine):
+    return engine.sweep(_books(), UniformSampler(_ball(), K, 64, seed=6),
+                        chunk=32, top_k=4, refine=None)
+
+
+def test_manifest_round_trip_and_audit(engine, tmp_path):
+    res = _small_result(engine)
+    man = build_sweep_manifest(res, backend="cpu", staleness=0,
+                               summary={"trace_id": "t" * 32})
+    path = write_sweep_manifest(str(tmp_path), man)
+    assert path == sweep_manifest_path_for(str(tmp_path))
+    back = read_sweep_manifest(path)
+    assert back["sweep"]["counts"] == res.counts
+    problems, warnings = audit_sweep_manifest(path)
+    assert problems == []
+
+
+def test_manifest_torn_write_detected(tmp_path):
+    path = str(tmp_path / "sweep_manifest.json")
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write('{"schema_version": 1, "kind": "sweep_man')
+    with pytest.raises(SweepManifestError):
+        read_sweep_manifest(path)
+
+
+def test_manifest_audit_catches_spec_hash_drift(engine, tmp_path):
+    res = _small_result(engine)
+    man = build_sweep_manifest(res)
+    # corrupt one embedded top entry's recorded hash
+    man["sweep"]["books"][0]["top"][0]["spec_hash"] = "0" * 64
+    path = write_sweep_manifest(str(tmp_path), man)
+    problems, _ = audit_sweep_manifest(path)
+    assert problems, "hash drift must be a problem"
+
+
+# -- serving -------------------------------------------------------------------
+
+def _qengine():
+    from mfm_tpu.serve import QueryEngine
+    return QueryEngine(_base_cov(), factor_names=_names())
+
+
+def _sweep_line(rid="s0", **sweep):
+    return json.dumps({"id": rid, "weights": [1.0 / K] * K,
+                       "sweep": sweep or True})
+
+
+def test_parse_request_sweep_bits():
+    from mfm_tpu.serve import ServePolicy, parse_request
+    from mfm_tpu.serve.server import REQ_REASON_BAD_SWEEP
+
+    eng = _qengine()
+    fields, mask, _ = parse_request(
+        _sweep_line(n=128, chunk=64, top_k=4), eng, ServePolicy())
+    assert mask == 0
+    assert fields[-1] == {"sampler": "uniform", "n": 128, "chunk": 64,
+                          "top_k": 4, "bins": 64, "seed": 0}
+    for bad in ({"sampler": "bogus"}, {"n": 10 ** 9}, {"n": 0},
+                {"chunk": -1}, {"top_k": 1.5}, "not-a-spec"):
+        line = json.dumps({"id": "x", "weights": [0.1] * K, "sweep": bad})
+        _, mask, detail = parse_request(line, eng, ServePolicy())
+        assert mask & REQ_REASON_BAD_SWEEP, (bad, detail)
+    both = json.dumps({"id": "x", "weights": [0.1] * K, "sweep": True,
+                       "construct": "min_vol"})
+    _, mask, _ = parse_request(both, eng, ServePolicy())
+    assert mask & REQ_REASON_BAD_SWEEP
+
+
+def test_server_answers_sweep_requests():
+    import io
+    from mfm_tpu.serve import QueryServer, ServePolicy
+
+    srv = QueryServer(_qengine(), ServePolicy())
+    out = io.StringIO()
+    lines = [_sweep_line("s0", n=64, chunk=32, top_k=4, seed=3),
+             json.dumps({"id": "q0", "weights": [1.0 / K] * K})]
+    srv.run(iter(lines), out)
+    got = {json.loads(ln)["id"]: json.loads(ln)
+           for ln in out.getvalue().strip().splitlines()}
+    assert got["q0"]["outcome"] == "ok" and "book" not in got["q0"]
+    sw = got["s0"]
+    assert sw["outcome"] == "ok" and sw["kind"] == "sweep"
+    assert sw["counts"]["n_ok"] == 64
+    assert len(sw["book"]["top"]) == 4
+    assert sw["book"]["vol_base"] > 0
+
+
+def test_sweep_requests_are_cache_exempt():
+    from mfm_tpu.serve.cache import ResponseCache
+
+    cache = ResponseCache()
+    assert cache.key_for(_sweep_line(n=64)) is None
+    assert cache.lookup(_sweep_line(n=64)) == (None, None)
+    plain = json.dumps({"id": "q", "weights": [0.1] * K})
+    assert cache.key_for(plain) is not None

@@ -49,6 +49,7 @@ python bench.py --config alla    "${plat[@]}" | tail -1 > "$out/config4_alla.jso
 python bench.py --config alpha   "${plat[@]}" | tail -1 > "$out/config5_alpha.json"
 python bench.py --config query   "${plat[@]}" | tail -1 > "$out/config6_query.json"
 python bench.py --config scenario "${plat[@]}" | tail -1 > "$out/config7_scenario.json"
+python bench.py --config sweep   "${plat[@]}" | tail -1 > "$out/config11_sweep.json"
 python bench.py --config grad    "${plat[@]}" | tail -1 > "$out/config8_grad.json"
 python bench.py --config fleet   "${plat[@]}" | tail -1 > "$out/config9_fleet.json"
 python bench.py --config cache   "${plat[@]}" | tail -1 > "$out/config10_cache.json"
@@ -109,10 +110,13 @@ done
 # generation fence (no post-reload answer equals a pre-reload cached
 # body), and after a SIGKILL-torn checkpoint publish a cache-on serve
 # must replay byte-for-byte against a cache-off run (config 10's
-# evidence)
+# evidence), and the streaming sweep: SIGKILL between the sweep
+# manifest's tmp write and its rename — no torn sweep_manifest.json,
+# checkpoint bytes untouched, seeded re-run byte-equal modulo the obs
+# summary (config 11's evidence)
 python tools/faultinject.py --plans \
-  query-kill-mid-batch,query-poison-slab,query-overflow-storm,query-ckpt-swap,query-steady-state,scenario-kill-mid-batch,scenario-poison-spec,trace-kill-mid-flush,eigen-kill-mid-update,shard-kill-mid-append,grad-kill-mid-solve,fleet-kill-replica,cache-stale-generation \
-  || { echo "query/scenario/trace/grad/fleet/cache chaos plans failed — config6/7/8/9/10 numbers are not evidence" >&2
+  query-kill-mid-batch,query-poison-slab,query-overflow-storm,query-ckpt-swap,query-steady-state,scenario-kill-mid-batch,scenario-poison-spec,trace-kill-mid-flush,eigen-kill-mid-update,shard-kill-mid-append,grad-kill-mid-solve,fleet-kill-replica,cache-stale-generation,sweep-kill-mid-stream \
+  || { echo "query/scenario/trace/grad/fleet/cache/sweep chaos plans failed — config6/7/8/9/10/11 numbers are not evidence" >&2
        exit 1; }
 
 cat "$out"/config*.json

@@ -58,6 +58,7 @@ report.
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 import shutil
@@ -1146,6 +1147,84 @@ def run_scenario_kill(plan, base: Baseline, root: str) -> dict:
             "recovered_n_ok": man["n_ok"]}
 
 
+def run_sweep_kill(plan, base: Baseline, root: str) -> dict:
+    """sweep-kill-mid-stream: SIGKILL a real `mfm-tpu scenario sweep`
+    subprocess between the sweep manifest's tmp write and its rename.
+    No torn ``sweep_manifest.json`` may exist, the checkpoint's bytes
+    must be untouched by the crash, the clean seeded re-run must write a
+    manifest ``doctor --scenarios`` accepts, and two clean runs must be
+    byte-equal modulo the volatile obs summary block (the seeded-replay
+    contract of the streaming sweep)."""
+    from mfm_tpu.scenario.sweep import (
+        read_sweep_manifest, sweep_manifest_path_for,
+    )
+
+    point = plan.param("point")
+    d = _fresh_workdir(root, plan.name, base.snaps[0])
+    path = os.path.join(d, "state.npz")
+    ckpt_before = hashlib.sha256(open(path, "rb").read()).hexdigest()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo_root}
+
+    def _cmd(out_dir):
+        # small bounded sweep, refinement off: the plan probes the write
+        # protocol, not the throughput
+        return [sys.executable, "-m", "mfm_tpu.cli", "scenario", "sweep",
+                path, "--n", "512", "--chunk", "128", "--seed", "11",
+                "--top-k", "4", "--no-refine", "--out", out_dir]
+
+    proc = subprocess.run(_cmd(d), env={**env, "MFM_CHAOS_KILL": point},
+                          capture_output=True, text=True, timeout=600)
+    if proc.returncode != -signal.SIGKILL:
+        raise AssertionError(
+            f"{plan.name}: expected the sweep to die by SIGKILL at "
+            f"{point}, got rc={proc.returncode}\n{proc.stderr[-2000:]}")
+    mpath = sweep_manifest_path_for(d)
+    if os.path.exists(mpath):
+        raise AssertionError(f"{plan.name}: a sweep manifest exists "
+                             "despite the kill before its rename — the "
+                             "write is not tmp-then-rename atomic")
+    ckpt_after = hashlib.sha256(open(path, "rb").read()).hexdigest()
+    if ckpt_after != ckpt_before:
+        raise AssertionError(f"{plan.name}: the crashed sweep mutated the "
+                             "checkpoint — sweeps must be read-only "
+                             "against the fenced store")
+    # clean re-run: manifest lands, doctor accepts it
+    proc2 = subprocess.run(_cmd(d), env=env, capture_output=True, text=True,
+                           timeout=600)
+    if proc2.returncode != 0:
+        raise AssertionError(f"{plan.name}: post-crash sweep failed "
+                             f"rc={proc2.returncode}\n{proc2.stderr[-2000:]}")
+    man = read_sweep_manifest(mpath)      # raises on a torn manifest
+    counts = man["sweep"]["counts"]
+    if counts["n_ok"] < 512:
+        raise AssertionError(f"{plan.name}: recovered sweep answered "
+                             f"n_ok={counts['n_ok']}, expected >= 512")
+    doc = subprocess.run([sys.executable, "-m", "mfm_tpu.cli", "doctor", d,
+                          "--scenarios"],
+                         env=env, capture_output=True, text=True, timeout=600)
+    if doc.returncode != 0:
+        raise AssertionError(f"{plan.name}: doctor --scenarios rejects the "
+                             f"post-crash sweep manifest\n{doc.stdout[-2000:]}")
+    # seeded replay: a second clean run produces the same manifest modulo
+    # the volatile obs summary
+    d2 = os.path.join(root, plan.name + "-replay")
+    os.makedirs(d2)
+    proc3 = subprocess.run(_cmd(d2), env=env, capture_output=True, text=True,
+                           timeout=600)
+    if proc3.returncode != 0:
+        raise AssertionError(f"{plan.name}: replay sweep failed "
+                             f"rc={proc3.returncode}\n{proc3.stderr[-2000:]}")
+    if _manifest_modulo_summary(mpath) != _manifest_modulo_summary(
+            sweep_manifest_path_for(d2)):
+        raise AssertionError(f"{plan.name}: two clean seeded sweeps "
+                             "diverge (modulo the obs summary) — the "
+                             "stream is not seeded-replayable")
+    return {"killed_at": point, "manifest_after_crash": "absent",
+            "checkpoint": "bytes untouched",
+            "recovered_n_ok": int(counts["n_ok"])}
+
+
 def run_scenario_poison(plan, base: Baseline, root: str) -> dict:
     """scenario-poison-spec: poisoned specs (NaN shock, corr stress past
     -1, negative vol regime) are rejected per-lane with reported problems
@@ -1567,6 +1646,7 @@ RUNNERS = {"truncate": run_byte_fault, "corrupt": run_byte_fault,
            "query_steady": run_query_steady,
            "scenario_kill": run_scenario_kill,
            "scenario_poison": run_scenario_poison,
+           "sweep_kill": run_sweep_kill,
            "trace_kill": run_trace_kill, "eigen_kill": run_eigen_kill,
            "shard_kill": run_shard_kill, "grad_kill": run_grad_kill,
            "fleet_kill": run_fleet_kill, "cache_stale": run_cache_stale}

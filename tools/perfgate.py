@@ -56,6 +56,8 @@ METRIC_SPECS = {
     "tracing_overhead_frac": ("lower", 0.50, 0.01),
     "portfolios_per_sec": ("higher", 0.20, None),
     "scenarios_per_sec": ("higher", 0.20, None),
+    "sweep_scenarios_per_sec": ("higher", 0.20, None),
+    "sweep_speedup_x": ("higher", 0.20, None),
     "minvol_portfolios_per_sec_b100": ("higher", 0.20, None),
     "minvol_portfolios_per_sec_b10000": ("higher", 0.20, None),
     "reverse_scenarios_per_sec": ("higher", 0.20, None),
@@ -90,6 +92,9 @@ def extract_metrics(rec) -> dict:
         out["portfolios_per_sec"] = rec.get("value")
     elif metric == "scenario_throughput":
         out["scenarios_per_sec"] = rec.get("value")
+    elif metric == "sweep_throughput":
+        out["sweep_scenarios_per_sec"] = rec.get("value")
+        out["sweep_speedup_x"] = rec.get("speedup_x")
     elif metric == "grad_throughput":
         for k in ("minvol_portfolios_per_sec_b100",
                   "minvol_portfolios_per_sec_b10000",

@@ -40,8 +40,17 @@ import time
 import numpy as np
 
 #: request-shape mix: (plain query, benchmark query, scenario-tagged,
-#: construct) — must sum to 1
-DEFAULT_MIX = (0.55, 0.20, 0.15, 0.10)
+#: construct, sweep) — must sum to 1.  Sweeps default to a thin slice:
+#: each one is a bounded streaming batch job (hundreds of scenarios),
+#: ~100x a risk query, and they are cache-exempt by contract.
+DEFAULT_MIX = (0.53, 0.20, 0.15, 0.10, 0.02)
+
+#: the sweep slice's admission-bounded spec shape: small sampler, a
+#: handful of chunks (n/chunk = 4 donated jit calls per request).  The
+#: seed varies per line so every sweep body is unique — repeat-heavy
+#: (Zipf) streams still exercise the cache-exemption path via the pool.
+SWEEP_REQ = {"sampler": "uniform", "n": 512, "chunk": 128, "top_k": 4,
+             "bins": 64}
 
 
 def gen_requests(seed: int, n: int, k: int, *, mix=DEFAULT_MIX,
@@ -51,10 +60,10 @@ def gen_requests(seed: int, n: int, k: int, *, mix=DEFAULT_MIX,
     ``mix``.  ``scenario=None`` drops the scenario slice into plain
     queries (for servers without a scenario table).  Weights round to 6
     decimals so lines are platform-stable."""
-    if abs(sum(mix) - 1.0) > 1e-9 or len(mix) != 4:
-        raise ValueError(f"mix must be 4 fractions summing to 1, got {mix}")
+    if abs(sum(mix) - 1.0) > 1e-9 or len(mix) != 5:
+        raise ValueError(f"mix must be 5 fractions summing to 1, got {mix}")
     rng = np.random.default_rng(seed)
-    kinds = rng.choice(4, size=n, p=np.asarray(mix, dtype=np.float64))
+    kinds = rng.choice(5, size=n, p=np.asarray(mix, dtype=np.float64))
     lines = []
     for i in range(n):
         req = {"id": f"t{i}",
@@ -68,6 +77,8 @@ def gen_requests(seed: int, n: int, k: int, *, mix=DEFAULT_MIX,
         elif kind == 3:
             req["construct"] = {"solver": "min_vol" if i % 2 else
                                 "risk_parity"}
+        elif kind == 4:
+            req["sweep"] = {**SWEEP_REQ, "seed": i}
         lines.append(json.dumps(req, sort_keys=True))
     return lines
 
@@ -77,7 +88,7 @@ def gen_zipf_requests(seed: int, n: int, k: int, *, alpha: float = 1.0,
                       benchmark: str = "idx", scenario: str | None = None,
                       deadline_s: float = 600.0) -> list:
     """``n`` seeded lines drawn Zipf(``alpha``) from a pool of
-    ``distinct`` unique request BODIES (all four request kinds, per
+    ``distinct`` unique request BODIES (all five request kinds, per
     ``mix``).  Every emitted line keeps a unique id ``t{i}`` — only the
     id differs between repeats, which is exactly the shape the
     content-addressed response cache keys on (identity excluded).
@@ -181,8 +192,8 @@ def main(argv=None) -> int:
                     help="factor count of the served engine (weights "
                          "length)")
     ap.add_argument("--mix", default=",".join(str(m) for m in DEFAULT_MIX),
-                    help="plain,benchmark,scenario,construct fractions "
-                         f"(default {DEFAULT_MIX})")
+                    help="plain,benchmark,scenario,construct,sweep "
+                         f"fractions (default {DEFAULT_MIX})")
     ap.add_argument("--benchmark", default="idx")
     ap.add_argument("--scenario", default=None,
                     help="scenario tag for the scenario slice (default: "
