@@ -1282,10 +1282,11 @@ def _doctor(args):
     )
 
     if args.path is None:
-        if not getattr(args, "audit", None):
-            raise SystemExit("doctor: PATH is required unless --audit is "
-                             "given (the static-audit snapshot check "
-                             "needs no serving artifacts)")
+        if not (getattr(args, "audit", None)
+                or getattr(args, "sync", None)):
+            raise SystemExit("doctor: PATH is required unless --audit or "
+                             "--sync is given (the static checks need no "
+                             "serving artifacts)")
         paths = []
     elif os.path.isdir(args.path):
         paths = sorted(glob.glob(os.path.join(args.path, "*.npz")))
@@ -1656,6 +1657,34 @@ def _doctor(args):
                     rec["summary"] = doc.get("summary")
                 if rec["problems"]:
                     rec["status"] = "unhealthy"
+    # --sync: run the lock-discipline pass strict against its committed
+    # baseline — new findings are problems, a stale baseline is a
+    # problem too (the justified exception no longer exists), baselined
+    # findings are warnings so the operator sees what is being excused
+    if getattr(args, "sync", None):
+        from mfm_tpu.analysis.sync import (
+            DEFAULT_BASELINE as _SYNC_BASELINE, REPO_ROOT as _SYNC_ROOT,
+            load_baseline as _load_sync_baseline, run_sync,
+        )
+
+        bpath = os.path.join(_SYNC_ROOT, _SYNC_BASELINE)
+        rec = {"file": bpath, "kind": "sync_analysis", "status": "ok",
+               "problems": [], "warnings": []}
+        records.append(rec)
+        res = run_sync(baseline=_load_sync_baseline(bpath))
+        for v in res.new:
+            rec["problems"].append(
+                f"{v.file}:{v.line}: {v.rule} [{v.qualname}] {v.message}")
+        for b in res.stale:
+            rec["problems"].append(
+                f"stale baseline entry: {b['file']} {b['rule']} "
+                f"[{b['qualname']}] — the finding no longer exists")
+        for v in res.baselined:
+            rec["warnings"].append(
+                f"baselined: {v.file} {v.rule} [{v.qualname}]")
+        rec["baselined"] = len(res.baselined)
+        if rec["problems"]:
+            rec["status"] = "unhealthy"
     unhealthy = sum(r["status"] != "ok" for r in records)
     print(json.dumps({"audited": len(records), "unhealthy": unhealthy,
                       "records": records}, indent=1))
@@ -2436,6 +2465,21 @@ def _lint_cmd(args):
     raise SystemExit(lint_main(lint_argv))
 
 
+def _sync_cmd(args):
+    # stdlib-only AST pass (mfm_tpu/analysis/sync.py): lock discipline and
+    # shared-state analysis for the serving fleet — no backend, no numpy
+    from mfm_tpu.analysis.sync import main as sync_main
+
+    sync_argv = list(args.paths)
+    if args.baseline:
+        sync_argv += ["--baseline", args.baseline]
+    if args.strict:
+        sync_argv.append("--strict")
+    if args.json:
+        sync_argv.append("--json")
+    raise SystemExit(sync_main(sync_argv))
+
+
 def _audit_cmd(args):
     # device-free IR audit (mfm_tpu/analysis/): lowers and compiles every
     # registered entrypoint on whatever backend is pinned, executes
@@ -2929,6 +2973,23 @@ def main(argv=None):
                     help="machine-readable output")
     ln.set_defaults(fn=_lint_cmd)
 
+    sy = sub.add_parser(
+        "sync",
+        help="lock-discipline & shared-state static analysis for the "
+             "serving fleet (rules S1-S3: guarded-field accesses, "
+             "lock-order cycles, blocking under a lock; docs/DOCTRINE.md "
+             "§Concurrency doctrine)")
+    sy.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: mfm_tpu)")
+    sy.add_argument("--baseline", default=None,
+                    help="baseline JSON ('none' disables; default: "
+                         "tools/mfmsync_baseline.json)")
+    sy.add_argument("--strict", action="store_true",
+                    help="also fail on stale baseline entries")
+    sy.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    sy.set_defaults(fn=_sync_cmd)
+
     au = sub.add_parser(
         "audit",
         help="IR-level static audit of every jit entrypoint: donation-"
@@ -2985,6 +3046,12 @@ def main(argv=None):
                          "and staleness vs the live registry and budget "
                          "file; exit non-zero on a torn or tampered "
                          "snapshot")
+    dr.add_argument("--sync", action="store_true",
+                    help="also run the lock-discipline pass (mfm-tpu "
+                         "sync --strict) against its committed baseline: "
+                         "exit non-zero on new S1-S3 findings or stale "
+                         "baseline entries; baselined findings surface "
+                         "as warnings")
     dr.set_defaults(fn=_doctor)
 
     sv = sub.add_parser(

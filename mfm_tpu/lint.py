@@ -116,7 +116,15 @@ _R7_HOST_ONLY_MODULES = ("mfm_tpu.serve.server", "mfm_tpu.cli",
                          # grad/construct.py, grad/sensitivity.py — all
                          # fully lintable)
                          "mfm_tpu.grad.engine",
-                         "mfm_tpu.grad.report")
+                         "mfm_tpu.grad.report",
+                         # concurrency tooling: the AST lock-discipline
+                         # pass and the deterministic scheduler are pure
+                         # host code, and their stdlib-shaped method
+                         # names (run/get/put/add/wait/value) collide
+                         # with half the package under bare-name
+                         # resolution
+                         "mfm_tpu.analysis.sync",
+                         "mfm_tpu.utils.sched")
 
 
 def _is_obs_module(module: str) -> bool:

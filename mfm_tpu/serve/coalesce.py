@@ -181,7 +181,11 @@ class Coalescer:
             raise ValueError("Coalescer.start() needs a deliver callback")
         if self._flusher is not None:
             return
-        self._stopping = False
+        # S1 (mfmsync): _stopping is read by the flusher under _lock; a
+        # bare write here could race a concurrent stop() and strand the
+        # new thread in an immediate-exit or never-exit state.
+        with self._lock:
+            self._stopping = False
         self._flusher = threading.Thread(target=self._flush_loop,
                                          name="mfm-coalesce-flusher",
                                          daemon=True)

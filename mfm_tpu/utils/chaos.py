@@ -309,4 +309,21 @@ def plan_suite(seed: int = 0) -> tuple:
         # summary block
         FaultPlan("sweep-kill-mid-stream", "sweep_kill", s + 24,
                   (("point", "sweep_manifest.after_tmp"),)),
+        # mfmsync race harness (PR 18): deterministic-interleaving
+        # schedule drills.  A seeded cooperative scheduler
+        # (mfm_tpu/utils/sched.py) serializes real threads through
+        # instrumented lock/condition hooks, so each seed IS a hostile
+        # interleaving — replayable bit-for-bit.  The coalescer drill
+        # races T submitters against a flusher (then hammers a live
+        # socket frontend) and requires responses bitwise == the
+        # sequential loop per id; the cache drill storms hit/miss/put
+        # while a fencer moves the generation mid-storm and requires
+        # hits byte-equal cold, LRU bounds intact, and a monotone fence
+        FaultPlan("sync-schedule-coalescer", "sync_schedule_coalescer",
+                  s + 25, (("seeds", 10), ("threads", 3), ("n", 12),
+                           ("hammer_threads", 4), ("hammer_n", 32))),
+        FaultPlan("sync-schedule-cache", "sync_schedule_cache", s + 26,
+                  (("seeds", 10), ("threads", 3), ("ops", 10),
+                   ("bodies", 6), ("max_entries", 4),
+                   ("max_bytes", 4096))),
     )

@@ -319,6 +319,46 @@ def test_registry_covers_every_package_jit_root():
         f"{sorted(ghosts)} — remove the stale entries")
 
 
+def test_every_thread_target_is_sync_analyzed_or_justified():
+    """The concurrency analogue of the jit-root test: every
+    ``threading.Thread(target=...)`` spawned anywhere in the package must
+    resolve to a method of a class mfmsync reasons about (one owning a
+    lock or queue field, directly or by inheritance), or carry a
+    reviewed rule-"S4" justification in tools/mfmsync_baseline.json —
+    and neither list may go stale."""
+    from pathlib import Path
+
+    from mfm_tpu.analysis.sync import (
+        DEFAULT_BASELINE, REPO_ROOT, load_baseline, run_sync)
+
+    res = run_sync()
+    covered, uncovered = res.analyzer.thread_target_coverage()
+    assert covered, "no thread targets found — analyzer regression?"
+    # the four known spawn sites: frontend write loop, the two protocol
+    # readers (one IfExp site), frontend serve, coalescer flush loop
+    quals = {rec["target"] for rec in covered}
+    for must in ("_Conn._write_loop", "SocketFrontend.serve",
+                 "Coalescer._flush_loop"):
+        assert any(q and q.endswith(must) for q in quals), \
+            f"lost track of the {must} thread spawn"
+
+    baseline = load_baseline(
+        str(Path(REPO_ROOT) / DEFAULT_BASELINE))
+    justified = {(b["file"], b["qualname"]) for b in baseline
+                 if b["rule"] == "S4"}
+    needs = {(rec["file"], rec["target"] or rec["expr"])
+             for rec in uncovered}
+    missing = needs - justified
+    assert not missing, (
+        f"thread targets outside any mfmsync-analyzed class with no S4 "
+        f"justification: {sorted(missing)} — give the target's class a "
+        f"lock, or add a justified S4 entry to tools/mfmsync_baseline.json")
+    ghosts = justified - needs
+    assert not ghosts, (
+        f"stale S4 baseline entries (targets now covered or gone): "
+        f"{sorted(ghosts)} — remove them")
+
+
 def test_registry_by_name_and_donation_contracts():
     ep = registry_by_name("risk.fused")
     assert ep.donate == (0, 1, 2, 3, 4)

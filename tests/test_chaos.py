@@ -196,7 +196,9 @@ def test_plan_suite_is_deterministic():
                                    "trace_kill", "eigen_kill",
                                    "shard_kill", "grad_kill",
                                    "fleet_kill", "cache_stale",
-                                   "sweep_kill"}
+                                   "sweep_kill",
+                                   "sync_schedule_coalescer",
+                                   "sync_schedule_cache"}
     assert len({p.seed for p in a}) == len(a)
 
 

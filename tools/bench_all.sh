@@ -34,6 +34,13 @@ JAX_PLATFORMS=cpu python tools/mfmaudit.py --strict \
   || { echo "mfmaudit violations — fix, re-budget, or baseline before benching" >&2
        exit 1; }
 
+# ... and the concurrency doctrine beside them: serving-fleet numbers from a
+# tree with an unguarded shared field, a lock-order cycle, or blocking under
+# a lock (mfmsync S1-S3) measure a race, not the service
+JAX_PLATFORMS=cpu python tools/mfmsync.py --strict \
+  || { echo "mfmsync violations — fix or baseline before benching" >&2
+       exit 1; }
+
 # probe the backend ONCE here: each bench.py run would otherwise repeat its
 # own multi-attempt probe (~6.5 min per config against a dead tunnel);
 # a dead tunnel pins every config straight to the CPU fallback instead
@@ -113,10 +120,15 @@ done
 # evidence), and the streaming sweep: SIGKILL between the sweep
 # manifest's tmp write and its rename — no torn sweep_manifest.json,
 # checkpoint bytes untouched, seeded re-run byte-equal modulo the obs
-# summary (config 11's evidence)
+# summary (config 11's evidence), and the schedule drills: adversarial
+# deterministic interleavings (mfm_tpu/utils/sched.py) plus a live
+# closed-loop socket hammer must keep the coalescer responses bitwise the
+# sequential loop per id, and a concurrent hit/miss/reload storm must keep
+# cache hits byte-equal cold with the LRU bounds and generation fence
+# intact — the runtime confirmation of mfmsync's static findings
 python tools/faultinject.py --plans \
-  query-kill-mid-batch,query-poison-slab,query-overflow-storm,query-ckpt-swap,query-steady-state,scenario-kill-mid-batch,scenario-poison-spec,trace-kill-mid-flush,eigen-kill-mid-update,shard-kill-mid-append,grad-kill-mid-solve,fleet-kill-replica,cache-stale-generation,sweep-kill-mid-stream \
-  || { echo "query/scenario/trace/grad/fleet/cache/sweep chaos plans failed — config6/7/8/9/10/11 numbers are not evidence" >&2
+  query-kill-mid-batch,query-poison-slab,query-overflow-storm,query-ckpt-swap,query-steady-state,scenario-kill-mid-batch,scenario-poison-spec,trace-kill-mid-flush,eigen-kill-mid-update,shard-kill-mid-append,grad-kill-mid-solve,fleet-kill-replica,cache-stale-generation,sweep-kill-mid-stream,sync-schedule-coalescer,sync-schedule-cache \
+  || { echo "query/scenario/trace/grad/fleet/cache/sweep/schedule chaos plans failed — config6/7/8/9/10/11 numbers are not evidence" >&2
        exit 1; }
 
 cat "$out"/config*.json

@@ -61,11 +61,13 @@ import argparse
 import hashlib
 import json
 import os
+import random
 import shutil
 import signal
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -1637,6 +1639,274 @@ def run_cache_stale(plan, base: Baseline, root: str) -> dict:
             "replay": "bitwise per id", "responses": n}
 
 
+# -- schedule-perturbation plans (mfmsync's runtime half) --------------------
+
+def _resp_body(resp: dict) -> str:
+    """Canonical response body with the identity keys stripped — the
+    byte-identity unit every schedule drill compares on."""
+    return json.dumps({f: v for f, v in resp.items()
+                       if f not in ("id", "trace_id")}, sort_keys=True)
+
+
+def run_sync_schedule_coalescer(plan, base: Baseline, root: str) -> dict:
+    """sync-schedule-coalescer: the coalescer's bitwise contract must
+    survive adversarial flush/submit interleavings.  Phase 1
+    (deterministic): the coalescer's RLock/Condition are transplanted
+    with DetScheduler primitives and a seed sweep explores hostile
+    schedules of T submitter threads racing an explicit flusher — every
+    request id must be answered exactly once, byte-equal the sequential
+    loop.  Phase 2 (live): a real SocketFrontend serves the same engine
+    while trafficgen's closed-loop hammer pins T client connections on
+    it; each thread asserts in-order responses on its own connection
+    (one in flight -> order IS the protocol) and the union replays
+    bitwise per id against the sequential reference."""
+    from mfm_tpu.serve import Coalescer, QueryServer, ServePolicy
+    from mfm_tpu.serve.frontend import SocketFrontend
+    from mfm_tpu.utils.sched import DetCondition, DetRLock, DetScheduler
+
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    from trafficgen import hammer
+
+    seeds = int(plan.param("seeds", 10))
+    n_threads = int(plan.param("threads", 3))
+    n = int(plan.param("n", 12))
+    d = _fresh_workdir(root, plan.name, base.snaps[0])
+    path = os.path.join(d, "state.npz")
+    k = _query_engine(path).K
+    lines = _query_requests(plan.seed, n, k)
+
+    def make_co(deliver=None):
+        server = QueryServer(_query_engine(path),
+                             ServePolicy(batch_max=4,
+                                         default_deadline_s=600.0),
+                             health="ok")
+        # frozen clock + huge linger: flushes happen only at batch_max
+        # and at explicit flush() calls, so a schedule fully determines
+        # the batch shapes
+        return Coalescer(server, linger_s=600.0, clock=lambda: 0.0,
+                         deliver=deliver)
+
+    def sequential(ls) -> dict:
+        co = make_co()
+        pairs = []
+        for ln in ls:
+            pairs += co.submit(ln)
+        pairs += co.flush()
+        out = {r["id"]: _resp_body(r) for _o, r in pairs}
+        if len(out) != len(ls):
+            raise AssertionError(f"{plan.name}: sequential reference "
+                                 f"answered {len(out)}/{len(ls)}")
+        return out
+
+    ref = sequential(lines)
+    for sd in range(seeds):
+        s = DetScheduler(plan.seed + sd)
+        co = make_co()
+        co._lock = DetRLock(s, "coalesce")
+        co._wake = DetCondition(s, co._lock)
+        got: list = []
+
+        def submitter(sl):
+            for ln in sl:
+                got.extend(co.submit(ln))
+
+        def flusher():
+            for _ in range(n):
+                got.extend(co.flush())
+
+        for i in range(n_threads):
+            s.spawn(submitter, lines[i::n_threads], name=f"sub{i}")
+        s.spawn(flusher, name="flusher")
+        s.run()
+        # final drain on the main thread with real primitives (the
+        # scheduler's are only usable from spawned workers)
+        co._lock = threading.RLock()
+        co._wake = threading.Condition(co._lock)
+        got.extend(co.flush())
+        by_id: dict = {}
+        for _origin, r in got:
+            if r["id"] in by_id:
+                raise AssertionError(f"{plan.name}: seed {sd} answered "
+                                     f"{r['id']} twice")
+            by_id[r["id"]] = _resp_body(r)
+        if set(by_id) != set(ref):
+            missing = sorted(set(ref) - set(by_id))
+            raise AssertionError(f"{plan.name}: seed {sd} dropped "
+                                 f"{missing[:4]}")
+        diverged = [i for i in sorted(ref) if by_id[i] != ref[i]]
+        if diverged:
+            raise AssertionError(f"{plan.name}: seed {sd}: "
+                                 f"{len(diverged)} responses diverge "
+                                 f"from the sequential loop under this "
+                                 f"interleaving (first: {diverged[0]})")
+
+    # -- phase 2: live socket frontend under the closed-loop hammer ----------
+    h_threads = int(plan.param("hammer_threads", 4))
+    h_n = int(plan.param("hammer_n", 32))
+    h_lines = _query_requests(plan.seed + 1, h_n, k)
+    ref2 = sequential(h_lines)
+    fe = SocketFrontend("127.0.0.1", 0)
+    server = QueryServer(_query_engine(path),
+                         ServePolicy(batch_max=4, default_deadline_s=600.0),
+                         health="ok")
+    fe.backend = Coalescer(server, linger_s=0.005, deliver=fe.deliver)
+    host, port = fe.listen()
+    accept_thread = fe.start()
+    try:
+        rep = hammer((host, port), [h_lines[i::h_threads]
+                                    for i in range(h_threads)])
+    finally:
+        fe.stop()
+        accept_thread.join(timeout=10.0)
+    if set(rep["responses"]) != set(ref2):
+        raise AssertionError(f"{plan.name}: hammer answered "
+                             f"{len(rep['responses'])}/{len(ref2)}")
+    diverged = [i for i in sorted(ref2)
+                if _resp_body(json.loads(rep["responses"][i])) != ref2[i]]
+    if diverged:
+        raise AssertionError(f"{plan.name}: {len(diverged)} hammered "
+                             f"responses diverge from the sequential "
+                             f"loop (first: {diverged[0]})")
+    return {"det_seeds": seeds, "det_threads": n_threads, "requests": n,
+            "hammer_threads": h_threads, "hammer_requests": h_n,
+            "replay": "bitwise per id (both phases)"}
+
+
+def run_sync_schedule_cache(plan, base: Baseline, root: str) -> dict:
+    """sync-schedule-cache: a concurrent hit/miss/reload storm on the
+    response cache under deterministic schedules.  T workers race
+    lookup/put over a small repeat-heavy body pool while a fencer thread
+    moves the generation fence mid-storm, all serialized by a seeded
+    DetScheduler through an instrumented cache lock.  Contracts: every
+    hit is byte-equal the cold body OF ITS OWN GENERATION, the LRU
+    bounds (entries AND resident bytes) hold at every step, per-worker
+    observed generations are monotone (the fence never serves stale),
+    and the post-storm stream re-warms under the new fence."""
+    from mfm_tpu.serve import Coalescer, QueryServer, ResponseCache, \
+        ServePolicy
+    from mfm_tpu.utils.sched import DetLock, DetScheduler
+
+    seeds = int(plan.param("seeds", 10))
+    n_threads = int(plan.param("threads", 3))
+    ops = int(plan.param("ops", 10))
+    n_bodies = int(plan.param("bodies", 6))
+    max_entries = int(plan.param("max_entries", 4))
+    max_bytes = int(plan.param("max_bytes", 4096))
+
+    d_a = _fresh_workdir(root, plan.name, base.snaps[0])       # gen 0
+    d_b = _fresh_workdir(root, plan.name + "-next", base.snaps[1])
+    path_a = os.path.join(d_a, "state.npz")
+    path_b = os.path.join(d_b, "state.npz")
+    k = _query_engine(path_a).K
+    rng = np.random.default_rng(plan.seed)
+    bodies = [{"weights": np.round(rng.normal(0.0, 1.0, k), 6).tolist(),
+               "deadline_s": 600.0} for _ in range(n_bodies)]
+
+    def line_for(bi: int, rid: str) -> str:
+        return json.dumps({"id": rid, **bodies[bi]}, sort_keys=True)
+
+    def cold_bodies(path: str) -> list:
+        server = QueryServer(_query_engine(path),
+                             ServePolicy(batch_max=8,
+                                         default_deadline_s=600.0),
+                             health="ok")
+        co = Coalescer(server, linger_s=600.0, clock=lambda: 0.0)
+        out = []
+        for i in range(n_bodies):
+            pairs = co.submit(line_for(i, f"ref{i}")) + co.flush()
+            if len(pairs) != 1 or pairs[0][1].get("outcome") != "ok":
+                raise AssertionError(f"{plan.name}: cold ref {i} not ok")
+            out.append(pairs[0][1])
+        return out
+
+    ref = {0: cold_bodies(path_a), 1: cold_bodies(path_b)}
+    for i in range(n_bodies):
+        if _resp_body(ref[0][i]) == _resp_body(ref[1][i]):
+            raise AssertionError(f"{plan.name}: generations answer body "
+                                 f"{i} identically — staleness would be "
+                                 "invisible")
+
+    hit_gens: dict = {0: 0, 1: 0}
+    for sd in range(seeds):
+        s = DetScheduler(plan.seed + sd)
+        cache = ResponseCache(max_entries, max_bytes, generation=0)
+        cache._lock = DetLock(s, "cache")
+        events: list = []
+
+        def worker(w: int):
+            wrng = random.Random((plan.seed, sd, w))
+            last_gen = -1
+            for j in range(ops):
+                bi = wrng.randrange(n_bodies)
+                line = line_for(bi, f"c{w}x{j}")
+                resp, tok = cache.lookup(line)
+                if tok is None:
+                    raise AssertionError(f"{plan.name}: body {bi} "
+                                         "uncacheable")
+                gen = tok[1]        # the key carries its generation
+                if gen < last_gen:
+                    raise AssertionError(
+                        f"{plan.name}: seed {sd} worker {w} went "
+                        f"backwards across the fence ({last_gen} -> "
+                        f"{gen}) — stale generation served")
+                last_gen = gen
+                if resp is None:
+                    filled = dict(ref[gen][bi])
+                    filled["id"] = f"c{w}x{j}"
+                    cache.put(tok, filled)
+                    events.append(("miss", gen))
+                else:
+                    if _resp_body(resp) != _resp_body(ref[gen][bi]):
+                        raise AssertionError(
+                            f"{plan.name}: seed {sd} worker {w}: hit on "
+                            f"body {bi} is not byte-equal the gen-{gen} "
+                            "cold response")
+                    events.append(("hit", gen))
+                if len(cache) > max_entries:
+                    raise AssertionError(f"{plan.name}: entry bound "
+                                         f"blown: {len(cache)}")
+                if cache.resident_bytes > max_bytes:
+                    raise AssertionError(f"{plan.name}: byte bound "
+                                         f"blown: {cache.resident_bytes}")
+
+        def fencer():
+            # park mid-storm before fencing: the fencer has far fewer
+            # scheduling points than the workers, so without the idle
+            # yields it would almost always fence before the first
+            # repeat hit and the gen-0 side would go untested
+            for _ in range(ops * n_threads // 2):
+                s.yield_point("fencer-idle")
+            cache.set_fence(generation=1)
+            events.append(("fence", 1))
+
+        for w in range(n_threads):
+            s.spawn(worker, w, name=f"w{w}")
+        s.spawn(fencer, name="fencer")
+        s.run()
+        for kind, gen in events:
+            if kind == "hit":
+                hit_gens[gen] += 1
+        # post-storm: the stream must re-warm under the new fence
+        cache._lock = threading.Lock()
+        r0, t0 = cache.lookup(line_for(0, "rewarm0"))
+        if t0[1] != 1:
+            raise AssertionError(f"{plan.name}: fence did not move")
+        if r0 is not None and _resp_body(r0) != _resp_body(ref[1][0]):
+            raise AssertionError(f"{plan.name}: post-storm hit served a "
+                                 "stale body across the fence")
+    if not hit_gens[0] or not hit_gens[1]:
+        raise AssertionError(f"{plan.name}: storm produced no hits on "
+                             f"one side of the fence ({hit_gens}) — the "
+                             "byte-equality check proved nothing")
+    return {"det_seeds": seeds, "workers": n_threads,
+            "ops_per_worker": ops, "bodies": n_bodies,
+            "hits_gen0": hit_gens[0], "hits_gen1": hit_gens[1],
+            "bounds": f"entries<={max_entries}, bytes<={max_bytes}",
+            "fence": "monotone per worker, re-warm confirmed"}
+
+
 RUNNERS = {"truncate": run_byte_fault, "corrupt": run_byte_fault,
            "kill": run_kill, "kill_manifest": run_kill_manifest,
            "nan_slab": run_poison, "outlier_slab": run_poison,
@@ -1649,7 +1919,9 @@ RUNNERS = {"truncate": run_byte_fault, "corrupt": run_byte_fault,
            "sweep_kill": run_sweep_kill,
            "trace_kill": run_trace_kill, "eigen_kill": run_eigen_kill,
            "shard_kill": run_shard_kill, "grad_kill": run_grad_kill,
-           "fleet_kill": run_fleet_kill, "cache_stale": run_cache_stale}
+           "fleet_kill": run_fleet_kill, "cache_stale": run_cache_stale,
+           "sync_schedule_coalescer": run_sync_schedule_coalescer,
+           "sync_schedule_cache": run_sync_schedule_cache}
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""mfmsync — lock-discipline & shared-state static analysis CLI.
+
+Thin shim over mfm_tpu.analysis.sync so the checker can run standalone
+(pre-commit, CI) without installing the package.  Same exit convention
+as mfmlint/mfmaudit: 0 clean, 1 on new findings (or stale baseline
+entries under --strict).
+"""
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from mfm_tpu.analysis.sync import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import socket
 import sys
 import threading
 import time
@@ -165,6 +166,73 @@ def closed_loop(submit_and_wait, lines, concurrency: int) -> dict:
             "n": len(lines)}
 
 
+def hammer(addr, lines_per_thread, *, timeout_s: float = 120.0) -> dict:
+    """Closed-loop hammer against a live socket frontend: one TCP
+    connection per client thread, exactly one request in flight each.
+
+    The JSONL protocol answers every request with exactly one line on
+    the same connection, so with one request in flight the response
+    order IS assertable: each thread requires ``resp["id"] == sent id``
+    line-for-line, which is how the ``sync-schedule-coalescer`` drill
+    detects a cross-connection delivery mixup or a dropped response
+    under adversarial flush/submit interleavings.
+
+    ``lines_per_thread`` is a list of request-line lists, one per
+    thread.  Returns per-thread ordered ``(id, response-line)`` pairs
+    plus a flat ``id -> response-line`` map for bitwise comparison
+    against a sequential reference run.  Raises the first per-thread
+    assertion failure after all threads finish.
+    """
+    host, port = addr
+    results: dict = {}
+    errors: list = []
+
+    def client(tix: int, lines) -> None:
+        got = []
+        try:
+            with socket.create_connection((host, int(port)),
+                                          timeout=timeout_s) as sk:
+                rf = sk.makefile("r", encoding="utf-8")
+                for line in lines:
+                    sent = json.loads(line)["id"]
+                    sk.sendall((line + "\n").encode("utf-8"))
+                    resp_line = rf.readline()
+                    if not resp_line:
+                        raise AssertionError(
+                            f"hammer thread {tix}: connection closed "
+                            f"with {sent!r} in flight")
+                    resp = json.loads(resp_line)
+                    if resp.get("id") != sent:
+                        raise AssertionError(
+                            f"hammer thread {tix}: response order "
+                            f"violated — sent {sent!r}, got "
+                            f"{resp.get('id')!r}")
+                    got.append((sent, resp_line.rstrip("\n")))
+        except BaseException as exc:
+            errors.append((tix, exc))
+        results[tix] = got
+
+    threads = [threading.Thread(target=client, args=(i, lines),
+                                name=f"hammer-{i}", daemon=True)
+               for i, lines in enumerate(lines_per_thread)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        tix, exc = sorted(errors)[0]
+        raise AssertionError(f"hammer thread {tix} failed: {exc}") from (
+            exc if isinstance(exc, Exception) else None)
+    flat = {rid: line for got in results.values() for rid, line in got}
+    n = sum(len(g) for g in results.values())
+    return {"threads": len(threads), "n": n, "wall_s": wall,
+            "qps": n / wall if wall else 0.0,
+            "per_thread": {i: results[i] for i in sorted(results)},
+            "responses": flat}
+
+
 def latency_stats(arrivals, completions) -> dict:
     """p50/p99/max of (completion - arrival) for matched ordinals.
     ``completions`` maps ordinal -> completion clock time; unanswered
@@ -207,7 +275,19 @@ def main(argv=None) -> int:
     ap.add_argument("--distinct", type=int, default=100,
                     help="unique request bodies in the Zipf pool "
                          "(default 100; only with --zipf)")
+    ap.add_argument("--hammer", type=int, default=None, metavar="T",
+                    help="instead of printing the stream, drive it "
+                         "closed-loop from T client threads against "
+                         "--connect, asserting per-thread response "
+                         "order; responses go to stdout, a stats line "
+                         "to stderr")
+    ap.add_argument("--connect", default=None, metavar="HOST:PORT",
+                    help="socket frontend address for --hammer")
     args = ap.parse_args(argv)
+    if (args.hammer is None) != (args.connect is None):
+        ap.error("--hammer and --connect go together")
+    if args.hammer is not None and args.hammer < 1:
+        ap.error("--hammer needs at least one thread")
     mix = tuple(float(x) for x in args.mix.split(","))
     if args.zipf is not None:
         lines = gen_zipf_requests(args.seed, args.n, args.k,
@@ -220,6 +300,16 @@ def main(argv=None) -> int:
                              benchmark=args.benchmark,
                              scenario=args.scenario,
                              deadline_s=args.deadline_s)
+    if args.hammer is not None:
+        host, _, port = args.connect.rpartition(":")
+        per_thread = [lines[i::args.hammer] for i in range(args.hammer)]
+        rep = hammer((host or "127.0.0.1", int(port)), per_thread)
+        for rid in sorted(rep["responses"]):
+            sys.stdout.write(rep["responses"][rid] + "\n")
+        print(json.dumps({"threads": rep["threads"], "n": rep["n"],
+                          "wall_s": round(rep["wall_s"], 4),
+                          "qps": round(rep["qps"], 2)}), file=sys.stderr)
+        return 0
     for line in lines:
         sys.stdout.write(line + "\n")
     return 0
