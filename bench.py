@@ -12,6 +12,7 @@ eigenfactor adjustment + vol-regime adjustment) on a CSI300-shaped panel
   python bench.py --config alpha  # config 5: 1000 alpha expressions, CSI300 panel
   python bench.py --config query  # config 6: batched portfolio-query service
   python bench.py --config fleet  # config 9: coalescing front end vs 1-at-a-time
+  python bench.py --config fleet_mh # config 12: 2-host TCP fleet + kill drill
 
 The reference publishes no numbers (BASELINE.md), so the config-1 baseline is
 measured here: the golden NumPy implementation of the identical math (same
@@ -1699,6 +1700,266 @@ def bench_cache():
             "warm_start_parity_max_dw": round(parity_dw, 9)}
 
 
+def bench_fleet_mh():
+    """Config 12 (fleet_mh): the multi-host fleet over the TCP worker
+    transport — 2 simulated hosts x 2 worker subprocesses each behind one
+    in-process dispatcher (`Replica.connect`, docs/SERVING.md §10).
+
+    Two phases, both seeded via tools/trafficgen.py:
+
+    - **fleet_mh_qps / per-host latency**: a 2-client-host striped
+      open-loop stream (the ``--hosts`` partition) through the healthy
+      2x2 fleet; sustained QPS is completions over first-arrival ->
+      last-completion, latency percentiles come back per client host,
+      and every response must be BITWISE the single-process ``--gulp``
+      replay's for the same request id.
+    - **kill drill**: a second stream with one simulated host (both its
+      workers) SIGKILLed mid-run.  The survivors must answer EVERY
+      request, still bitwise, and the merged fleet manifest must count
+      the loss and the redispatches with a consistent delivery audit —
+      the standing ``>=2-host kill drill survivable`` gate on this cell.
+    """
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import shutil
+    import signal
+    import tempfile
+    import threading
+
+    import trafficgen
+    from mfm_tpu.config import (
+        PipelineConfig, QuarantinePolicy, RiskModelConfig,
+    )
+    from mfm_tpu.data.artifacts import load_risk_state
+    from mfm_tpu.data.synthetic import synthetic_barra_table
+    from mfm_tpu.pipeline import run_risk_pipeline, save_pipeline_state
+    from mfm_tpu.serve import QueryEngine, QueryServer, ServePolicy
+    from mfm_tpu.serve.replica import (
+        FleetServer, Replica, build_fleet_manifest, worker_cmd,
+    )
+
+    hosts, wph = 2, 2                 # 2 simulated hosts x 2 workers
+    batch_max, linger = 32, 0.02
+    tmp = tempfile.mkdtemp(prefix="bench_fleet_mh_")
+    # workers/reference run with cwd=tmp, so the repo import path (and the
+    # platform pin) must ride the environment
+    env = {**os.environ, "PYTHONPATH": REPO,
+           "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu")}
+    procs, replicas = [], []
+    try:
+        # -- a small guarded checkpoint for the workers to serve ------------
+        cfg = PipelineConfig(
+            risk=RiskModelConfig(eigen_n_sims=64, eigen_sim_length=40,
+                                 quarantine=QuarantinePolicy(enabled=True)),
+            dtype="float32")
+        df, _ = synthetic_barra_table(T=40, N=48, P=4, Q=2, seed=7)
+        res = run_risk_pipeline(barra_df=df, config=cfg, with_state=True)
+        state_path = os.path.join(tmp, "state.npz")
+        save_pipeline_state(state_path, res)
+        state, meta = load_risk_state(state_path)
+        # one shared benchmark vector so the mix's benchmark slice rides
+        # the wire instead of bouncing off admission ("unknown benchmark")
+        K = int(QueryEngine.from_risk_state(state, meta).K)
+        bvec = np.round(
+            0.1 * np.random.default_rng(3).standard_normal(K), 6).tolist()
+        bpath = os.path.join(tmp, "benchmarks.json")
+        with open(bpath, "w", encoding="utf-8") as fh:
+            json.dump({"idx": bvec}, fh)
+        eng = QueryEngine.from_risk_state(state, meta,
+                                          benchmarks={"idx": bvec})
+
+        # -- 4 TCP workers, grouped into simulated hosts --------------------
+        def _boot(j):
+            errp = os.path.join(tmp, f"worker{j}.err")
+            cmd = worker_cmd(state_path, worker_id=j,
+                             policy_args=["--batch-max", str(batch_max),
+                                          "--deadline-s", "600",
+                                          "--benchmarks", bpath,
+                                          "--listen", "127.0.0.1:0"])
+            proc = subprocess.Popen(cmd, stdin=subprocess.DEVNULL,
+                                    stdout=subprocess.DEVNULL,
+                                    stderr=open(errp, "w"), cwd=tmp,
+                                    env=env)
+            return proc, errp
+
+        boots = [_boot(j) for j in range(hosts * wph)]
+        for j, (proc, errp) in enumerate(boots):
+            procs.append(proc)
+            addr = None
+            deadline = time.monotonic() + 180.0
+            while time.monotonic() < deadline:
+                try:
+                    with open(errp, encoding="utf-8") as fh:
+                        for ln in fh:
+                            if '"worker_listening"' in ln:
+                                addr = json.loads(ln)["worker_listening"]
+                                break
+                except OSError:
+                    pass
+                if addr is not None or proc.poll() is not None:
+                    break
+                time.sleep(0.25)
+            if addr is None:
+                raise AssertionError(
+                    f"fleet_mh: worker {j} never announced its port "
+                    f"(rc={proc.poll()})")
+            whost, _, wport = addr.rpartition(":")
+            rep = Replica.connect(j, (whost, int(wport)), io_timeout_s=60.0)
+            rep.host = f"host{j // wph}"   # simulated-host grouping
+            replicas.append(rep)
+
+        # the admission server must stamp the SAME health the cli workers
+        # and the reference replay derive from the manifest beside the
+        # checkpoint — responses it answers locally (rejects) carry it
+        from mfm_tpu.obs.manifest import (
+            ManifestError, manifest_path_for, read_run_manifest,
+        )
+        try:
+            health = read_run_manifest(
+                manifest_path_for(state_path))["health"].get(
+                    "status", "unknown")
+        except (ManifestError, OSError, KeyError):
+            health = "unknown"
+        server = QueryServer(
+            eng, ServePolicy(batch_max=batch_max, queue_max=65536,
+                             default_deadline_s=600.0), health=health)
+
+        comps = {"w": {}, "a": {}, "b": {}}
+        resps = {"w": {}, "a": {}, "b": {}}
+        done = threading.Event()
+        target = {"phase": "w", "n": 0}
+
+        def deliver(pairs):
+            now = time.monotonic()
+            for origin, resp in pairs:
+                tag, i = origin
+                comps[tag][i] = now
+                resps[tag][i] = resp
+            if len(resps[target["phase"]]) >= target["n"]:
+                done.set()
+
+        # heartbeat off: dead workers are found at dispatch (EOF), and an
+        # idle probe inside the timed window would perturb the QPS number;
+        # the heartbeat path is the chaos drills' evidence, not this cell's
+        fleet = FleetServer(server, replicas, linger_s=linger,
+                            deliver=deliver, heartbeat_s=0.0)
+        fleet.start()
+
+        mix = (0.55, 0.25, 0.0, 0.20, 0.0)
+
+        def _run_phase(tag, lines, rate):
+            target["phase"], target["n"] = tag, len(lines)
+            done.clear()
+            if len(resps[tag]) >= len(lines):   # pragma: no cover
+                done.set()
+            sched = trafficgen.open_loop(
+                lambda line, i: fleet.submit(line, origin=(tag, i)),
+                lines, rate)
+            done.wait(timeout=300.0)
+            return sched
+
+        def _ref(tag, lines):
+            req = os.path.join(tmp, f"req_{tag}.jsonl")
+            out = os.path.join(tmp, f"ref_{tag}.jsonl")
+            with open(req, "w", encoding="utf-8") as fh:
+                fh.write("\n".join(lines) + "\n")
+            proc = subprocess.run(
+                [sys.executable, "-m", "mfm_tpu.cli", "serve", state_path,
+                 "--input", req, "--output", out, "--gulp",
+                 "--batch-max", str(batch_max), "--deadline-s", "600",
+                 "--benchmarks", bpath],
+                capture_output=True, text=True, timeout=600, cwd=tmp,
+                env=env)
+            if proc.returncode != 0:
+                raise AssertionError(f"fleet_mh: reference replay failed "
+                                     f"rc={proc.returncode}\n"
+                                     f"{proc.stderr[-2000:]}")
+            with open(out, encoding="utf-8") as fh:
+                return {json.loads(ln)["id"]: ln
+                        for ln in fh.read().splitlines() if ln}
+
+        def _mismatches(tag):
+            ref = _ref(tag, lines_a if tag == "a" else lines_b)
+            return [i for i, resp in resps[tag].items()
+                    if json.dumps(resp, sort_keys=True)
+                    != ref.get(resp.get("id"))]
+
+        # warm every (kernel-group, bucket) on every worker: fresh-first
+        # routing hands the first rounds to each replica in turn
+        warm_lines = trafficgen.gen_requests(1, 8 * batch_max, K, mix=mix)
+        _run_phase("w", warm_lines, 4000.0)
+
+        # -- phase A: healthy 2x2 fleet, striped across 2 client hosts ------
+        n_a, rate_a = 800, 300.0
+        lines_a = trafficgen.gen_requests(7, n_a, K, mix=mix)
+        sched_a = _run_phase("a", lines_a, rate_a)
+        if comps["a"]:
+            wall = max(max(comps["a"].values()) - sched_a["t0"], 1e-9)
+            mh_qps = len(resps["a"]) / wall
+        else:
+            mh_qps = 0.0
+        lat = trafficgen.latency_stats(sched_a["arrivals"], comps["a"])
+        by_host = trafficgen.per_host_latency(sched_a["arrivals"],
+                                              comps["a"], hosts)
+        mism_a = _mismatches("a")
+
+        # -- phase B: SIGKILL one whole simulated host mid-stream -----------
+        n_b, rate_b = 320, 200.0
+        lines_b = trafficgen.gen_requests(8, n_b, K, mix=mix)
+        victims = [j for j in range(hosts * wph) if j // wph == 1]
+
+        def _kill_host1():
+            for j in victims:
+                if procs[j].poll() is None:
+                    procs[j].send_signal(signal.SIGKILL)
+
+        killer = threading.Timer(0.5 * n_b / rate_b, _kill_host1)
+        killer.start()
+        _run_phase("b", lines_b, rate_b)
+        killer.cancel()
+        _kill_host1()                  # fire even if the stream outran it
+        mism_b = _mismatches("b")
+
+        fleet.stop()
+        fm = build_fleet_manifest({"bench": "fleet_mh",
+                                   "n": n_a + n_b + len(warm_lines)},
+                                  fleet, tmp)
+        fleet.close_replicas()
+        survived = (not mism_b and len(resps["b"]) == n_b
+                    and fm["audit"]["consistent"])
+        return {"metric": "fleet_mh_serving_throughput",
+                "value": round(mh_qps),
+                "unit": "requests/s", "vs_baseline": None,
+                "k_factors": K, "hosts": hosts, "workers_per_host": wph,
+                "n_requests": n_a, "offered_rate_rps": rate_a,
+                "linger_s": linger, "batch_max": batch_max,
+                "fleet_mh_qps": round(mh_qps, 1),
+                "fleet_mh_p50_latency_s": lat.get("p50_s"),
+                "fleet_mh_p99_latency_s": lat.get("p99_s"),
+                "per_host_latency": by_host,
+                "bitwise_identical": not mism_a,
+                "bitwise_mismatches": len(mism_a),
+                "unanswered": lat.get("unanswered"),
+                "kill_drill": {
+                    "n_requests": n_b,
+                    "killed_host": "host1",
+                    "killed_workers": victims,
+                    "answered": len(resps["b"]),
+                    "bitwise_identical": not mism_b,
+                    "redispatches": fm["transport"]["redispatches"],
+                    "lost_replicas": [r["replica"] for r in fm["replicas"]
+                                      if r["lost"]],
+                    "audit_consistent": fm["audit"]["consistent"],
+                    "survived": survived,
+                },
+                "transport": fm["transport"]}
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 CONFIGS = {
     "riskmodel": bench_riskmodel,
     "chunk_sweep": bench_chunk_sweep,
@@ -1713,6 +1974,7 @@ CONFIGS = {
     "grad": bench_grad,
     "fleet": bench_fleet,
     "cache": bench_cache,
+    "fleet_mh": bench_fleet_mh,
 }
 
 

@@ -1535,6 +1535,7 @@ def _doctor(args):
                             f"({audit.get('accepted_total')}) — "
                             "responses were lost between dispatch and "
                             "delivery")
+                    redisp = 0
                     for rep in fm.get("replicas", []):
                         if rep.get("lost"):
                             frec["warnings"].append(
@@ -1542,11 +1543,48 @@ def _doctor(args):
                                 f"(exit {rep.get('exit_code')}) — its "
                                 "in-flight batch re-dispatched to "
                                 "survivors")
-                        if rep.get("quarantined"):
+                        if rep.get("wedged"):
+                            frec["warnings"].append(
+                                f"replica {rep.get('replica')} wedged "
+                                "(deadline/heartbeat expiry with the "
+                                "process still alive) — quarantined and "
+                                "its in-flight batch re-dispatched")
+                        elif rep.get("quarantined"):
                             frec["warnings"].append(
                                 f"replica {rep.get('replica')} was "
                                 "quarantined after failing its fence "
                                 "audit")
+                        tp = rep.get("transport")
+                        if isinstance(tp, dict):
+                            redisp += int(tp.get("redispatches", 0) or 0)
+                            neg = sorted(
+                                k for k, v in tp.items()
+                                if isinstance(v, int) and v < 0)
+                            if neg:
+                                frec["problems"].append(
+                                    f"replica {rep.get('replica')} "
+                                    "transport counters went negative "
+                                    f"({', '.join(neg)}) — the counter "
+                                    "plumbing is corrupt")
+                            if tp.get("heartbeat_misses"):
+                                frec["warnings"].append(
+                                    f"replica {rep.get('replica')} "
+                                    f"missed {tp['heartbeat_misses']} "
+                                    "heartbeat(s)")
+                    tr = fm.get("transport")
+                    if isinstance(tr, dict):
+                        # the transport totals are part of the delivery
+                        # story: every re-dispatched request must still
+                        # appear in exactly one ledger (checked by
+                        # `consistent` above); here the merged totals
+                        # must agree with the per-replica counters
+                        frec["transport"] = tr
+                        if int(tr.get("redispatches", 0) or 0) != redisp:
+                            frec["problems"].append(
+                                "fleet transport totals disagree with "
+                                f"per-replica counters (redispatches "
+                                f"{tr.get('redispatches')} != "
+                                f"{redisp})")
                 if frec["problems"]:
                     frec["status"] = "unhealthy"
 
@@ -1807,7 +1845,13 @@ def _serve(args):
                   if args.warm_tol > 0 else None)
 
     reload_fn = None
-    if args.watch:
+    if args.watch or args.rollout or args.hold_fence:
+        # --rollout implies watching: the frontend needs the reload hook
+        # to move its admission engine + cache fence once the fleet
+        # agrees.  --hold-fence implies it too — that flag's one job is
+        # "re-fence on the frontend's reload frame", which is this hook;
+        # without it a TCP worker would answer every reload frame with
+        # its startup generation and the fleet could never agree
         seen = {"gen": (read_pointer(state_path) or {}).get("generation")}
 
         def reload_fn():
@@ -1836,11 +1880,15 @@ def _serve(args):
                     scenario_hashes=_scenario_hashes_beside())
             return {"engine": QueryEngine.from_risk_state(
                         st, mt, benchmarks=benchmarks),
-                    "health": _health_beside()}
+                    "health": _health_beside(),
+                    "generation": int(gen or 0)}
 
     server = QueryServer(engine, policy, health=_health_beside(),
                          dead_letter_path=args.dead_letter,
                          reload_fn=reload_fn, warm_index=warm_index)
+    # generation stamp for the rolling-rollout agreement protocol (a
+    # worker reports it in its "reloaded" frame)
+    server.generation = int((meta or {}).get("generation") or 0)
     man_dir = os.path.dirname(state_path) or "."
 
     def _finish(summary: dict, manifest_name: str, extra: dict) -> None:
@@ -1864,15 +1912,34 @@ def _serve(args):
     if args.worker:
         # fleet worker: admitted lines in, seq envelopes out (the wire
         # protocol in serve/replica.py); manifest shard beside the
-        # checkpoint for the front end's merge
+        # checkpoint for the front end's merge.  With --listen the same
+        # loop runs over ONE accepted TCP connection instead of stdin —
+        # the multi-host worker a remote frontend attaches to with
+        # --workers host:port (docs/SERVING.md §10)
         from mfm_tpu.serve.replica import WORKER_MANIFEST_FMT, run_worker
 
-        summary = run_worker(server, sys.stdin, sys.stdout)
+        if args.listen:
+            from mfm_tpu.serve.transport import serve_worker_socket
+
+            host, _, port = args.listen.rpartition(":")
+
+            def announce(addr):
+                print(json.dumps({
+                    "worker_listening": f"{addr[0]}:{addr[1]}",
+                    "worker_id": args.worker_id}),
+                    file=sys.stderr, flush=True)
+
+            summary = serve_worker_socket(
+                server, host or "127.0.0.1", int(port or 0),
+                announce=announce, poll_on_flush=not args.hold_fence)
+        else:
+            summary = run_worker(server, sys.stdin, sys.stdout,
+                                 poll_on_flush=not args.hold_fence)
         _finish(summary, WORKER_MANIFEST_FMT.format(idx=args.worker_id),
                 {"worker_id": args.worker_id})
         return
 
-    if args.replicas or args.listen:
+    if args.replicas or args.listen or args.workers:
         _serve_fleet(args, server, state_path, man_dir, _finish,
                      cache=cache)
         return
@@ -1894,13 +1961,16 @@ def _serve(args):
 def _serve_fleet(args, server, state_path, man_dir, _finish,
                  cache=None) -> None:
     """The fleet/coalescing serve paths: ``--replicas N`` dispatches
-    batches to worker subprocesses; ``--listen`` accepts concurrent
-    socket (or ``--http``) connections; either alone also works —
-    ``--replicas`` over stdin is the deterministic drill mode, and
-    ``--listen`` without replicas coalesces into the local engine."""
+    batches to spawned worker subprocesses, ``--workers host:port,...``
+    attaches to already-running TCP workers on any host (both may mix),
+    ``--listen`` accepts concurrent socket (or ``--http``) connections;
+    each alone also works — ``--replicas`` over stdin is the
+    deterministic drill mode, and ``--listen`` without workers coalesces
+    into the local engine."""
     import signal
     import sys
 
+    from mfm_tpu.data.artifacts import read_pointer
     from mfm_tpu.obs.instrument import fleet_summary_from_registry
     from mfm_tpu.serve.coalesce import Coalescer
     from mfm_tpu.serve.frontend import SocketFrontend
@@ -1910,6 +1980,7 @@ def _serve_fleet(args, server, state_path, man_dir, _finish,
     )
 
     fleet = None
+    replicas = []
     if args.replicas:
         policy_args = [
             "--queue-max", str(args.queue_max),
@@ -1921,20 +1992,50 @@ def _serve_fleet(args, server, state_path, man_dir, _finish,
             "--warm-tol", str(args.warm_tol)]
         if args.benchmarks:
             policy_args += ["--benchmarks", args.benchmarks]
-        if args.watch:
+        if args.watch or args.rollout:
             policy_args += ["--watch"]
+        if args.rollout:
+            # rollout workers must NOT self-poll: generations move one
+            # worker at a time on the frontend's reload frames
+            policy_args += ["--hold-fence"]
         if args.fsync_emits:
             policy_args += ["--fsync-emits"]
         replicas = [
             Replica(i, worker_cmd(state_path, worker_id=i,
                                   policy_args=policy_args),
-                    env=replica_env(i))
+                    env=replica_env(i),
+                    io_timeout_s=args.worker_timeout_s)
             for i in range(args.replicas)]
+    if args.workers:
+        base = len(replicas)
+        for j, spec in enumerate(p.strip() for p in args.workers.split(",")
+                                 if p.strip()):
+            whost, _, wport = spec.rpartition(":")
+            try:
+                replicas.append(Replica.connect(
+                    base + j, (whost or "127.0.0.1", int(wport)),
+                    io_timeout_s=args.worker_timeout_s,
+                    attempts=args.connect_attempts,
+                    backoff_s=args.connect_backoff_s))
+            except OSError as e:
+                raise SystemExit(
+                    f"serve: cannot attach worker {spec}: {e} "
+                    f"(phase={getattr(e, 'phase', 'connect')}, "
+                    f"attempts={getattr(e, 'attempts', 1)}, "
+                    f"backoff={getattr(e, 'total_backoff_s', 0.0):.3f}s)")
 
     def make_backend(deliver=None):
-        if args.replicas:
+        if replicas:
+            rollout_check = None
+            if args.rollout:
+                def rollout_check():
+                    return (read_pointer(state_path)
+                            or {}).get("generation")
             return FleetServer(server, replicas, linger_s=args.linger_s,
-                               deliver=deliver, cache=cache)
+                               deliver=deliver, cache=cache,
+                               heartbeat_s=args.heartbeat_s,
+                               heartbeat_timeout_s=args.heartbeat_timeout_s,
+                               rollout_check=rollout_check)
         return Coalescer(server, linger_s=args.linger_s, deliver=deliver,
                          cache=cache)
 
@@ -1944,10 +2045,10 @@ def _serve_fleet(args, server, state_path, man_dir, _finish,
                             http=args.http)
         backend = make_backend(deliver=fe.deliver)
         fe.backend = backend
-        fleet = backend if args.replicas else None
+        fleet = backend if replicas else None
         addr = fe.listen()
         print(json.dumps({"listening": f"{addr[0]}:{addr[1]}",
-                          "replicas": args.replicas or 0,
+                          "replicas": len(replicas),
                           "http": bool(args.http)}),
               file=sys.stderr, flush=True)
         for sig in (signal.SIGINT, signal.SIGTERM):
@@ -1955,7 +2056,7 @@ def _serve_fleet(args, server, state_path, man_dir, _finish,
         fe.serve(backend)   # blocks until stop(); drains the backend
     else:
         backend = make_backend()
-        fleet = backend if args.replicas else None
+        fleet = backend if replicas else None
         in_fp = (sys.stdin if args.input in (None, "-")
                  else open(args.input, encoding="utf-8"))
         out_fp = (sys.stdout if args.output in (None, "-")
@@ -3136,6 +3237,44 @@ def main(argv=None):
                          "seeds the next solve's warm-start blend "
                          "(0 = off; warmed responses carry a "
                          "warm_start parity stanza)")
+    sv.add_argument("--workers", default=None, metavar="HOST:PORT,...",
+                    help="attach to already-running TCP workers, each "
+                         "started elsewhere with `serve STATE --worker "
+                         "--listen HOST:PORT` against the same fenced "
+                         "checkpoint; mixes with --replicas; dialing "
+                         "retries with exponential backoff "
+                         "(docs/SERVING.md §10 Multi-host fleets)")
+    sv.add_argument("--rollout", action="store_true",
+                    help="rolling zero-downtime reload: when the "
+                         "checkpoint generation moves, drain + re-fence "
+                         "ONE worker at a time; the admission engine and "
+                         "response-cache fence move only after the whole "
+                         "fleet agrees (spawned workers run with "
+                         "--hold-fence; TCP workers should be started "
+                         "with it)")
+    sv.add_argument("--hold-fence", action="store_true",
+                    help="worker mode: do not self-poll the checkpoint "
+                         "pointer between batches; re-fence only on the "
+                         "frontend's __fleet__ reload frame (the rolling "
+                         "rollout protocol)")
+    sv.add_argument("--worker-timeout-s", type=float, default=30.0,
+                    help="per-I/O deadline on every worker read/write; "
+                         "silence past this quarantines the worker as "
+                         "wedged and re-dispatches its in-flight batch "
+                         "(default 30)")
+    sv.add_argument("--heartbeat-s", type=float, default=5.0,
+                    help="ping a worker idle this long before handing it "
+                         "a batch; a missed pong quarantines it "
+                         "(default 5, 0 = off)")
+    sv.add_argument("--heartbeat-timeout-s", type=float, default=2.0,
+                    help="deadline on a heartbeat pong / live metrics "
+                         "scrape (default 2)")
+    sv.add_argument("--connect-attempts", type=int, default=5,
+                    help="--workers dial attempts per worker, with "
+                         "exponential backoff (default 5)")
+    sv.add_argument("--connect-backoff-s", type=float, default=0.05,
+                    help="base backoff between --workers dial attempts "
+                         "(default 0.05, doubles per retry)")
     sv.add_argument("--worker", action="store_true",
                     help=argparse.SUPPRESS)   # internal: fleet replica
     sv.add_argument("--worker-id", type=int, default=0,

@@ -59,7 +59,8 @@ class RateLimiter:
 def with_retry(fn: Callable, attempts: int = 3, backoff_s: float = 5.0,
                sleep=time.sleep, *, exponential: bool = False,
                max_backoff_s: float = 60.0, jitter: float = 0.0,
-               seed: int = 0, retryable: tuple = (Exception,)):
+               seed: int = 0, retryable: tuple = (Exception,),
+               phase: str | None = None):
     """Call ``fn``; on a retryable exception retry up to ``attempts`` times.
 
     Defaults reproduce the reference's fixed 5 s backoff, broad catch
@@ -80,7 +81,11 @@ def with_retry(fn: Callable, attempts: int = 3, backoff_s: float = 5.0,
     ``e.attempts`` (calls made) and ``e.total_backoff_s`` (seconds slept
     between them) — dead-letter records and reload failure logs in the
     query loop stamp these so an operator can tell "failed instantly"
-    from "fought the outage for a minute".
+    from "fought the outage for a minute".  ``phase`` additionally
+    stamps ``e.phase`` so a caller several frames up can tell WHICH
+    retried operation died — the fleet transport uses it to separate
+    "never connected" (``phase="connect"``) from "connection lost
+    mid-batch" (``phase="batch"``) in its manifest counters.
     """
     import random
 
@@ -108,6 +113,8 @@ def with_retry(fn: Callable, attempts: int = 3, backoff_s: float = 5.0,
     _telemetry.RETRY_ATTEMPTS_TOTAL.inc(outcome="exhausted")
     last.attempts = attempts
     last.total_backoff_s = total_backoff
+    if phase is not None:
+        last.phase = phase
     raise last
 
 

@@ -109,6 +109,7 @@ _R7_HOST_ONLY_MODULES = ("mfm_tpu.serve.server", "mfm_tpu.cli",
                          "mfm_tpu.serve.coalesce",
                          "mfm_tpu.serve.frontend",
                          "mfm_tpu.serve.replica",
+                         "mfm_tpu.serve.transport",
                          "mfm_tpu.scenario.engine",
                          "mfm_tpu.scenario.manifest",
                          # grad host orchestration + report writer (the
@@ -216,6 +217,13 @@ class ModuleInfo:
     # (e.g. the alpha DSL's _OPS table) — dispatched via subscript calls that
     # name resolution cannot see
     registry_names: set = dataclasses.field(default_factory=set)
+    # class name -> attrs assigned from EXTERNAL handle constructors
+    # (subprocess.Popen, socket.socket, open, .makefile()): method calls
+    # through such a receiver (`self.proc.poll()`) are OS-handle I/O and
+    # must never resolve into package defs via the bare-name fallback —
+    # otherwise the fleet's Popen.poll() aliases Coalescer.poll and drags
+    # the whole transport layer into the jax_touch closure
+    external_attrs: dict = dataclasses.field(default_factory=dict)
 
 
 class _Scanner(ast.NodeVisitor):
@@ -226,6 +234,7 @@ class _Scanner(ast.NodeVisitor):
         self.funcs = funcs
         self.bare_index = bare_index
         self.scope: list[str] = []      # class/function name stack
+        self.class_stack: list[str] = []  # enclosing ClassDef names only
 
     # -- imports ------------------------------------------------------------
     def visit_Import(self, node):
@@ -306,11 +315,35 @@ class _Scanner(ast.NodeVisitor):
 
     def visit_ClassDef(self, node):
         self.scope.append(node.name)
+        self.class_stack.append(node.name)
         self.generic_visit(node)
+        self.class_stack.pop()
         self.scope.pop()
 
     def visit_Lambda(self, node):
         self._visit_func(node, f"<lambda@L{node.lineno}>")
+
+    def _is_external_handle_ctor(self, call: ast.Call) -> bool:
+        """subprocess.Popen / socket.* / open / .makefile() — constructors
+        of OS handles whose methods (poll/wait/kill/recv/...) share bare
+        names with half the package but can never be package calls."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id == "open":
+                return True
+            src = self.mod.from_imports.get(f.id)
+            return bool(src and src[0] in ("subprocess", "socket"))
+        chain = _attr_chain(f)
+        if not chain:
+            return False
+        root, attr = chain[0], chain[-1]
+        if attr == "makefile":
+            return True
+        tgt = self.mod.module_imports.get(root)
+        if tgt == "subprocess" and attr == "Popen":
+            return True
+        return tgt == "socket" and attr in ("socket", "create_connection",
+                                            "socketpair")
 
     def visit_Assign(self, node):
         # `phase1 = lambda ...` binds a function to a name: register the
@@ -324,6 +357,17 @@ class _Scanner(ast.NodeVisitor):
             for v in node.value.values:
                 if isinstance(v, ast.Name):
                     self.mod.registry_names.add(v.id)
+        # `self.proc = subprocess.Popen(...)`: remember the attr as an
+        # OS-handle receiver for the typed-receiver barrier in
+        # _resolve_call (Popen.poll must not alias Coalescer.poll)
+        if self.class_stack and isinstance(node.value, ast.Call) \
+                and self._is_external_handle_ctor(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    self.mod.external_attrs.setdefault(
+                        self.class_stack[-1], set()).add(t.attr)
         self.generic_visit(node)
 
     def visit_AnnAssign(self, node):
@@ -457,6 +501,15 @@ class Linter:
             tgt = self.modules.get(f"{src}.{a}" if src else a)
             if tgt:
                 return self._resolve_in_module(tgt, attr)
+        # typed-receiver barrier: `self.proc.poll()` on a field assigned
+        # from subprocess.Popen/socket/open is OS-handle I/O — resolving
+        # `poll` through the bare index would alias Coalescer.poll and
+        # mark the whole fleet transport as dispatching jax work
+        if root == "self" and len(chain) >= 3:
+            cls_name = caller.qualname.split(":", 1)[1].split(".", 1)[0]
+            ext = mod.external_attrs.get(cls_name)
+            if ext and chain[1] in ext:
+                return []
         # bare-name over-approximation: any def in the lint set with this name
         return list(self.bare_index.get(attr, []))
 

@@ -154,6 +154,25 @@ FLEET_REPLICA_QUARANTINED_TOTAL = REGISTRY.counter(
 FLEET_REDISPATCH_TOTAL = REGISTRY.counter(
     "mfm_fleet_redispatch_total",
     "request lines re-dispatched after a replica death or quarantine")
+FLEET_TRANSPORT_RECONNECTS_TOTAL = REGISTRY.counter(
+    "mfm_fleet_transport_reconnects_total",
+    "extra worker connect attempts beyond the first (with_retry backoff "
+    "while the worker was still loading its checkpoint)",
+    labelnames=("replica",))
+FLEET_TRANSPORT_HEARTBEAT_MISSES_TOTAL = REGISTRY.counter(
+    "mfm_fleet_transport_heartbeat_misses_total",
+    "heartbeat pings a worker failed to answer within the deadline "
+    "(wedged worker: quarantined, its batch re-dispatched)",
+    labelnames=("replica",))
+FLEET_TRANSPORT_IO_TIMEOUTS_TOTAL = REGISTRY.counter(
+    "mfm_fleet_transport_io_timeouts_total",
+    "per-I/O deadline expiries on worker reads/writes by failure phase "
+    "(connect = never attached, batch = lost mid-batch)",
+    labelnames=("replica", "phase"))
+FLEET_ROLLOUT_STEPS_TOTAL = REGISTRY.counter(
+    "mfm_fleet_rollout_steps_total",
+    "single-worker re-fence steps completed by rolling checkpoint "
+    "rollouts (one per worker per generation crossed)")
 
 # -- response cache (serve/cache.py content-addressed reuse) ------------------
 
@@ -465,6 +484,26 @@ def record_fleet_redispatch(n: int = 1) -> None:
     FLEET_REDISPATCH_TOTAL.inc(int(n))
 
 
+def record_transport_reconnects(replica: int, n: int) -> None:
+    if n:
+        FLEET_TRANSPORT_RECONNECTS_TOTAL.inc(int(n), replica=str(replica))
+
+
+def record_heartbeat_miss(replica: int, n: int = 1) -> None:
+    FLEET_TRANSPORT_HEARTBEAT_MISSES_TOTAL.inc(int(n),
+                                               replica=str(replica))
+
+
+def record_transport_timeout(replica: int, phase: str,
+                             n: int = 1) -> None:
+    FLEET_TRANSPORT_IO_TIMEOUTS_TOTAL.inc(int(n), replica=str(replica),
+                                          phase=str(phase))
+
+
+def record_rollout_step(n: int = 1) -> None:
+    FLEET_ROLLOUT_STEPS_TOTAL.inc(int(n))
+
+
 def fleet_summary_from_registry() -> dict:
     """The fleet manifest's front-end block, off the live counters.
 
@@ -497,6 +536,16 @@ def fleet_summary_from_registry() -> dict:
         "replica_quarantined_total": int(
             FLEET_REPLICA_QUARANTINED_TOTAL.value()),
         "redispatch_total": int(FLEET_REDISPATCH_TOTAL.value()),
+        "transport": {
+            "reconnects_total": int(sum(
+                FLEET_TRANSPORT_RECONNECTS_TOTAL.series().values())),
+            "heartbeat_misses_total": int(sum(
+                FLEET_TRANSPORT_HEARTBEAT_MISSES_TOTAL.series()
+                .values())),
+            "io_timeouts_total": int(sum(
+                FLEET_TRANSPORT_IO_TIMEOUTS_TOTAL.series().values())),
+        },
+        "rollout_steps_total": int(FLEET_ROLLOUT_STEPS_TOTAL.value()),
     })
     return out
 

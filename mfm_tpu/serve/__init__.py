@@ -21,9 +21,12 @@ bounded content-addressed response cache fenced on checkpoint
 generation + scenario spec hash, :mod:`mfm_tpu.serve.coalesce` merges
 concurrent submissions into the bucket ladder under a linger budget,
 :mod:`mfm_tpu.serve.frontend` accepts concurrent socket/HTTP
-connections, and :mod:`mfm_tpu.serve.replica` runs N worker processes
-behind the fenced checkpoint store (docs/SERVING.md §"Fleet", §9
-"Response cache").
+connections, :mod:`mfm_tpu.serve.replica` runs N worker processes
+behind the fenced checkpoint store, and :mod:`mfm_tpu.serve.transport`
+carries the worker wire protocol over deadline-bearing pipe/TCP
+transports so the fleet spans hosts and survives wedged workers
+(docs/SERVING.md §"Fleet", §9 "Response cache", §10 "Multi-host
+fleets").
 """
 
 from mfm_tpu.serve.guard import (  # noqa: F401
@@ -62,5 +65,14 @@ from mfm_tpu.serve.replica import (  # noqa: F401
     FleetServer,
     Replica,
     ReplicaDeadError,
+    ReplicaWedgedError,
     run_worker,
+)
+from mfm_tpu.serve.transport import (  # noqa: F401
+    PipeTransport,
+    TcpTransport,
+    TransportClosed,
+    TransportError,
+    TransportTimeout,
+    serve_worker_socket,
 )

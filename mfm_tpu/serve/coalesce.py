@@ -85,6 +85,12 @@ class Coalescer:
         self._stopping = False
 
     # -- internals (callers hold self._lock) ---------------------------------
+    def _poll_reload_locked(self) -> None:
+        """The reload point the hit-path throttle uses.  FleetServer
+        overrides this so a rollout fleet never moves the admission
+        engine (or the cache fence) ahead of its workers."""
+        self.server.poll_reload()
+
     def _emit(self, pairs):
         if not pairs:
             return []
@@ -129,7 +135,7 @@ class Coalescer:
             if now - self._last_poll >= self.linger_s:
                 self._last_poll = now
                 with self._lock:
-                    self.server.poll_reload()
+                    self._poll_reload_locked()
             if self.server.breaker.state == "closed":
                 resp, token = self.cache.lookup(line)
                 if resp is not None:

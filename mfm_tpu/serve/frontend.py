@@ -242,6 +242,16 @@ class SocketFrontend:
         for c in conns:
             c.close()
 
+    def _fleet_shards(self):
+        """Live per-worker shards off a fleet backend (None for a plain
+        coalescer).  The scrape serializes under the coalescer lock —
+        never mid-batch — and each probe carries its own deadline, so a
+        wedged worker costs one bounded timeout, not a hung endpoint."""
+        scrape = getattr(self.backend, "scrape_fleet", None)
+        if not callable(scrape):
+            return None
+        return scrape()
+
     # -- raw JSONL connections ----------------------------------------------
     def _jsonl_reader(self, conn: _Conn) -> None:
         try:
@@ -274,14 +284,37 @@ class SocketFrontend:
                     break
                 method, path, headers, body = req
                 if method == "GET" and path == "/healthz":
-                    payload = json.dumps(
-                        _obs.serve_summary_from_registry(),
-                        sort_keys=True)
+                    summary = _obs.serve_summary_from_registry()
+                    shards = self._fleet_shards()
+                    if shards is not None:
+                        # live mid-run merge: one entry per worker,
+                        # marked by replica ordinal — no waiting for
+                        # shutdown manifests
+                        summary["workers"] = [
+                            {"replica": s["replica"],
+                             "host": s.get("host"),
+                             "alive": s["alive"],
+                             "wedged": s.get("wedged", False),
+                             "summary": s.get("summary")}
+                            for s in shards]
+                    payload = json.dumps(summary, sort_keys=True)
                     self._http_reply(conn, 200, payload,
                                      "application/json")
                 elif method == "GET" and path == "/metrics":
                     from mfm_tpu.obs.metrics import snapshot_json
-                    self._http_reply(conn, 200, snapshot_json(),
+                    body = snapshot_json()
+                    shards = self._fleet_shards()
+                    if shards is not None:
+                        snap = json.loads(body)
+                        snap["workers"] = [
+                            {"replica": s["replica"],
+                             "host": s.get("host"),
+                             "alive": s["alive"],
+                             "metrics": s.get("metrics"),
+                             "transport": s.get("transport")}
+                            for s in shards]
+                        body = json.dumps(snap, sort_keys=True)
+                    self._http_reply(conn, 200, body,
                                      "application/json")
                 elif method == "POST":
                     lines = [ln for ln in

@@ -563,6 +563,10 @@ class QueryServer:
         self._dead_fp = None
         self._reload_fn = reload_fn
         self.warm_index = warm_index
+        #: checkpoint generation currently served (None = untracked);
+        #: moved by swap() so the fleet's rolling rollout can verify a
+        #: worker landed on the target fence before routing to it again
+        self.generation: int | None = None
         if self.health == "degraded" and self.policy.breaker_on_degraded:
             self.breaker.force_open("health_degraded")
 
@@ -578,13 +582,16 @@ class QueryServer:
         resp["trace_id"] = trace_id
         return resp
 
-    def swap(self, engine=None, health: str | None = None) -> None:
+    def swap(self, engine=None, health: str | None = None,
+             generation: int | None = None) -> None:
         """Hot-swap the served engine / health verdict (checkpoint reload
         under load).  Degraded health force-opens the breaker; a recovery
         to "ok" lets the normal cooldown -> half-open -> closed path run
         (no instant flap back to closed)."""
         if engine is not None:
             self.engine = engine
+        if generation is not None:
+            self.generation = int(generation)
         if health is not None:
             self.health = str(health)
             if self.health == "degraded" and self.policy.breaker_on_degraded:
@@ -604,7 +611,8 @@ class QueryServer:
             self.breaker.force_open("fence_audit")
             return
         if upd:
-            self.swap(engine=upd.get("engine"), health=upd.get("health"))
+            self.swap(engine=upd.get("engine"), health=upd.get("health"),
+                      generation=upd.get("generation"))
 
     # -- dead letter ---------------------------------------------------------
     def _dead_letter(self, rid, mask: int, detail: str, line: str,

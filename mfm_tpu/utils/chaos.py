@@ -210,7 +210,8 @@ class FaultPlan:
                      # query_kill | query_poison | query_overflow |
                      # query_swap | query_steady | scenario_kill |
                      # scenario_poison | trace_kill | eigen_kill |
-                     # shard_kill | grad_kill | fleet_kill
+                     # shard_kill | grad_kill | fleet_kill |
+                     # fleet_kill_host | fleet_wedge
     seed: int = 0
     params: tuple = ()   # ((key, value), ...) — hashable, printable
 
@@ -326,4 +327,28 @@ def plan_suite(seed: int = 0) -> tuple:
                   (("seeds", 10), ("threads", 3), ("ops", 10),
                    ("bodies", 6), ("max_entries", 4),
                    ("max_bytes", 4096))),
+        # multi-host fleet (PR 19): kill a whole simulated host mid-storm.
+        # 2 hosts x 2 workers; both of host 1's workers die by SIGKILL
+        # while another worker sits SIGSTOPped — wedged, not dead: its
+        # pipes stay open but nothing ever answers, the failure mode an
+        # EOF check cannot see.  The survivors must answer EVERY request
+        # (compared by id — live feeding makes batch boundaries
+        # timing-dependent) bitwise the fault-free replay's, the merged
+        # manifest must count the dead as lost and the stopped as wedged
+        # with a balanced delivery audit, and no flush may block past the
+        # per-I/O deadline + heartbeat budget
+        # n=96 (batch-max 8): 6 post-storm batches — enough dispatch
+        # rounds that the starve_rounds guard provably routes the router
+        # onto every undiscovered faulty worker before the stream ends
+        FaultPlan("fleet-kill-host", "fleet_kill_host", s + 27,
+                  (("hosts", 2), ("workers_per_host", 2),
+                   ("kill_host", 1), ("wedge", 1), ("n", 96))),
+        # one SIGSTOPped worker mid-storm, nothing killed: the heartbeat
+        # ping (or the per-I/O deadline on its next batch) must
+        # quarantine it within heartbeat_s + the I/O timeout, its batch
+        # re-dispatches exactly like a death, and the wedge lands in the
+        # transport counters (heartbeat_misses / io_timeouts) without
+        # unbalancing the audit
+        FaultPlan("fleet-wedge-worker", "fleet_wedge", s + 28,
+                  (("replicas", 3), ("wedge", 1), ("n", 96))),
     )

@@ -160,6 +160,24 @@ def test_with_retry_exhaustion_reraises_last():
     assert len(sleeps) == 2  # no sleep after the final attempt
 
 
+def test_with_retry_stamps_phase_on_exhaustion():
+    """The fleet transport separates "never connected" from "lost
+    mid-batch" by the ``phase`` attr stamped on the exhausted exception
+    — surfaced per replica in fleet_manifest.json's failure_phases."""
+    fn = flaky(lambda: "ok", n_failures=99)
+    with pytest.raises(ConnectionError) as exc:
+        with_retry(fn, attempts=2, backoff_s=0.0, sleep=lambda s: None,
+                   retryable=(ConnectionError,), phase="connect")
+    assert exc.value.phase == "connect"
+    assert exc.value.attempts == 2
+    # no phase requested -> no attr invented
+    with pytest.raises(ConnectionError) as exc2:
+        with_retry(flaky(lambda: "ok", n_failures=99), attempts=2,
+                   backoff_s=0.0, sleep=lambda s: None,
+                   retryable=(ConnectionError,))
+    assert not hasattr(exc2.value, "phase")
+
+
 def test_flaky_store_fails_then_delegates():
     class Store:
         def __init__(self):
@@ -195,7 +213,8 @@ def test_plan_suite_is_deterministic():
                                    "scenario_kill", "scenario_poison",
                                    "trace_kill", "eigen_kill",
                                    "shard_kill", "grad_kill",
-                                   "fleet_kill", "cache_stale",
+                                   "fleet_kill", "fleet_kill_host",
+                                   "fleet_wedge", "cache_stale",
                                    "sweep_kill",
                                    "sync_schedule_coalescer",
                                    "sync_schedule_cache"}

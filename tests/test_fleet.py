@@ -1,10 +1,13 @@
-"""Serving fleet (mfm_tpu/serve/{coalesce,frontend,replica}.py): coalesced
-mixed-type batches bitwise-equal to the single-threaded loop, the linger/
-full/eof flush triggers, the <=1-compile steady state with the coalescer
-on, the worker wire protocol, death re-dispatch + fence-audit quarantine +
-the merged-manifest delivery audit, the thread-safety hammer for the
-breaker and the metrics registry, fsync-on-emit, and the socket front end
-under concurrent clients."""
+"""Serving fleet (mfm_tpu/serve/{coalesce,frontend,replica,transport}.py):
+coalesced mixed-type batches bitwise-equal to the single-threaded loop, the
+linger/full/eof flush triggers, the <=1-compile steady state with the
+coalescer on, the worker wire protocol (pipe AND TCP parity), death
+re-dispatch + fence-audit quarantine + the merged-manifest delivery audit,
+heartbeat-miss wedge detection before dispatch, rolling zero-downtime
+rollouts (no dropped requests, no generation-straddling batch, failed
+fence audits quarantined), live /metrics//healthz worker-shard merging,
+the thread-safety hammer for the breaker and the metrics registry,
+fsync-on-emit, and the socket front end under concurrent clients."""
 
 import io
 import json
@@ -24,15 +27,18 @@ from mfm_tpu.serve import (
     QueryEngine,
     QueryServer,
     ReplicaDeadError,
+    ReplicaWedgedError,
     ServePolicy,
     SocketFrontend,
 )
 from mfm_tpu.serve.replica import (
     CONTROL_KEY,
+    Replica,
     build_fleet_manifest,
     replica_env,
     run_worker,
 )
+from mfm_tpu.serve.transport import serve_worker_socket
 
 K = 4
 
@@ -295,6 +301,43 @@ def test_worker_control_frame_not_spoofable():
     for seq, ln in ((0, lines[0]), (2, lines[1])):
         rid = json.loads(ln)["id"]
         assert json.dumps(resps[seq], sort_keys=True) == ref[rid]
+
+
+def test_hold_fence_worker_refences_only_on_reload_frame():
+    """A --hold-fence worker (poll_on_flush=False) must not move its
+    generation on flush, and MUST re-fence and report the NEW generation
+    on the frontend's reload frame — if the reply carried the startup
+    generation instead, _roll_fleet would treat it as disagreement and
+    re-roll forever while the worker kept pricing the old engine."""
+    pending = {"gen": None}
+    polls = []
+
+    def reload_fn():
+        polls.append(1)
+        if pending["gen"] is None:
+            return None
+        return {"generation": pending["gen"]}
+
+    server = _server(batch_max=8, reload_fn=reload_fn)
+    server.generation = 1
+    flush = json.dumps({CONTROL_KEY: "flush"})
+    reload_frame = json.dumps({CONTROL_KEY: "reload"})
+    lines = _mixed_lines(2, seed=37)
+    in_text = "\n".join([lines[0], flush, reload_frame,
+                         lines[1], flush]) + "\n"
+    pending["gen"] = 2
+    out = io.StringIO()
+    run_worker(server, io.StringIO(in_text), out, poll_on_flush=False)
+    envs = [json.loads(ln) for ln in out.getvalue().splitlines()]
+    reloaded = [e for e in envs if e.get(CONTROL_KEY) == "reloaded"]
+    assert len(reloaded) == 1
+    assert reloaded[0]["ok"] is True
+    # the frame reply must carry the PENDING generation, not the startup
+    # one: this is what the frontend's agreement check reads
+    assert reloaded[0]["generation"] == 2
+    assert server.generation == 2
+    # flushes (two of them) and EOF never polled: ONLY the reload frame
+    assert len(polls) == 1
 
 
 # -- fleet dispatch: death, quarantine, outage, manifest ----------------------
@@ -621,3 +664,237 @@ def test_doctor_serve_accepts_fleet_manifest(tmp_path, capsys):
     assert srec["breaker_state"] == "closed"
     frec = recs["fleet_manifest"]
     assert frec["status"] == "ok" and frec["accepted_total"] == 4
+
+
+# -- TCP transport: parity, heartbeat, rollout, live /metrics merge -----------
+
+def test_tcp_replica_parity_with_pipe():
+    """A worker reached over TCP is byte-for-byte the in-process loop:
+    same wire protocol, same envelopes, and the live probes (ping,
+    metrics scrape) answer between batches without disturbing parity."""
+    addr_box, ready, summary_box = [], threading.Event(), []
+
+    def announce(addr):
+        addr_box.append(addr)
+        ready.set()
+
+    def worker():
+        summary_box.append(
+            serve_worker_socket(_server(batch_max=64), "127.0.0.1", 0,
+                                announce=announce))
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    assert ready.wait(timeout=30)
+    rep = Replica.connect(0, addr_box[0], io_timeout_s=30.0)
+    lines = _mixed_lines(10, seed=31)
+    ref = _sequential_by_id(lines)
+    got = dict(rep.run_batch(lines[:6]))
+    rep.ping(10.0)
+    shard = rep.scrape(10.0)
+    assert "summary" in shard and "metrics" in shard
+    for seq, resp in rep.run_batch(lines[6:]).items():
+        got[6 + seq] = resp
+    assert len(got) == len(lines)
+    for i, ln in enumerate(lines):
+        rid = json.loads(ln)["id"]
+        assert json.dumps(got[i], sort_keys=True) == ref[rid], \
+            f"TCP response for {rid} diverges from the in-process loop"
+    assert rep.close() is None     # TCP: the process belongs to its host
+    t.join(timeout=30)
+    # the summary reads the process-global registry (the in-process
+    # reference run above counts too) — assert shape, not an absolute
+    assert summary_box and summary_box[0]["requests_total"] >= len(lines)
+    assert "breaker_state" in summary_box[0]
+    tc = rep.transport_counters()
+    assert tc["connect_attempts"] >= 1 and tc["heartbeat_misses"] == 0
+
+
+class _WedgedStub(_StubReplica):
+    """SIGSTOP stand-in: alive by every process-level check, silent on
+    the wire — only a heartbeat ping can expose it."""
+
+    def __init__(self, idx):
+        super().__init__(idx)
+        self.wedged = False
+        self.heartbeat_misses = 0
+        self.last_io_t = time.monotonic() - 60.0   # long idle: ping is due
+
+    @property
+    def alive(self):
+        return (not self.quarantined and not self.wedged
+                and self.proc.poll() is None)
+
+    def ping(self, timeout_s=None):
+        self.heartbeat_misses += 1
+        self.wedged = True
+        raise ReplicaWedgedError(f"replica {self.idx}: heartbeat miss")
+
+    def run_batch(self, lines):
+        raise AssertionError("a wedged replica must never see a batch")
+
+    def transport_counters(self):
+        return {"reconnects": 0, "heartbeat_misses": self.heartbeat_misses,
+                "redispatches": 0, "send_timeouts": 0, "recv_timeouts": 0,
+                "failure_phases": {}}
+
+
+def test_fleet_heartbeat_miss_quarantines_before_dispatch(tmp_path):
+    """A long-idle replica is pinged before it is trusted with a batch:
+    the miss quarantines it PRE-dispatch (no batch lost, no redispatch),
+    every response still matches the single-process loop, and the miss
+    is on the manifest's books."""
+    wedged = _WedgedStub(0)
+    ok = _StubReplica(1)
+    fleet = FleetServer(_server(batch_max=4), [wedged, ok], linger_s=10.0,
+                        heartbeat_s=0.5, heartbeat_timeout_s=1.0)
+    lines = _mixed_lines(8, seed=17)
+    got = {}
+    for i, ln in enumerate(lines):
+        for o, r in fleet.submit(ln, origin=i):
+            got[o] = r
+    for o, r in fleet.stop():
+        got[o] = r
+    ref = _sequential_by_id(lines, batch_max=4)
+    assert len(got) == len(lines)
+    for i, ln in enumerate(lines):
+        assert json.dumps(got[i], sort_keys=True) == ref[json.loads(ln)["id"]]
+    assert wedged.wedged and not wedged.alive
+    assert wedged.heartbeat_misses == 1   # one ping, never re-picked
+    assert wedged.delivered == {}
+    fleet.close_replicas()
+    fm = build_fleet_manifest({}, fleet, str(tmp_path))
+    by_idx = {r["replica"]: r for r in fm["replicas"]}
+    assert by_idx[0]["wedged"] and by_idx[0]["outcomes_total"] == 0
+    assert fm["transport"]["heartbeat_misses"] == 1
+    assert fm["transport"]["redispatches"] == 0
+    assert fm["audit"]["consistent"]
+
+
+class _RollStub(_StubReplica):
+    """Worker that re-fences only when told (``--hold-fence`` semantics):
+    ``reload_worker`` adopts the pointed-at generation; every batch logs
+    (replica, generation) into a shared timeline."""
+
+    def __init__(self, idx, pointer, timeline, ok=True):
+        super().__init__(idx)
+        self._pointer = pointer
+        self._timeline = timeline
+        self._ok = ok
+        self.generation = pointer[0]
+        self.reloads = 0
+
+    def run_batch(self, lines):
+        self._timeline.append((self.idx, self.generation))
+        return super().run_batch(lines)
+
+    def reload_worker(self, timeout_s=None):
+        self.reloads += 1
+        if not self._ok:
+            return {"ok": False, "generation": None}
+        self.generation = self._pointer[0]
+        return {"ok": True, "generation": self.generation}
+
+
+def test_rollout_rolls_workers_without_dropping_requests():
+    """The pointer flips mid-stream: the fleet rolls every worker between
+    batches, zero requests are dropped, every response stays bitwise, and
+    once any batch runs on the new generation no later batch anywhere in
+    the fleet runs on the old one (no mixed-generation batches)."""
+    pointer, timeline = ["gen-a"], []
+    reps = [_RollStub(0, pointer, timeline), _RollStub(1, pointer, timeline)]
+    fleet = FleetServer(_server(batch_max=4), reps, linger_s=10.0,
+                        rollout_check=lambda: pointer[0])
+    assert fleet._fleet_generation == "gen-a"
+    lines = _mixed_lines(16, seed=17)
+    got = {}
+    for i, ln in enumerate(lines):
+        if i == 8:
+            pointer[0] = "gen-b"
+        for o, r in fleet.submit(ln, origin=i):
+            got[o] = r
+    for o, r in fleet.stop():
+        got[o] = r
+    ref = _sequential_by_id(lines, batch_max=4)
+    assert len(got) == len(lines)          # zero dropped across the roll
+    for i, ln in enumerate(lines):
+        assert json.dumps(got[i], sort_keys=True) == ref[json.loads(ln)["id"]]
+    assert [r.reloads for r in reps] == [1, 1]
+    assert fleet._fleet_generation == "gen-b"
+    gens = [g for _, g in timeline]
+    assert "gen-a" in gens and "gen-b" in gens
+    first_b = gens.index("gen-b")
+    assert all(g == "gen-b" for g in gens[first_b:])
+
+
+def test_rollout_failed_fence_audit_quarantines_worker():
+    """A worker whose new generation fails its fence audit is drained
+    out of the rotation; the survivor finishes the roll, the fence still
+    moves, and every request is answered bitwise."""
+    pointer, timeline = ["gen-a"], []
+    bad = _RollStub(0, pointer, timeline, ok=False)
+    good = _RollStub(1, pointer, timeline)
+    fleet = FleetServer(_server(batch_max=4), [bad, good], linger_s=10.0,
+                        rollout_check=lambda: pointer[0])
+    lines = _mixed_lines(12, seed=17)
+    got = {}
+    for i, ln in enumerate(lines):
+        if i == 4:
+            pointer[0] = "gen-b"
+        for o, r in fleet.submit(ln, origin=i):
+            got[o] = r
+    for o, r in fleet.stop():
+        got[o] = r
+    ref = _sequential_by_id(lines, batch_max=4)
+    assert len(got) == len(lines)
+    for i, ln in enumerate(lines):
+        assert json.dumps(got[i], sort_keys=True) == ref[json.loads(ln)["id"]]
+    assert bad.quarantined and not bad.alive
+    assert good.reloads == 1 and good.generation == "gen-b"
+    assert fleet._fleet_generation == "gen-b"
+    # after the roll the quarantined worker never saw another batch
+    assert all(g == "gen-a" for ix, g in timeline if ix == 0)
+
+
+def test_http_metrics_merges_live_worker_shards():
+    """GET /metrics and /healthz on a fleet frontend carry one live
+    entry per worker (scraped mid-run over the transport), not just the
+    frontend's own registry."""
+    ok = _StubReplica(0)
+    ok.scrape = lambda timeout_s=None: {"summary": {"requests_total": 3},
+                                        "metrics": {"fleet_probe": 1.0}}
+    ok.transport_counters = lambda: {"reconnects": 0, "heartbeat_misses": 0,
+                                     "redispatches": 0, "send_timeouts": 0,
+                                     "recv_timeouts": 0, "failure_phases": {}}
+    fe = SocketFrontend("127.0.0.1", 0, http=True)
+    backend = FleetServer(_server(batch_max=64), [ok], linger_s=0.02,
+                          deliver=fe.deliver)
+    fe.backend = backend
+    addr = fe.listen()
+    fe.start()
+    try:
+        def get(path):
+            with socket.create_connection(addr, timeout=30) as s:
+                s.sendall(f"GET {path} HTTP/1.1\r\nHost: x\r\n"
+                          "Connection: close\r\n\r\n".encode())
+                raw = b""
+                while True:
+                    chunk = s.recv(65536)
+                    if not chunk:
+                        break
+                    raw += chunk
+            head, _, payload = raw.partition(b"\r\n\r\n")
+            assert head.startswith(b"HTTP/1.1 200")
+            return json.loads(payload.decode())
+
+        snap = get("/metrics")
+        (w0,) = snap["workers"]
+        assert w0["replica"] == 0 and w0["alive"]
+        assert w0["metrics"] == {"fleet_probe": 1.0}
+        assert w0["transport"]["heartbeat_misses"] == 0
+        hz = get("/healthz")
+        (h0,) = hz["workers"]
+        assert h0["summary"] == {"requests_total": 3}
+        assert not h0["wedged"]
+    finally:
+        fe.stop()

@@ -297,6 +297,37 @@ def test_s3_jit_dispatch_under_lock(tmp_path):
     assert set(_found(res)) == {("S3", "bad_direct"), ("S3", "bad_dispatch")}
 
 
+def test_s3_os_handle_receiver_is_not_a_jax_edge(tmp_path):
+    """``self.proc.poll()`` on a subprocess.Popen field must not resolve
+    through the bare-name index onto some unrelated class's ``poll`` that
+    happens to dispatch jax — that alias would drag every transport
+    method into the jax_touch closure and flag locked callers as S3."""
+    res = _sync(tmp_path, {"mod.py": """
+        import subprocess
+        import threading
+
+        import jax.numpy as jnp
+
+        LOCK = threading.Lock()
+
+        class Engine:
+            def poll(self):
+                return jnp.zeros(3)       # genuine jax toucher named poll
+
+        class Worker:
+            def __init__(self):
+                self.proc = subprocess.Popen(["true"])
+
+            def alive(self):
+                return self.proc.poll() is None   # OS handle, not Engine.poll
+
+        def route(w):
+            with LOCK:
+                return w.alive()          # must NOT be S3: no jax reachable
+    """})
+    assert _found(res) == []
+
+
 def test_baseline_and_strict_stale(tmp_path):
     files = {"mod.py": """
         import threading

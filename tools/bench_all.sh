@@ -60,6 +60,9 @@ python bench.py --config sweep   "${plat[@]}" | tail -1 > "$out/config11_sweep.j
 python bench.py --config grad    "${plat[@]}" | tail -1 > "$out/config8_grad.json"
 python bench.py --config fleet   "${plat[@]}" | tail -1 > "$out/config9_fleet.json"
 python bench.py --config cache   "${plat[@]}" | tail -1 > "$out/config10_cache.json"
+# config 12 always measures the TCP transport on-host: workers are real
+# subprocesses, so the multi-host number is the wire + dispatch overhead
+python bench.py --config fleet_mh --platform cpu | tail -1 > "$out/config12_fleet_mh.json"
 
 # universe-scaling smoke (slow; skip with MFM_SKIP_UNIVERSE_SMOKE=1): the
 # full A-share universe (N=5000) on an 8-device host mesh, time-bounded by
@@ -92,7 +95,8 @@ python tools/profile_eigen.py --json "$out/eigen_sweep.json" \
 # numbers are a finding, not evidence to file.
 for rec in "$out/config1_risk.json" "$out/config6_query.json" \
            "$out/config7_scenario.json" "$out/config8_grad.json" \
-           "$out/config9_fleet.json" "$out/config10_cache.json"; do
+           "$out/config9_fleet.json" "$out/config10_cache.json" \
+           "$out/config12_fleet_mh.json"; do
   python tools/perfgate.py "$rec" \
     || { echo "perfgate: $rec regressed vs the BENCH_r*.json trajectory" >&2
          exit 1; }
@@ -125,9 +129,14 @@ done
 # closed-loop socket hammer must keep the coalescer responses bitwise the
 # sequential loop per id, and a concurrent hit/miss/reload storm must keep
 # cache hits byte-equal cold with the LRU bounds and generation fence
-# intact — the runtime confirmation of mfmsync's static findings
+# intact — the runtime confirmation of mfmsync's static findings, and the
+# multi-host fleet: SIGKILL an entire 2-worker host mid-storm while a
+# third worker sits SIGSTOPped (wedged, not dead) — heartbeats must
+# quarantine the silent worker, survivors answer everything bitwise
+# by id, and the merged manifest's transport counters stay audit-
+# consistent (config 12's evidence)
 python tools/faultinject.py --plans \
-  query-kill-mid-batch,query-poison-slab,query-overflow-storm,query-ckpt-swap,query-steady-state,scenario-kill-mid-batch,scenario-poison-spec,trace-kill-mid-flush,eigen-kill-mid-update,shard-kill-mid-append,grad-kill-mid-solve,fleet-kill-replica,cache-stale-generation,sweep-kill-mid-stream,sync-schedule-coalescer,sync-schedule-cache \
+  query-kill-mid-batch,query-poison-slab,query-overflow-storm,query-ckpt-swap,query-steady-state,scenario-kill-mid-batch,scenario-poison-spec,trace-kill-mid-flush,eigen-kill-mid-update,shard-kill-mid-append,grad-kill-mid-solve,fleet-kill-replica,fleet-kill-host,fleet-wedge-worker,cache-stale-generation,sweep-kill-mid-stream,sync-schedule-coalescer,sync-schedule-cache \
   || { echo "query/scenario/trace/grad/fleet/cache/sweep/schedule chaos plans failed — config6/7/8/9/10/11 numbers are not evidence" >&2
        exit 1; }
 

@@ -1353,11 +1353,14 @@ def run_fleet_kill(plan, base: Baseline, root: str) -> dict:
     path = os.path.join(d, "state.npz")
     k = _query_engine(path).K
     req = os.path.join(d, "req.jsonl")
-    # 48 requests / batch-max 8 = 6 batches round-robin over 3 replicas:
-    # the victim (replica 1) sees global batches 1 and 4 as its local
-    # batch0/batch1 — MATCH=batch1 kills it on its SECOND batch, mid-run
+    # 96 requests / batch-max 8 = 12 batches.  The EWMA router hands the
+    # first cycle to each fresh replica in index order, then routes by
+    # batch wall with a starve_rounds=4 starvation guard — so the victim
+    # (replica 1) is GUARANTEED its second batch (the MATCH=batch1 kill
+    # point) by dispatch ~6 at the latest, with batches still queued
+    # behind it for the survivors to absorb
     with open(req, "w") as fh:
-        fh.write("\n".join(_query_requests(plan.seed, 48, k)) + "\n")
+        fh.write("\n".join(_query_requests(plan.seed, 96, k)) + "\n")
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo_root}
     with open(path, "rb") as fh:
@@ -1398,6 +1401,15 @@ def run_fleet_kill(plan, base: Baseline, root: str) -> dict:
             f"{fleet['audit']['frontend_local_total']}) of "
             f"{fleet['audit']['accepted_total']} accepted requests (the "
             "re-dispatch dropped the dead replica's batch)")
+    # the chaos point fires AFTER the victim computed its batch but
+    # BEFORE the envelopes hit the pipe — so exactly that in-flight
+    # batch (8 requests) MUST show up in the transport redispatch
+    # counters the manifest totals for the doctor audit
+    if fleet["transport"]["redispatches"] < 8:
+        raise AssertionError(
+            f"{plan.name}: transport counters show "
+            f"{fleet['transport']['redispatches']} redispatched requests, "
+            "expected the victim's full in-flight batch (8)")
 
     # single-process replay: the fleet's answers must be its prefix-free
     # equal — same ids, same floats, same order
@@ -1413,9 +1425,9 @@ def run_fleet_kill(plan, base: Baseline, root: str) -> dict:
         fleet_resp = [ln for ln in fh.read().splitlines() if ln]
     with open(os.path.join(d, "resp_clean.jsonl")) as fh:
         clean_resp = [ln for ln in fh.read().splitlines() if ln]
-    if len(fleet_resp) != 48:
+    if len(fleet_resp) != 96:
         raise AssertionError(f"{plan.name}: fleet answered "
-                             f"{len(fleet_resp)}/48 requests")
+                             f"{len(fleet_resp)}/96 requests")
     if fleet_resp != clean_resp:
         diverge = sum(1 for a, b in zip(fleet_resp, clean_resp) if a != b)
         raise AssertionError(
@@ -1430,6 +1442,278 @@ def run_fleet_kill(plan, base: Baseline, root: str) -> dict:
     return {"killed_replica": victim, "killed_at": plan.param("match"),
             "survivors": n_replicas - 1, "responses": len(fleet_resp),
             "replay": "bitwise", "doctor": "green"}
+
+
+def _worker_pids(fe_pid: int, n: int, deadline_s: float = 240.0) -> dict:
+    """``{worker_id: pid}`` of a live frontend's spawned worker children,
+    read off /proc (the drill signals them directly, bypassing the
+    frontend — that is the point: the frontend must DISCOVER the faults)."""
+    pids: dict = {}
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s and len(pids) < n:
+        try:
+            with open(f"/proc/{fe_pid}/task/{fe_pid}/children") as fh:
+                kids = fh.read().split()
+        except OSError:
+            kids = []
+        for cpid in kids:
+            try:
+                with open(f"/proc/{cpid}/cmdline", "rb") as fh:
+                    argv = fh.read().split(b"\0")
+            except OSError:
+                continue
+            if b"--worker-id" in argv:
+                wid = int(argv[argv.index(b"--worker-id") + 1])
+                pids[wid] = int(cpid)
+        if len(pids) < n:
+            time.sleep(0.2)
+    if len(pids) < n:
+        raise AssertionError(f"found {len(pids)}/{n} worker children of "
+                             f"frontend pid {fe_pid}")
+    return pids
+
+
+def _drive_fleet_storm(plan, d: str, path: str, lines: list, env: dict,
+                       n_replicas: int, mid_storm) -> list:
+    """Feed ``lines`` to a live ``serve --replicas N`` frontend over its
+    stdin in two halves, calling ``mid_storm(worker_pids)`` between them
+    (after the first half's responses are durable, so no batch is in
+    flight when the signals land).  Returns the response lines; the
+    frontend must exit 0 whatever ``mid_storm`` did to its workers."""
+    out = os.path.join(d, "resp_fleet.jsonl")
+    cmd = [sys.executable, "-m", "mfm_tpu.cli", "serve", path,
+           "--output", out, "--replicas", str(n_replicas),
+           "--batch-max", "8", "--deadline-s", "600",
+           # the SIGSTOP lands while every worker is idle (first half
+           # durable), so the HEARTBEAT is what must detect it: idle
+           # workers are pinged after 0.5 s and quarantined 1 s later.
+           # The per-I/O deadline stays generous — a worker's first
+           # batch pays its jit compile in silence, and a 2 s budget
+           # falsely wedges it before the storm even starts
+           "--worker-timeout-s", "60", "--heartbeat-s", "0.5",
+           "--heartbeat-timeout-s", "1"]
+    proc = subprocess.Popen(cmd, env=env, stdin=subprocess.PIPE,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        pids = _worker_pids(proc.pid, n_replicas)
+        half = len(lines) // 2
+        proc.stdin.write("\n".join(lines[:half]) + "\n")
+        proc.stdin.flush()
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 240.0:
+            try:
+                with open(out, encoding="utf-8") as fh:
+                    if sum(1 for ln in fh if ln.strip()) >= half:
+                        break
+            except OSError:
+                pass
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"{plan.name}: frontend died during the first half "
+                    f"(rc={proc.returncode})\n{proc.stderr.read()[-2000:]}")
+            time.sleep(0.2)
+        else:
+            raise AssertionError(f"{plan.name}: first {half} responses "
+                                 "never became durable")
+        mid_storm(pids)
+        # idle past --heartbeat-s before releasing the second half: every
+        # worker's last I/O goes stale, so the router PINGS each pick
+        # before trusting it — the SIGSTOPped worker misses its pong
+        # within --heartbeat-timeout-s instead of burning the full
+        # --worker-timeout-s batch deadline.  This is the detection
+        # bound the drill certifies: heartbeat interval + timeout.
+        time.sleep(1.0)
+        proc.stdin.write("\n".join(lines[half:]) + "\n")
+        proc.stdin.close()
+        rc = proc.wait(timeout=600)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        # a SIGSTOPped worker never dies with its parent — resume-by-kill
+        # any stragglers so the scratch tree can be reaped
+        for pid in list(locals().get("pids", {}).values()):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+    if rc != 0:
+        raise AssertionError(
+            f"{plan.name}: the frontend must survive the storm, got "
+            f"rc={rc}\n{proc.stderr.read()[-2000:]}")
+    with open(out, encoding="utf-8") as fh:
+        return [ln for ln in fh.read().splitlines() if ln]
+
+
+def _fleet_clean_by_id(plan, d: str, path: str, req_lines: list,
+                       env: dict) -> dict:
+    """id -> response line of the fault-free single-process replay."""
+    req = os.path.join(d, "req.jsonl")
+    with open(req, "w") as fh:
+        fh.write("\n".join(req_lines) + "\n")
+    clean_cmd = [sys.executable, "-m", "mfm_tpu.cli", "serve", path,
+                 "--input", req, "--output", os.path.join(d, "resp_clean.jsonl"),
+                 "--batch-max", "8", "--deadline-s", "600", "--gulp"]
+    proc = subprocess.run(clean_cmd, env=env, capture_output=True,
+                          text=True, timeout=600)
+    if proc.returncode != 0:
+        raise AssertionError(f"{plan.name}: fault-free replay failed "
+                             f"rc={proc.returncode}\n{proc.stderr[-2000:]}")
+    with open(os.path.join(d, "resp_clean.jsonl")) as fh:
+        return {json.loads(ln)["id"]: ln
+                for ln in fh.read().splitlines() if ln}
+
+
+def _assert_fleet_bitwise_by_id(plan, fleet_resp: list, clean: dict,
+                                n: int) -> None:
+    """Live feeding makes batch boundaries timing-dependent, so the
+    survivors' answers are compared BY REQUEST ID, not by line order —
+    the per-id bytes are still the single-process replay's."""
+    got = {json.loads(ln)["id"]: ln for ln in fleet_resp}
+    if len(got) != n:
+        raise AssertionError(f"{plan.name}: fleet answered {len(got)}/{n} "
+                             "request ids")
+    diverged = [rid for rid, ln in got.items() if clean.get(rid) != ln]
+    if diverged:
+        raise AssertionError(
+            f"{plan.name}: {len(diverged)} responses diverge from the "
+            f"fault-free replay (first: {sorted(diverged)[0]}) — "
+            "re-dispatch after the storm is not deterministic")
+
+
+def run_fleet_kill_host(plan, base: Baseline, root: str) -> dict:
+    """fleet-kill-host: the multi-host headline drill.  2 simulated hosts
+    x 2 workers; mid-storm both of host 1's workers die by SIGKILL while
+    worker ``wedge`` (on host 0) is SIGSTOPped — wedged, not dead.  The
+    surviving worker must answer everything (bitwise-by-id the fault-free
+    replay's), the manifest must count 2 lost + 1 wedged with a balanced
+    audit and the redispatches in its transport block, the checkpoint's
+    bytes stay untouched, and ``doctor --serve`` stays green."""
+    hosts = int(plan.param("hosts", 2))
+    wph = int(plan.param("workers_per_host", 2))
+    kill_host = int(plan.param("kill_host", 1))
+    wedge = int(plan.param("wedge", 1))
+    n = int(plan.param("n", 64))
+    n_replicas = hosts * wph
+    victims = [j for j in range(n_replicas) if j // wph == kill_host]
+    d = _fresh_workdir(root, plan.name, base.snaps[0])
+    path = os.path.join(d, "state.npz")
+    k = _query_engine(path).K
+    lines = _query_requests(plan.seed, n, k)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo_root}
+    with open(path, "rb") as fh:
+        state_bytes = fh.read()
+
+    def mid_storm(pids):
+        os.kill(pids[wedge], signal.SIGSTOP)
+        for j in victims:
+            os.kill(pids[j], signal.SIGKILL)
+
+    fleet_resp = _drive_fleet_storm(plan, d, path, lines, env,
+                                    n_replicas, mid_storm)
+    with open(path, "rb") as fh:
+        if fh.read() != state_bytes:
+            raise AssertionError(f"{plan.name}: the checkpoint's bytes "
+                                 "changed under a read-only serving fleet")
+    clean = _fleet_clean_by_id(plan, d, path, lines, env)
+    _assert_fleet_bitwise_by_id(plan, fleet_resp, clean, n)
+
+    fman = json.load(open(os.path.join(d, "fleet_manifest.json")))
+    fleet = fman["fleet"]
+    lost = sorted(r["replica"] for r in fleet["replicas"] if r["lost"])
+    wedged = sorted(r["replica"] for r in fleet["replicas"] if r["wedged"])
+    if not set(victims) <= set(lost):
+        raise AssertionError(f"{plan.name}: manifest counts lost {lost}, "
+                             f"expected at least {victims}")
+    if wedged != [wedge]:
+        raise AssertionError(f"{plan.name}: manifest counts wedged "
+                             f"{wedged}, expected [{wedge}] — the "
+                             "SIGSTOPped worker was not detected as such")
+    tr = fleet["transport"]
+    # NOTE: whether dead workers cost a REDISPATCH (batch sent, EOF on
+    # the reply) or are caught by the pre-dispatch heartbeat (no batch
+    # ever sent) is a timing race this drill does not pin down —
+    # fleet-kill-replica pins the guaranteed-redispatch case via its
+    # in-worker chaos point.  The wedge, though, can only be discovered
+    # by a bounded mechanism, and that discovery must be on the books:
+    if tr["heartbeat_misses"] + tr["io_timeouts"] < 1:
+        raise AssertionError(f"{plan.name}: the wedge left no heartbeat "
+                             "miss or I/O timeout in the counters")
+    if not fleet["audit"]["consistent"]:
+        raise AssertionError(
+            f"{plan.name}: delivery audit broken — delivered "
+            f"{fleet['audit']['delivered_total']} of "
+            f"{fleet['audit']['accepted_total']} accepted")
+    doc = subprocess.run([sys.executable, "-m", "mfm_tpu.cli", "doctor", d,
+                          "--serve"],
+                         env=env, capture_output=True, text=True, timeout=600)
+    if doc.returncode != 0:
+        raise AssertionError(f"{plan.name}: doctor --serve rejects the "
+                             f"post-storm directory\n{doc.stdout[-2000:]}")
+    return {"killed_host": kill_host, "killed_workers": victims,
+            "wedged_worker": wedge, "responses": len(fleet_resp),
+            "redispatches": tr["redispatches"],
+            "replay": "bitwise-by-id", "doctor": "green"}
+
+
+def run_fleet_wedge(plan, base: Baseline, root: str) -> dict:
+    """fleet-wedge-worker: SIGSTOP one of three workers mid-storm —
+    nothing killed, nothing closed, the failure an EOF check cannot see.
+    The heartbeat ping (or the per-I/O deadline on its next batch) must
+    quarantine it, its batch re-dispatches like a death, every request is
+    answered bitwise-by-id, and the wedge is visible in the manifest
+    (wedged flag + heartbeat_misses/io_timeouts) with the audit intact."""
+    n_replicas = int(plan.param("replicas", 3))
+    wedge = int(plan.param("wedge", 1))
+    n = int(plan.param("n", 48))
+    d = _fresh_workdir(root, plan.name, base.snaps[0])
+    path = os.path.join(d, "state.npz")
+    k = _query_engine(path).K
+    lines = _query_requests(plan.seed, n, k)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo_root}
+
+    def mid_storm(pids):
+        os.kill(pids[wedge], signal.SIGSTOP)
+
+    fleet_resp = _drive_fleet_storm(plan, d, path, lines, env,
+                                    n_replicas, mid_storm)
+    clean = _fleet_clean_by_id(plan, d, path, lines, env)
+    _assert_fleet_bitwise_by_id(plan, fleet_resp, clean, n)
+
+    fman = json.load(open(os.path.join(d, "fleet_manifest.json")))
+    fleet = fman["fleet"]
+    wedged = sorted(r["replica"] for r in fleet["replicas"] if r["wedged"])
+    if wedged != [wedge]:
+        raise AssertionError(f"{plan.name}: manifest counts wedged "
+                             f"{wedged}, expected [{wedge}]")
+    tr = fleet["transport"]
+    # the storm driver idles past --heartbeat-s before the second half,
+    # so discovery MUST come from the ping (fast path), never the 60 s
+    # batch deadline — a drill that quietly fell through to the I/O
+    # timeout would certify the wrong detection bound
+    if tr["heartbeat_misses"] < 1:
+        raise AssertionError(f"{plan.name}: the wedge was not caught by "
+                             "a heartbeat miss — detection fell through "
+                             "to the batch I/O deadline")
+    if not fleet["audit"]["consistent"]:
+        raise AssertionError(
+            f"{plan.name}: delivery audit broken — delivered "
+            f"{fleet['audit']['delivered_total']} of "
+            f"{fleet['audit']['accepted_total']} accepted")
+    doc = subprocess.run([sys.executable, "-m", "mfm_tpu.cli", "doctor", d,
+                          "--serve"],
+                         env=env, capture_output=True, text=True, timeout=600)
+    if doc.returncode != 0:
+        raise AssertionError(f"{plan.name}: doctor --serve rejects the "
+                             f"post-wedge directory\n{doc.stdout[-2000:]}")
+    return {"wedged_worker": wedge, "survivors": n_replicas - 1,
+            "responses": len(fleet_resp),
+            "heartbeat_misses": tr["heartbeat_misses"],
+            "io_timeouts": tr["io_timeouts"],
+            "replay": "bitwise-by-id", "doctor": "green"}
 
 
 def run_cache_stale(plan, base: Baseline, root: str) -> dict:
@@ -1919,7 +2203,10 @@ RUNNERS = {"truncate": run_byte_fault, "corrupt": run_byte_fault,
            "sweep_kill": run_sweep_kill,
            "trace_kill": run_trace_kill, "eigen_kill": run_eigen_kill,
            "shard_kill": run_shard_kill, "grad_kill": run_grad_kill,
-           "fleet_kill": run_fleet_kill, "cache_stale": run_cache_stale,
+           "fleet_kill": run_fleet_kill,
+           "fleet_kill_host": run_fleet_kill_host,
+           "fleet_wedge": run_fleet_wedge,
+           "cache_stale": run_cache_stale,
            "sync_schedule_coalescer": run_sync_schedule_coalescer,
            "sync_schedule_cache": run_sync_schedule_cache}
 

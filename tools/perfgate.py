@@ -63,6 +63,7 @@ METRIC_SPECS = {
     "reverse_scenarios_per_sec": ("higher", 0.20, None),
     "fleet_qps": ("higher", 0.20, None),
     "fleet_p99_latency_s": ("lower", 0.30, 0.05),
+    "fleet_mh_qps": ("higher", 0.20, None),
     "coalesce_batch_fill_frac": ("higher", 0.20, None),
     "cached_qps": ("higher", 0.20, None),
     "cache_hit_rate": ("higher", 0.05, None),
@@ -104,6 +105,11 @@ def extract_metrics(rec) -> dict:
         for k in ("fleet_qps", "fleet_p99_latency_s",
                   "coalesce_batch_fill_frac"):
             out[k] = rec.get(k)
+    elif metric == "fleet_mh_serving_throughput":
+        # only gated when the kill drill survived — a QPS number from a
+        # run whose fleet dropped requests is not evidence of anything
+        if (rec.get("kill_drill") or {}).get("survived"):
+            out["fleet_mh_qps"] = rec.get("fleet_mh_qps")
     elif metric == "cache_serving_throughput":
         for k in ("cached_qps", "cache_hit_rate",
                   "cache_p99_latency_s"):

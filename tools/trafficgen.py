@@ -233,6 +233,38 @@ def hammer(addr, lines_per_thread, *, timeout_s: float = 120.0) -> dict:
             "responses": flat}
 
 
+def partition_hosts(lines, hosts: int) -> list:
+    """Split one seeded stream across ``hosts`` client hosts: host ``h``
+    sends ``lines[h::hosts]``.  Striding (not chunking) keeps every host's
+    slice the same shape mix and arrival density, so per-host latency
+    percentiles are comparable — and the union of the partitions is the
+    original stream, so a fleet answering the partitioned run stays
+    bitwise-comparable per id to the single-host replay."""
+    if hosts < 1:
+        raise ValueError(f"hosts must be >= 1, got {hosts}")
+    return [lines[h::hosts] for h in range(hosts)]
+
+
+def host_of(ordinal: int, hosts: int) -> int:
+    """The client host an ordinal belongs to under :func:`partition_hosts`
+    striding (ordinal i came from host ``i % hosts``)."""
+    return int(ordinal) % int(hosts)
+
+
+def per_host_latency(arrivals, completions, hosts: int) -> dict:
+    """:func:`latency_stats` per client host of the striped partition:
+    ``{"host0": {...}, ...}``.  One slow or partitioned host shows up as
+    ITS percentiles degrading while the others hold — the merged stats
+    alone cannot distinguish that from uniform slowdown."""
+    out = {}
+    for h in range(int(hosts)):
+        harr = arrivals[h::hosts]
+        hcomp = {i // hosts: completions[i]
+                 for i in completions if host_of(i, hosts) == h}
+        out[f"host{h}"] = latency_stats(harr, hcomp)
+    return out
+
+
 def latency_stats(arrivals, completions) -> dict:
     """p50/p99/max of (completion - arrival) for matched ordinals.
     ``completions`` maps ordinal -> completion clock time; unanswered
@@ -277,17 +309,32 @@ def main(argv=None) -> int:
                          "(default 100; only with --zipf)")
     ap.add_argument("--hammer", type=int, default=None, metavar="T",
                     help="instead of printing the stream, drive it "
-                         "closed-loop from T client threads against "
-                         "--connect, asserting per-thread response "
-                         "order; responses go to stdout, a stats line "
-                         "to stderr")
-    ap.add_argument("--connect", default=None, metavar="HOST:PORT",
-                    help="socket frontend address for --hammer")
+                         "closed-loop from T client threads (per host) "
+                         "against --connect, asserting per-thread "
+                         "response order; responses go to stdout, a "
+                         "stats line to stderr")
+    ap.add_argument("--connect", default=None, metavar="HOST:PORT[,..]",
+                    help="socket frontend address(es) for --hammer; a "
+                         "comma list drives one address per client host "
+                         "(--hosts must match its length)")
+    ap.add_argument("--hosts", type=int, default=1, metavar="N",
+                    help="partition the stream across N client hosts "
+                         "(host h sends lines h::N); stream mode needs "
+                         "--out-prefix, hammer mode one --connect "
+                         "address per host; stats come back per host")
+    ap.add_argument("--out-prefix", default=None, metavar="PATH",
+                    help="with --hosts N in stream mode, write host h's "
+                         "partition to PATH.host{h}.jsonl instead of "
+                         "stdout")
     args = ap.parse_args(argv)
     if (args.hammer is None) != (args.connect is None):
         ap.error("--hammer and --connect go together")
     if args.hammer is not None and args.hammer < 1:
         ap.error("--hammer needs at least one thread")
+    if args.hosts < 1:
+        ap.error("--hosts needs at least one host")
+    if args.hosts > 1 and args.hammer is None and args.out_prefix is None:
+        ap.error("--hosts N in stream mode needs --out-prefix")
     mix = tuple(float(x) for x in args.mix.split(","))
     if args.zipf is not None:
         lines = gen_zipf_requests(args.seed, args.n, args.k,
@@ -301,14 +348,51 @@ def main(argv=None) -> int:
                              scenario=args.scenario,
                              deadline_s=args.deadline_s)
     if args.hammer is not None:
-        host, _, port = args.connect.rpartition(":")
-        per_thread = [lines[i::args.hammer] for i in range(args.hammer)]
-        rep = hammer((host or "127.0.0.1", int(port)), per_thread)
-        for rid in sorted(rep["responses"]):
-            sys.stdout.write(rep["responses"][rid] + "\n")
-        print(json.dumps({"threads": rep["threads"], "n": rep["n"],
-                          "wall_s": round(rep["wall_s"], 4),
-                          "qps": round(rep["qps"], 2)}), file=sys.stderr)
+        addrs = []
+        for spec in args.connect.split(","):
+            host, _, port = spec.strip().rpartition(":")
+            addrs.append((host or "127.0.0.1", int(port)))
+        if len(addrs) != args.hosts:
+            ap.error(f"--hosts {args.hosts} needs {args.hosts} --connect "
+                     f"address(es), got {len(addrs)}")
+        by_host = partition_hosts(lines, args.hosts)
+        reports: dict = {}
+
+        def drive(h):
+            slab = by_host[h]
+            per_thread = [slab[i::args.hammer] for i in range(args.hammer)]
+            reports[h] = hammer(addrs[h], per_thread)
+
+        drivers = [threading.Thread(target=drive, args=(h,),
+                                    name=f"hammer-host{h}", daemon=True)
+                   for h in range(args.hosts)]
+        for t in drivers:
+            t.start()
+        for t in drivers:
+            t.join()
+        responses = {}
+        for rep in reports.values():
+            responses.update(rep["responses"])
+        for rid in sorted(responses):
+            sys.stdout.write(responses[rid] + "\n")
+        n = sum(rep["n"] for rep in reports.values())
+        wall = max(rep["wall_s"] for rep in reports.values())
+        stats = {"hosts": args.hosts, "threads_per_host": args.hammer,
+                 "n": n, "wall_s": round(wall, 4),
+                 "qps": round(n / wall if wall else 0.0, 2),
+                 "per_host": {f"host{h}": {
+                     "n": reports[h]["n"],
+                     "wall_s": round(reports[h]["wall_s"], 4),
+                     "qps": round(reports[h]["qps"], 2)}
+                     for h in sorted(reports)}}
+        print(json.dumps(stats, sort_keys=True), file=sys.stderr)
+        return 0
+    if args.hosts > 1:
+        for h, slab in enumerate(partition_hosts(lines, args.hosts)):
+            with open(f"{args.out_prefix}.host{h}.jsonl", "w",
+                      encoding="utf-8") as fh:
+                for line in slab:
+                    fh.write(line + "\n")
         return 0
     for line in lines:
         sys.stdout.write(line + "\n")
