@@ -1732,6 +1732,7 @@ def bench_fleet_mh():
     from mfm_tpu.data.artifacts import load_risk_state
     from mfm_tpu.data.synthetic import synthetic_barra_table
     from mfm_tpu.pipeline import run_risk_pipeline, save_pipeline_state
+    from mfm_tpu.obs import trace as _trace
     from mfm_tpu.serve import QueryEngine, QueryServer, ServePolicy
     from mfm_tpu.serve.replica import (
         FleetServer, Replica, build_fleet_manifest, worker_cmd,
@@ -1739,6 +1740,12 @@ def bench_fleet_mh():
 
     hosts, wph = 2, 2                 # 2 simulated hosts x 2 workers
     batch_max, linger = 32, 0.02
+    # distributed tracing stays ON (the default) for the whole cell: the
+    # bitwise checks below double as the proof that the trace prologue +
+    # span piggyback never touch response bytes.  A big ring keeps every
+    # merged span for the coverage audit.
+    _trace.reset_tracing()
+    _trace.set_ring_capacity(65536)
     tmp = tempfile.mkdtemp(prefix="bench_fleet_mh_")
     # workers/reference run with cwd=tmp, so the repo import path (and the
     # platform pin) must ride the environment
@@ -1926,6 +1933,33 @@ def bench_fleet_mh():
         fleet.close_replicas()
         survived = (not mism_b and len(resps["b"]) == n_b
                     and fm["audit"]["consistent"])
+
+        # -- distributed-trace audit: ONE corrected timeline per request ----
+        # Every healthy-phase request id must appear in the merged ring
+        # with BOTH a frontend-local span and a worker child span shipped
+        # over the wire (stamped with its clock correction) — the >=95%
+        # coverage gate on this cell.  The merged ring must also render a
+        # Perfetto-loadable Chrome trace via the atomic writer.
+        front_tids, worker_tids, n_skew = set(), set(), 0
+        merged = _trace.spans()
+        for sp in merged:
+            if sp.trace_id is None:
+                continue
+            if "worker" in sp.attrs:          # ingested over the wire
+                worker_tids.add(sp.trace_id)
+                if sp.attrs.get("clock_skew") == "uncorrectable":
+                    n_skew += 1
+            else:
+                front_tids.add(sp.trace_id)
+        a_tids = [resp.get("trace_id") for resp in resps["a"].values()]
+        a_tids = [t for t in a_tids if t]
+        covered = sum(1 for t in a_tids
+                      if t in front_tids and t in worker_tids)
+        coverage = covered / max(1, len(a_tids))
+        trace_path = _trace.write_chrome_trace(
+            os.path.join(tmp, "fleet_trace.json"))
+        with open(trace_path, encoding="utf-8") as fh:
+            trace_events = _trace.parse_chrome_trace(fh.read())
         return {"metric": "fleet_mh_serving_throughput",
                 "value": round(mh_qps),
                 "unit": "requests/s", "vs_baseline": None,
@@ -1951,12 +1985,21 @@ def bench_fleet_mh():
                     "audit_consistent": fm["audit"]["consistent"],
                     "survived": survived,
                 },
+                "trace": {
+                    "request_coverage_frac": round(coverage, 4),
+                    "coverage_ok": coverage >= 0.95,
+                    "requests_with_trace_id": len(a_tids),
+                    "merged_spans": len(merged),
+                    "chrome_events": len(trace_events),
+                    "uncorrectable_skew_spans": n_skew,
+                },
                 "transport": fm["transport"]}
     finally:
         for proc in procs:
             if proc.poll() is None:
                 proc.kill()
                 proc.wait(timeout=30)
+        _trace.reset_tracing()
         shutil.rmtree(tmp, ignore_errors=True)
 
 

@@ -1491,6 +1491,27 @@ def _doctor(args):
                         "serve manifest carries no root trace_id — this "
                         "run cannot be joined to its trace (pre-tracing "
                         "build, or tracing disabled)")
+                # SLO burn audit: a fast-burning objective at shutdown is
+                # a page-now condition doctor FAILS on; slow burn warns
+                slo = (serve.get("slo") if isinstance(serve, dict)
+                       else None)
+                if isinstance(slo, dict):
+                    rec["slo_worst_state"] = slo.get("worst_state")
+                    for s in slo.get("slos", []):
+                        if s.get("state") == "fast_burn":
+                            rec["problems"].append(
+                                f"SLO {s.get('name')!r} was FAST-BURNING "
+                                f"(burn {s.get('burn_fast')} over the "
+                                f"{slo.get('window_fast_s')}s window, "
+                                "threshold "
+                                f"{slo.get('fast_burn_threshold')}) — "
+                                "the error budget was being spent at "
+                                "page-now rate")
+                        elif s.get("state") == "slow_burn":
+                            rec["warnings"].append(
+                                f"SLO {s.get('name')!r} was slow-burning "
+                                f"(burn {s.get('burn_slow')} over the "
+                                f"{slo.get('window_slow_s')}s window)")
                 if rec["problems"]:
                     rec["status"] = "unhealthy"
 
@@ -1587,6 +1608,36 @@ def _doctor(args):
                                 f"{redisp})")
                 if frec["problems"]:
                     frec["status"] = "unhealthy"
+
+        # flight-recorder dumps: a dump beside the artifacts means the
+        # run hit a postmortem trigger (breaker open, wedge quarantine,
+        # fence-audit failure, SIGTERM) — doctor validates the bundle
+        # parses (the faultinject kill-mid-dump plan drives the torn-file
+        # case) and surfaces the trigger + triggering trace id
+        import glob as _glob
+
+        from mfm_tpu.obs.flightrec import read_flightrec
+        for fr_path in sorted(_glob.glob(
+                os.path.join(man_dir, "flightrec*.json"))):
+            fr_rec = {"file": fr_path, "kind": "flightrec",
+                      "status": "ok", "problems": [], "warnings": []}
+            records.append(fr_rec)
+            try:
+                dump = read_flightrec(fr_path)
+            except (ValueError, OSError) as err:
+                fr_rec["status"] = "corrupt"
+                fr_rec["problems"].append(str(err))
+            else:
+                fr_rec["trigger"] = dump.get("trigger")
+                fr_rec["trace_id"] = dump.get("trace_id")
+                fr_rec["events"] = len(dump.get("events", []))
+                fr_rec["spans"] = len(dump.get("spans", []))
+                fr_rec["warnings"].append(
+                    "flight-recorder dump present (trigger="
+                    f"{dump.get('trigger')!r}, trace_id="
+                    f"{dump.get('trace_id')!r}) — the serve run hit a "
+                    "postmortem trigger; inspect the bundled "
+                    "events/spans/metrics")
 
     # --scenarios: audit the scenario manifest beside the artifacts — a
     # torn write, an embedded spec whose recomputed hash disagrees with
@@ -1891,6 +1942,19 @@ def _serve(args):
     server.generation = int((meta or {}).get("generation") or 0)
     man_dir = os.path.dirname(state_path) or "."
 
+    # SLO engine + flight recorder: every serve process evaluates its own
+    # burn rates at scrape time (the block rides serve summaries into
+    # /healthz, the manifests and doctor --serve), and triggered
+    # postmortem dumps land beside the checkpoint — workers get
+    # per-replica shard names so a fleet on one host never races the
+    # frontend's dump
+    from mfm_tpu.obs import flightrec as _frec
+    from mfm_tpu.obs import slo as _slo
+    _slo.install(_slo.SloEngine())
+    frec_name = (f"flightrec.r{args.worker_id}.json" if args.worker
+                 else _frec.FLIGHTREC_NAME)
+    _frec.arm(os.path.join(man_dir, frec_name))
+
     def _finish(summary: dict, manifest_name: str, extra: dict) -> None:
         manifest = build_run_manifest(
             stamp_json=meta.get("stamp"),
@@ -2051,8 +2115,18 @@ def _serve_fleet(args, server, state_path, man_dir, _finish,
                           "replicas": len(replicas),
                           "http": bool(args.http)}),
               file=sys.stderr, flush=True)
+        def _on_term(*_):
+            # the operator's kill is a postmortem trigger too: dump the
+            # flight recorder BEFORE the drain so the bundle shows what
+            # was in flight when the signal landed
+            from mfm_tpu.obs import flightrec as _frec
+            state = (backend._flightrec_state()
+                     if hasattr(backend, "_flightrec_state") else None)
+            _frec.trigger_dump("sigterm", state=state)
+            fe.stop()
+
         for sig in (signal.SIGINT, signal.SIGTERM):
-            signal.signal(sig, lambda *_: fe.stop())
+            signal.signal(sig, _on_term)
         fe.serve(backend)   # blocks until stop(); drains the backend
     else:
         backend = make_backend()
@@ -2521,10 +2595,70 @@ def _snapshot_scalars(snap: dict) -> dict:
     return out
 
 
+def _fleet_manifest_scalars(man: dict) -> dict | None:
+    """Flatten a merged fleet manifest (or the run manifest embedding
+    one) into diffable series keys, or None when ``man`` is not one.
+    The frontend's own metrics snapshot flattens normally; each replica
+    shard contributes ``r{idx}:``-prefixed series (delivered outcomes +
+    transport counters), so a diff of two fleet runs shows per-worker
+    drift, not just the merged totals."""
+    fm = man.get("fleet")
+    if not isinstance(fm, dict):
+        fm = man if {"replicas", "audit"} <= set(man) else None
+    if fm is None:
+        return None
+    out = {}
+    snap = man.get("metrics")
+    if isinstance(snap, dict) and snap.get("schema") == 1 \
+            and isinstance(snap.get("metrics"), dict):
+        out.update(_snapshot_scalars(snap))
+    out["fleet:accepted_total"] = fm.get("accepted_total")
+    for k, v in (fm.get("transport") or {}).items():
+        out[f"fleet:transport:{k}"] = v
+    for k, v in ((fm.get("frontend_local") or {}).get("outcomes")
+                 or {}).items():
+        out[f"fleet:frontend_local:{k}"] = v
+    for rep in fm.get("replicas") or []:
+        i = rep.get("replica")
+        out[f"r{i}:outcomes_total"] = rep.get("outcomes_total")
+        for k, v in (rep.get("outcomes") or {}).items():
+            out[f"r{i}:outcomes:{k}"] = v
+        tp = rep.get("transport")
+        if isinstance(tp, dict):
+            for k, v in sorted(tp.items()):
+                if isinstance(v, (int, float)):
+                    out[f"r{i}:transport:{k}"] = v
+    return out
+
+
+def _metrics_diff_side(path: str) -> dict:
+    """One side of ``metrics diff``: a metrics snapshot (file or
+    --metrics-dir) or a merged ``fleet_manifest.json`` — the fleet form
+    diffs the frontend snapshot plus per-replica shard series."""
+    p = os.path.join(path, "metrics.json") if os.path.isdir(path) else path
+    if not os.path.exists(p):
+        raise SystemExit(f"{p}: not found — run with --metrics-dir first")
+    try:
+        with open(p, encoding="utf-8") as fh:
+            obj = json.load(fh)
+    except ValueError as err:
+        raise SystemExit(f"{p}: not valid JSON ({err})") from err
+    if isinstance(obj, dict):
+        fleet = _fleet_manifest_scalars(obj)
+        if fleet is not None:
+            return fleet
+        if obj.get("schema") == 1 and isinstance(obj.get("metrics"), dict):
+            return _snapshot_scalars(obj)
+    raise SystemExit(f"{p}: neither a metrics snapshot (schema 1) nor a "
+                     "merged fleet manifest")
+
+
 def _metrics(args):
     """dump: print + parse-validate the Prometheus textfile; snapshot:
     print the validated snapshot JSON; diff: per-series deltas between two
-    snapshots (counters/gauges by value, histograms by count/sum)."""
+    snapshots (counters/gauges by value, histograms by count/sum) —
+    either side may also be a merged fleet manifest, whose replica shards
+    diff as ``r{idx}:``-prefixed series."""
     from mfm_tpu.obs.exporters import parse_prometheus
 
     if args.action == "dump":
@@ -2539,8 +2673,8 @@ def _metrics(args):
                          sort_keys=True))
         return
     # diff
-    a = _snapshot_scalars(_load_metrics_snapshot(args.a))
-    b = _snapshot_scalars(_load_metrics_snapshot(args.b))
+    a = _metrics_diff_side(args.a)
+    b = _metrics_diff_side(args.b)
     delta = {}
     for key in sorted(set(a) | set(b)):
         va, vb = a.get(key), b.get(key)

@@ -224,6 +224,12 @@ class ModuleInfo:
     # otherwise the fleet's Popen.poll() aliases Coalescer.poll and drags
     # the whole transport layer into the jax_touch closure
     external_attrs: dict = dataclasses.field(default_factory=dict)
+    # function qualname -> LOCAL names bound to external handles or builtin
+    # containers (`fh = open(...)`, `with open(...) as fh`, `ev = {...}`):
+    # the local form of the typed-receiver barrier — `fh.flush()` must not
+    # alias Coalescer.flush, `ev.update(...)` must not alias
+    # RiskModel.update
+    external_fn_locals: dict = dataclasses.field(default_factory=dict)
 
 
 class _Scanner(ast.NodeVisitor):
@@ -345,6 +351,52 @@ class _Scanner(ast.NodeVisitor):
         return tgt == "socket" and attr in ("socket", "create_connection",
                                             "socketpair")
 
+    _CONTAINER_CTORS = ("dict", "list", "set", "frozenset", "bytearray")
+
+    def _is_builtin_container(self, value) -> bool:
+        """Dict/list/set displays, comprehensions, and calls to the builtin
+        container constructors — receivers whose methods (update, append,
+        flush-free but get/keys/add/...) can never be package calls."""
+        if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                              ast.ListComp, ast.SetComp)):
+            return True
+        return (isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Name)
+                and value.func.id in self._CONTAINER_CTORS
+                and value.func.id not in self.mod.from_imports)
+
+    def _current_func(self) -> str | None:
+        for i in range(len(self.scope), 0, -1):
+            q = f"{self.mod.name}:{'.'.join(self.scope[:i])}"
+            if q in self.funcs:
+                return q
+        return None
+
+    def _note_local_binding(self, name: str, external: bool) -> None:
+        """Track (or, on rebind to anything else, untrack) a function-local
+        name bound to an external handle / builtin container."""
+        qual = self._current_func()
+        if qual is None:
+            return
+        bound = self.mod.external_fn_locals.setdefault(qual, set())
+        if external:
+            bound.add(name)
+        else:
+            bound.discard(name)
+
+    def visit_With(self, node):
+        # `with open(tmp) as fh:` — the canonical atomic-writer idiom;
+        # fh.flush()/fh.write() are OS-handle I/O, never package calls
+        for item in node.items:
+            if isinstance(item.optional_vars, ast.Name):
+                self._note_local_binding(
+                    item.optional_vars.id,
+                    isinstance(item.context_expr, ast.Call)
+                    and self._is_external_handle_ctor(item.context_expr))
+        self.generic_visit(node)
+
+    visit_AsyncWith = visit_With
+
     def visit_Assign(self, node):
         # `phase1 = lambda ...` binds a function to a name: register the
         # lambda under that name so `jax.vmap(phase1)` resolves to it
@@ -368,6 +420,14 @@ class _Scanner(ast.NodeVisitor):
                         and t.value.id == "self":
                     self.mod.external_attrs.setdefault(
                         self.class_stack[-1], set()).add(t.attr)
+        # `fh = open(...)` / `ev = {...}`: the local form of the same
+        # barrier (rebinding to anything else untracks the name)
+        external = (isinstance(node.value, ast.Call)
+                    and self._is_external_handle_ctor(node.value)) \
+            or self._is_builtin_container(node.value)
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                self._note_local_binding(t.id, external)
         self.generic_visit(node)
 
     def visit_AnnAssign(self, node):
@@ -376,6 +436,12 @@ class _Scanner(ast.NodeVisitor):
             for v in node.value.values:
                 if isinstance(v, ast.Name):
                     self.mod.registry_names.add(v.id)
+        if node.value is not None and isinstance(node.target, ast.Name):
+            self._note_local_binding(
+                node.target.id,
+                (isinstance(node.value, ast.Call)
+                 and self._is_external_handle_ctor(node.value))
+                or self._is_builtin_container(node.value))
         self.generic_visit(node)
 
     def visit_Call(self, node):
@@ -510,6 +576,15 @@ class Linter:
             ext = mod.external_attrs.get(cls_name)
             if ext and chain[1] in ext:
                 return []
+        # ... and its local form: `fh.flush()` / `ev.update(...)` where the
+        # receiver was bound in this function (or an enclosing one) to an
+        # open()/Popen/socket handle or a builtin container literal
+        p = caller.qualname
+        while p is not None:
+            if root in mod.external_fn_locals.get(p, ()):
+                return []
+            info = self.funcs.get(p)
+            p = info.parent if info is not None else None
         # bare-name over-approximation: any def in the lint set with this name
         return list(self.bare_index.get(attr, []))
 

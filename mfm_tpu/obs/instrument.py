@@ -91,6 +91,45 @@ TRACE_DROPPED_TOTAL = REGISTRY.counter(
     "mfm_trace_dropped_total",
     "oldest spans evicted by ring-buffer overflow (trace is lossy past "
     "capacity, but counted)")
+TRACE_FOREIGN_SPANS_TOTAL = REGISTRY.counter(
+    "mfm_trace_foreign_spans_total",
+    "worker spans merged into this process's ring off the fleet wire "
+    "(clock-offset corrected before insertion)")
+TRACE_SKEW_UNCORRECTABLE_TOTAL = REGISTRY.counter(
+    "mfm_trace_skew_uncorrectable_total",
+    "merged foreign spans whose corrected timeline still fell outside "
+    "the dispatch window beyond the offset uncertainty (flagged "
+    "clock_skew=uncorrectable on the span, never reordered)")
+
+# -- flight recorder (obs/flightrec.py postmortem ring) -----------------------
+
+FLIGHTREC_EVENTS_TOTAL = REGISTRY.counter(
+    "mfm_flightrec_events_total",
+    "events recorded to the flight-recorder ring")
+FLIGHTREC_DROPPED_TOTAL = REGISTRY.counter(
+    "mfm_flightrec_dropped_total",
+    "oldest flight-recorder events evicted by ring overflow")
+FLIGHTREC_DUMPS_TOTAL = REGISTRY.counter(
+    "mfm_flightrec_dumps_total",
+    "atomic flightrec.json dumps by trigger",
+    labelnames=("trigger",))   # breaker_open | wedge_quarantine |
+#                                fence_audit | sigterm | manual
+
+# -- SLO burn-rate engine (obs/slo.py) ----------------------------------------
+
+SLO_BURN_RATE = REGISTRY.gauge(
+    "mfm_slo_burn_rate",
+    "error-budget burn rate per SLO and window (1.0 = burning exactly "
+    "the budget; fast window trips paging, slow window trips tickets)",
+    labelnames=("slo", "window"))   # window: fast | slow
+SLO_STATE = REGISTRY.gauge(
+    "mfm_slo_state",
+    "SLO alert state (0 ok, 1 slow_burn, 2 fast_burn)",
+    labelnames=("slo",))
+SLO_BREACHES_TOTAL = REGISTRY.counter(
+    "mfm_slo_breaches_total",
+    "evaluations that found an SLO in a burning state",
+    labelnames=("slo", "state"))
 
 # -- query service (serve/server.py request loop) -----------------------------
 
@@ -338,6 +377,37 @@ def unwatch_compiles() -> None:
     _COMPILE_WATCHER = None
 
 
+def record_foreign_spans(n: int, uncorrectable: int = 0) -> None:
+    """Tally one fleet-wire span merge: spans ingested + how many were
+    flagged with uncorrectable clock skew."""
+    if n:
+        TRACE_FOREIGN_SPANS_TOTAL.inc(int(n))
+    if uncorrectable:
+        TRACE_SKEW_UNCORRECTABLE_TOTAL.inc(int(uncorrectable))
+
+
+def record_flightrec_event(n: int = 1, dropped: int = 0) -> None:
+    FLIGHTREC_EVENTS_TOTAL.inc(int(n))
+    if dropped:
+        FLIGHTREC_DROPPED_TOTAL.inc(int(dropped))
+
+
+def record_flightrec_dump(trigger: str) -> None:
+    FLIGHTREC_DUMPS_TOTAL.inc(1, trigger=str(trigger))
+
+
+def record_slo_state(slo: str, state: str, burn_fast: float,
+                     burn_slow: float) -> None:
+    """Mirror one SLO evaluation onto the gauges; a burning state also
+    tallies ``mfm_slo_breaches_total``."""
+    SLO_BURN_RATE.set_value(float(burn_fast), slo=slo, window="fast")
+    SLO_BURN_RATE.set_value(float(burn_slow), slo=slo, window="slow")
+    SLO_STATE.set_value(
+        {"ok": 0, "slow_burn": 1, "fast_burn": 2}.get(state, 0), slo=slo)
+    if state != "ok":
+        SLO_BREACHES_TOTAL.inc(1, slo=slo, state=state)
+
+
 def record_query_outcome(outcome: str, n: int = 1) -> None:
     QUERY_REQUESTS_TOTAL.inc(n, outcome=outcome)
 
@@ -382,7 +452,7 @@ def serve_summary_from_registry() -> dict:
     state_code = int(BREAKER_STATE.value())
     p50 = QUERY_LATENCY_SECONDS.quantile_est(0.5)
     p99 = QUERY_LATENCY_SECONDS.quantile_est(0.99)
-    return {
+    out = {
         "requests": outcomes,
         "requests_total": total,
         "portfolios_total": int(QUERY_PORTFOLIOS_TOTAL.value()),
@@ -394,6 +464,15 @@ def serve_summary_from_registry() -> dict:
         "query_p99_latency_s": (None if p99 != p99 else round(p99, 6)),
         "cache": cache_summary_from_registry(),
     }
+    # the SLO block rides along whenever an engine is installed (the
+    # serve CLI installs one): /healthz, the serve/fleet manifests and
+    # doctor --serve all read the same evaluation.  Deferred import —
+    # obs/slo.py reads THIS module's catalog.
+    from mfm_tpu.obs import slo as _slo
+    slo_block = _slo.installed_summary()
+    if slo_block is not None:
+        out["slo"] = slo_block
+    return out
 
 
 def record_coalesce_flush(n_true: int, capacity: int, trigger: str,

@@ -41,7 +41,11 @@ import os
 import threading
 import time
 
-from mfm_tpu.obs.instrument import TRACE_DROPPED_TOTAL, TRACE_SPANS_TOTAL
+from mfm_tpu.obs.instrument import (
+    TRACE_DROPPED_TOTAL,
+    TRACE_SPANS_TOTAL,
+    record_foreign_spans,
+)
 from mfm_tpu.utils.chaos import chaos_point
 
 #: default ring capacity — ~1 MB of spans; a serve storm overflows it by
@@ -205,6 +209,109 @@ def reset_tracing() -> None:
         _ring.clear()
         _capacity = DEFAULT_RING_CAPACITY
     _tls.stack = []
+
+
+# -- fleet-wire span merge ----------------------------------------------------
+#
+# A fleet worker's spans live in ITS process ring; these helpers move them
+# across the ``__fleet__`` wire and into the frontend's ring so one Chrome
+# trace shows the whole request timeline.  Worker clocks are perf_counter
+# clocks with arbitrary epochs, so every merged span is shifted by a
+# per-worker offset estimated from heartbeat RTT midpoints; the offset and
+# its uncertainty (half the RTT) are stamped on the span, and a span whose
+# corrected timeline still falls outside the frontend's observed dispatch
+# window beyond that uncertainty is flagged ``clock_skew="uncorrectable"``
+# — flagged, never reordered or clamped.
+
+#: wire-form span fields (the JSONL payload piggybacked on flushed/pong
+#: replies); ``tid`` rides along so per-thread lanes survive the merge
+_WIRE_FIELDS = ("name", "trace_id", "span_id", "parent_id", "start_us",
+                "dur_us", "wall_ts", "tid")
+
+
+def wire_span(sp: Span) -> dict:
+    """One finished span as a JSON-safe wire dict."""
+    d = {k: getattr(sp, k) for k in _WIRE_FIELDS}
+    d["attrs"] = dict(sp.attrs)
+    return d
+
+
+def drain_spans() -> list:
+    """Destructively pop every finished span off the ring, in order, as
+    wire dicts — the worker side of the piggyback (spans ship once, on
+    the next flushed/pong reply, and stop occupying worker memory)."""
+    with _lock:
+        out = list(_ring)
+        _ring.clear()
+    return [wire_span(s) for s in out]
+
+
+def clock_offset_from_probe(t0_s: float, t1_s: float,
+                            peer_clock_us: float) -> tuple:
+    """``(offset_us, uncertainty_us)`` from one probe round trip: the
+    peer stamped ``peer_clock_us`` (its perf_counter, µs) somewhere
+    between our send (``t0_s``) and receive (``t1_s``) perf_counter
+    stamps.  The midpoint is the minimum-error estimate; half the RTT
+    bounds the error.  Adding ``offset_us`` to a LOCAL timestamp maps it
+    onto the peer's clock — so subtract it from peer timestamps (which
+    is what :func:`ingest_foreign_spans` expects as its ``offset_us``,
+    negated by the caller)."""
+    mid_us = (float(t0_s) + float(t1_s)) / 2.0 * 1e6
+    rtt_us = max(0.0, (float(t1_s) - float(t0_s)) * 1e6)
+    return float(peer_clock_us) - mid_us, rtt_us / 2.0
+
+
+def ingest_foreign_spans(span_dicts, *, offset_us: float = 0.0,
+                         uncertainty_us: float = 0.0, window_us=None,
+                         worker=None) -> list:
+    """Merge wire-form spans from another process into this ring.
+
+    ``offset_us`` is ADDED to each span's ``start_us`` to map it onto
+    this process's perf_counter clock (callers that estimated
+    ``peer - local`` via :func:`clock_offset_from_probe` pass the
+    NEGATED estimate).  Every merged span is stamped with the correction
+    (``clock_offset_us``/``clock_uncertainty_us`` and ``worker``), and a
+    span whose corrected extent lies outside ``window_us`` (a local
+    ``(lo_us, hi_us)`` bracket around the exchange that produced it) by
+    more than the uncertainty is flagged ``clock_skew="uncorrectable"``
+    — the timeline is preserved as corrected, never reordered.  Returns
+    the ingested spans (empty when tracing is disabled)."""
+    if not _enabled:
+        return []
+    out = []
+    n_skew = 0
+    for d in span_dicts or ():
+        if not isinstance(d, dict) or not d.get("name"):
+            continue
+        try:
+            start = float(d["start_us"]) + float(offset_us)
+            dur = float(d.get("dur_us") or 0.0)
+        except (KeyError, TypeError, ValueError):
+            continue
+        attrs = dict(d.get("attrs") or {})
+        attrs["clock_offset_us"] = round(float(offset_us), 3)
+        attrs["clock_uncertainty_us"] = round(float(uncertainty_us), 3)
+        if worker is not None:
+            attrs["worker"] = worker
+        if window_us is not None:
+            lo, hi = float(window_us[0]), float(window_us[1])
+            slack = max(0.0, float(uncertainty_us))
+            if start < lo - slack or start + dur > hi + slack:
+                attrs["clock_skew"] = "uncorrectable"
+                n_skew += 1
+        sp = Span(str(d["name"]), str(d.get("trace_id")),
+                  str(d.get("span_id") or new_span_id()),
+                  d.get("parent_id"), start,
+                  d.get("wall_ts"), int(d.get("tid") or 0), attrs)
+        sp.dur_us = dur
+        out.append(sp)
+    if out:
+        TRACE_SPANS_TOTAL.inc(len(out))
+        record_foreign_spans(len(out), n_skew)
+        with _lock:
+            _ring.extend(out)
+            _evict_locked()
+    return out
 
 
 # -- Chrome trace-event export ------------------------------------------------

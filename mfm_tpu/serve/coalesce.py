@@ -39,6 +39,7 @@ import time
 from typing import Callable
 
 from mfm_tpu.obs import instrument as _obs
+from mfm_tpu.obs import trace as _trace
 from mfm_tpu.serve.cache import CacheFill
 from mfm_tpu.serve.query import bucket_for
 
@@ -139,6 +140,12 @@ class Coalescer:
             if self.server.breaker.state == "closed":
                 resp, token = self.cache.lookup(line)
                 if resp is not None:
+                    if _trace.tracing_enabled():
+                        # a hit never opens a serve.request span — this
+                        # child marks the short-circuit on the timeline
+                        _trace.end_span(_trace.start_span(
+                            "cache.hit", trace_id=resp.get("trace_id"),
+                            request_id=resp.get("id")))
                     with self._lock:
                         return self._emit([(origin, resp)])
                 if token is not None:

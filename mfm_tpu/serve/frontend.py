@@ -301,18 +301,26 @@ class SocketFrontend:
                     self._http_reply(conn, 200, payload,
                                      "application/json")
                 elif method == "GET" and path == "/metrics":
+                    from mfm_tpu.obs import slo as _slo
                     from mfm_tpu.obs.metrics import snapshot_json
+
+                    # evaluate BEFORE the snapshot so the burn gauges in
+                    # it are current; the structured block rides beside
+                    slo_block = _slo.installed_summary()
                     body = snapshot_json()
                     shards = self._fleet_shards()
-                    if shards is not None:
+                    if shards is not None or slo_block is not None:
                         snap = json.loads(body)
-                        snap["workers"] = [
-                            {"replica": s["replica"],
-                             "host": s.get("host"),
-                             "alive": s["alive"],
-                             "metrics": s.get("metrics"),
-                             "transport": s.get("transport")}
-                            for s in shards]
+                        if slo_block is not None:
+                            snap["slo"] = slo_block
+                        if shards is not None:
+                            snap["workers"] = [
+                                {"replica": s["replica"],
+                                 "host": s.get("host"),
+                                 "alive": s["alive"],
+                                 "metrics": s.get("metrics"),
+                                 "transport": s.get("transport")}
+                                for s in shards]
                         body = json.dumps(snap, sort_keys=True)
                     self._http_reply(conn, 200, body,
                                      "application/json")

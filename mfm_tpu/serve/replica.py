@@ -28,7 +28,12 @@ object is exactly ``{"__fleet__": ...}``, so a client can never spoof a
 flush or shift response ordinals):
 
 - frontend -> worker: admitted request lines verbatim, then
-  ``{"__fleet__": "flush"}`` to drain the batch.
+  ``{"__fleet__": "flush"}`` to drain the batch.  A tracing frontend
+  precedes the lines with ONE structured prologue frame
+  ``{"__fleet__": {"op": "batch", "trace": {...}}}`` carrying the
+  dispatch span and each line's parent span id; the prologue consumes no
+  seq ordinal and an unknown ``op`` is ignored, so the frame is invisible
+  to response routing and to older workers alike.
 - worker -> frontend: one envelope ``{"seq": i, "resp": {...}}`` per line
   (``seq`` = the line's ordinal within the current batch — request ids
   need not be unique, ordinals are), then
@@ -39,6 +44,15 @@ flush or shift response ordinals):
   scrape-time observability shard ``/metrics`` and ``/healthz`` merge),
   and ``"reload"`` -> re-fence now and report
   ``{"ok": ..., "generation": ...}`` (the rolling-rollout step).
+- piggyback: ``flushed``, ``pong`` and ``reloaded`` replies also carry
+  ``clock_us`` (the worker's perf_counter stamp, for RTT-midpoint
+  clock-offset estimation) and, when tracing is on, ``spans`` — the
+  worker's finished spans in wire form, drained once and merged into the
+  frontend ring shifted onto its clock
+  (:func:`mfm_tpu.obs.trace.ingest_foreign_spans`), so ONE Chrome trace
+  shows the whole request timeline across processes.  Response bodies
+  are untouched: the extra keys ride only on control replies, so fleet
+  responses stay bitwise-identical per request id.
 
 Failure semantics
 -----------------
@@ -88,6 +102,7 @@ import os
 import subprocess
 import time
 
+from mfm_tpu.obs import flightrec as _frec
 from mfm_tpu.obs import instrument as _obs
 from mfm_tpu.obs import trace as _trace
 from mfm_tpu.serve.coalesce import Coalescer
@@ -173,6 +188,17 @@ def run_worker(server, in_fp, out_fp, *, poll_on_flush: bool = True) -> dict:
         out_fp.write(json.dumps(obj, sort_keys=True) + "\n")
         flush_out()
 
+    def piggyback(frame):
+        # completed spans ride back on control replies so the frontend
+        # can merge them into one timeline; clock_us lets it estimate
+        # this process's perf_counter offset from the probe RTT
+        frame["clock_us"] = time.perf_counter() * 1e6
+        if _trace.tracing_enabled():
+            shipped = _trace.drain_spans()
+            if shipped:
+                frame["spans"] = shipped
+        return frame
+
     # Immediate responses (worker-side rejections, shed notices) BUFFER
     # until the flush control: the front end writes its whole batch before
     # it starts reading, so a worker that wrote envelopes mid-batch could
@@ -183,6 +209,7 @@ def run_worker(server, in_fp, out_fp, *, poll_on_flush: bool = True) -> dict:
     # discipline: one frame in, one frame out, frontend reads immediately.
     seq = 0
     held: list = []
+    trace_ctx: dict | None = None
     for line in in_fp:
         line = line.strip()
         if not line:
@@ -190,34 +217,72 @@ def run_worker(server, in_fp, out_fp, *, poll_on_flush: bool = True) -> dict:
         ctl = _control_frame(line)
         if ctl is not None:
             kind = ctl[CONTROL_KEY]
+            if isinstance(kind, dict):
+                # structured control frame: op dispatch.  Today's only op
+                # is the trace-context prologue a tracing frontend sends
+                # before its batch lines; unknown ops are ignored, not
+                # fatal, so an older worker survives a newer frontend.
+                if kind.get("op") == "batch":
+                    tr = kind.get("trace")
+                    trace_ctx = tr if isinstance(tr, dict) else {}
+                continue
             if kind == "flush":
                 n_batch = seq
+                bsp = None
+                if _trace.tracing_enabled():
+                    ref = (trace_ctx or {}).get("dispatch") or []
+                    bsp = _trace.start_span(
+                        "worker.batch",
+                        trace_id=(ref[0] if len(ref) > 0 else None),
+                        parent_id=(ref[1] if len(ref) > 1 else None),
+                        n=n_batch)
                 emit(held)
                 held = []
                 if poll_on_flush:
                     server.poll_reload()
                 while server._queue:
                     emit(server.drain_routed())
-                reply({CONTROL_KEY: "flushed", "n": n_batch})
+                if bsp is not None:
+                    _trace.end_span(bsp)
+                trace_ctx = None
+                reply(piggyback({CONTROL_KEY: "flushed", "n": n_batch}))
                 seq = 0   # seq is an ordinal WITHIN a batch
             elif kind == "ping":
-                reply({CONTROL_KEY: "pong"})
+                reply(piggyback({CONTROL_KEY: "pong"}))
             elif kind == "metrics":
                 from mfm_tpu.obs.metrics import REGISTRY
                 reply({CONTROL_KEY: "metrics",
                        "summary": _obs.serve_summary_from_registry(),
                        "metrics": REGISTRY.snapshot()})
             elif kind == "reload":
+                rsp = (_trace.start_span("worker.reload_fence")
+                       if _trace.tracing_enabled() else None)
                 server.poll_reload()
                 # a reload that failed its fence audit force-opened the
                 # breaker; report it so the frontend quarantines us
                 # instead of shipping batches that would all reject
                 ok = not (server.breaker.state == "open"
                           and server.breaker.open_reason == "fence_audit")
-                reply({CONTROL_KEY: "reloaded", "ok": ok,
-                       "generation": server.generation})
+                if rsp is not None:
+                    _trace.end_span(rsp, ok=ok,
+                                    generation=server.generation)
+                reply(piggyback({CONTROL_KEY: "reloaded", "ok": ok,
+                                 "generation": server.generation}))
             continue
+        rsp = None
+        if _trace.tracing_enabled() and trace_ctx is not None:
+            parents = trace_ctx.get("parents") or []
+            par = parents[seq] if seq < len(parents) else None
+            if par:
+                # the frontend's serve.request span for this ordinal is
+                # the parent; the trace id matches its sha-derived one,
+                # so the two processes' spans join in one timeline
+                rsp = _trace.start_span(
+                    "worker.recv", trace_id=par[0], parent_id=par[1],
+                    seq=seq)
         held.extend(server.submit_line_routed(line, origin=seq))
+        if rsp is not None:
+            _trace.end_span(rsp)
         seq += 1
     # EOF: drain the tail (a frontend that closes our stdin without a
     # final flush still gets every admitted request answered)
@@ -235,6 +300,10 @@ def run_worker(server, in_fp, out_fp, *, poll_on_flush: bool = True) -> dict:
 
 class Replica:
     """One worker (spawned subprocess or remote TCP peer) + its ledger."""
+
+    #: capability flag the dispatcher checks before prepending a trace
+    #: prologue frame to a batch (test stubs lack it -> plain batches)
+    accepts_trace_frames = True
 
     def __init__(self, idx: int, cmd: list, env: dict | None = None, *,
                  io_timeout_s: float = DEFAULT_IO_TIMEOUT_S):
@@ -289,6 +358,11 @@ class Replica:
         self.heartbeat_misses = 0
         #: requests re-dispatched AWAY from this replica after it failed
         self.redispatches = 0
+        #: perf_counter offset estimate (peer - local, µs) and half-RTT
+        #: uncertainty, refreshed whenever a tighter probe lands; spans
+        #: the worker ships are corrected by the negated offset
+        self.clock_offset_us: float | None = None
+        self.clock_uncertainty_us: float = 0.0
 
     @property
     def alive(self) -> bool:
@@ -329,6 +403,28 @@ class Replica:
 
     # -- the wire calls (all I/O deadline-bearing; mfmsync: these run
     # under the coalescer lock, two levels above the raw fd waits) -----------
+    def _absorb_reply_telemetry(self, obj: dict, t0: float,
+                                t1: float) -> None:
+        """Fold a control reply's piggyback into local state: refresh the
+        clock-offset estimate when this probe bounds it at least as tight
+        as the current one (ping RTTs beat batch walls), then merge any
+        shipped spans into the local ring shifted by the NEGATED offset
+        (the probe measures peer - local) with the exchange bracket
+        ``(t0, t1)`` as the skew-sanity window."""
+        clock = obj.get("clock_us")
+        if isinstance(clock, (int, float)):
+            off, unc = _trace.clock_offset_from_probe(t0, t1, float(clock))
+            if (self.clock_offset_us is None
+                    or unc <= self.clock_uncertainty_us):
+                self.clock_offset_us = off
+                self.clock_uncertainty_us = unc
+        shipped = obj.get("spans")
+        if shipped:
+            _trace.ingest_foreign_spans(
+                shipped, offset_us=-(self.clock_offset_us or 0.0),
+                uncertainty_us=self.clock_uncertainty_us,
+                window_us=(t0 * 1e6, t1 * 1e6), worker=self.idx)
+
     def run_batch(self, lines: list) -> dict:
         """Send one batch + flush, collect the envelopes.  Returns
         ``{seq: resp}``; raises :class:`ReplicaDeadError` /
@@ -343,17 +439,23 @@ class Replica:
         while True:
             obj = self._recv_obj(None, "mid-batch")
             if obj.get(CONTROL_KEY) == "flushed":
+                flushed = obj
                 break
             resps[int(obj["seq"])] = obj["resp"]
-        wall = time.monotonic() - t0
+        t1 = time.monotonic()
+        wall = t1 - t0
         self.ewma_wall = (wall if self.ewma_wall is None
                           else EWMA_ALPHA * wall
                           + (1.0 - EWMA_ALPHA) * self.ewma_wall)
-        self.last_io_t = time.monotonic()
+        self.last_io_t = t1
+        self._absorb_reply_telemetry(flushed, t0, t1)
         return resps
 
     def ping(self, timeout_s: float | None = None) -> None:
-        """One heartbeat round trip; a miss marks this replica wedged."""
+        """One heartbeat round trip; a miss marks this replica wedged.
+        Doubling as the clock probe: the pong's ``clock_us`` against the
+        tight ping RTT is the best offset estimate this replica gets."""
+        t0 = time.monotonic()
         try:
             self.transport.send_frame({CONTROL_KEY: "ping"})
             raw = self.transport.recv_line(timeout_s)
@@ -371,7 +473,9 @@ class Replica:
             raise self._gone("torn heartbeat reply") from e
         if obj.get(CONTROL_KEY) != "pong":
             raise self._gone(f"bad heartbeat reply {raw[:64]!r}")
-        self.last_io_t = time.monotonic()
+        t1 = time.monotonic()
+        self.last_io_t = t1
+        self._absorb_reply_telemetry(obj, t0, t1)
 
     def scrape(self, timeout_s: float | None = None) -> dict:
         """Live observability shard: the worker's serve summary + metrics
@@ -387,12 +491,15 @@ class Replica:
     def reload_worker(self, timeout_s: float | None = None) -> dict:
         """One rolling-rollout step: tell the worker to re-fence NOW and
         report ``{"ok": ..., "generation": ...}``."""
+        t0 = time.monotonic()
         try:
             self.transport.send_frame({CONTROL_KEY: "reload"})
         except TransportError as e:
             raise self._transport_failed(e) from e
         obj = self._recv_obj(timeout_s, "on reload")
-        self.last_io_t = time.monotonic()
+        t1 = time.monotonic()
+        self.last_io_t = t1
+        self._absorb_reply_telemetry(obj, t0, t1)
         return obj
 
     def transport_counters(self) -> dict:
@@ -549,6 +656,11 @@ class FleetServer(Coalescer):
                 # is already rejecting (breaker open) — drain it out
                 w.quarantined = True
                 _obs.record_replica_quarantine()
+                _frec.record_event("fence_audit_quarantine",
+                                   replica=w.idx, generation=gen,
+                                   during="rollout")
+                _frec.trigger_dump("fence_audit",
+                                   state=self._flightrec_state())
                 continue
             if rep.get("generation") not in (None, gen):
                 # pointer moved again mid-roll; re-roll next flush
@@ -662,29 +774,86 @@ class FleetServer(Coalescer):
             {"id": r.rid, "ok": False, "outcome": "deadline"},
             scenario_id=r.scenario, trace_id=r.trace_id))
 
+    def _flightrec_state(self) -> dict:
+        """The live-context block a triggered flight-recorder dump
+        bundles: breaker, rollout generation, per-replica ledgers."""
+        b = self.server.breaker
+        return {
+            "breaker": {"state": b.state, "open_reason": b.open_reason},
+            "fleet_generation": self._fleet_generation,
+            "accepted_total": self.accepted_total,
+            "replicas": [
+                {"replica": w.idx, "host": getattr(w, "host", "local"),
+                 "alive": bool(getattr(w, "alive", True)),
+                 "quarantined": bool(getattr(w, "quarantined", False)),
+                 "wedged": bool(getattr(w, "wedged", False)),
+                 "dead": bool(getattr(w, "dead", False)),
+                 "delivered_total": sum(getattr(w, "delivered",
+                                                {}).values())}
+                for w in self.replicas],
+        }
+
     def _dispatch(self, batch: list) -> list:
         lines = [r.line for r in batch]
+        head = batch[0]
         while True:
             w = self._next_replica()
             if w is None:
+                _frec.record_event("fleet_outage", trace_id=head.trace_id,
+                                   n=len(lines))
                 return [self._local_error(r, "no healthy replicas")
                         for r in batch]
             if not self._heartbeat_ok(w):
                 continue   # quarantined before the batch left — no loss
             _obs.record_fleet_dispatch(w.idx, len(lines))
+            _frec.record_event("dispatch", trace_id=head.trace_id,
+                               replica=w.idx, n=len(lines))
+            dsp = None
+            wire = lines
+            if _trace.tracing_enabled():
+                # the dispatch span is the worker.batch span's parent;
+                # each request's admission span parents its worker.recv
+                dsp = _trace.start_span(
+                    "fleet.dispatch", trace_id=head.trace_id,
+                    parent_id=(head.span.span_id
+                               if head.span is not None else None),
+                    replica=w.idx, n=len(lines))
+                if getattr(w, "accepts_trace_frames", False):
+                    payload = {"op": "batch", "trace": {
+                        "dispatch": [dsp.trace_id, dsp.span_id],
+                        "parents": [
+                            [r.trace_id,
+                             (r.span.span_id if r.span is not None
+                              else None)]
+                            for r in batch]}}
+                    wire = [json.dumps({CONTROL_KEY: payload},
+                                       sort_keys=True)] + lines
             try:
-                resps = w.run_batch(lines)
+                resps = w.run_batch(wire)
             except ReplicaWedgedError:
                 # alive-but-frozen mid-batch: quarantine exactly like a
                 # death and re-dispatch; close() kills it at shutdown
                 w.redispatches = getattr(w, "redispatches", 0) + len(lines)
                 _obs.record_replica_quarantine()
                 _obs.record_fleet_redispatch(len(lines))
+                if dsp is not None:
+                    _trace.end_span(dsp, outcome="wedged")
+                _frec.record_event("wedge_quarantine",
+                                   trace_id=head.trace_id, replica=w.idx,
+                                   n=len(lines))
+                _frec.trigger_dump("wedge_quarantine",
+                                   trace_id=head.trace_id,
+                                   state=self._flightrec_state())
                 continue
             except ReplicaDeadError:
                 w.redispatches = getattr(w, "redispatches", 0) + len(lines)
                 _obs.record_replica_death()
                 _obs.record_fleet_redispatch(len(lines))
+                if dsp is not None:
+                    _trace.end_span(dsp, outcome="dead")
+                _frec.record_event("replica_death",
+                                   trace_id=head.trace_id, replica=w.idx,
+                                   n=len(lines))
                 continue
             if (len(resps) == len(lines) and resps and
                     all(isinstance(v, dict)
@@ -697,7 +866,16 @@ class FleetServer(Coalescer):
                 w.redispatches = getattr(w, "redispatches", 0) + len(lines)
                 _obs.record_replica_quarantine()
                 _obs.record_fleet_redispatch(len(lines))
+                if dsp is not None:
+                    _trace.end_span(dsp, outcome="fence_audit")
+                _frec.record_event("fence_audit_quarantine",
+                                   trace_id=head.trace_id, replica=w.idx,
+                                   n=len(lines))
+                _frec.trigger_dump("fence_audit", trace_id=head.trace_id,
+                                   state=self._flightrec_state())
                 continue
+            if dsp is not None:
+                _trace.end_span(dsp, outcome="ok")
             pairs = []
             for i, r in enumerate(batch):
                 resp = resps.get(i)
@@ -805,9 +983,14 @@ def build_fleet_manifest(frontend_summary: dict, fleet,
     accepted = int(fleet.accepted_total)
     local = dict(sorted(getattr(fleet, "local_delivered", {}).items()))
     local_total = sum(local.values())
+    slo = (frontend_summary.get("slo")
+           if isinstance(frontend_summary, dict) else None)
     return {
         "schema": 1,
         "frontend": frontend_summary,
+        "slo": slo,
+        "flightrec": {"armed": _frec.armed_path() is not None,
+                      "events": len(_frec.events())},
         "accepted_total": accepted,
         "replicas": reps,
         "transport": totals,

@@ -43,6 +43,7 @@ from typing import Callable
 
 import numpy as np
 
+from mfm_tpu.obs import flightrec as _frec
 from mfm_tpu.obs import instrument as _obs
 from mfm_tpu.obs import trace as _trace
 from mfm_tpu.serve._checks import combine_reason_bits, mad_outlier_cells, \
@@ -228,18 +229,32 @@ class CircuitBreaker:
     def record_failure(self) -> None:
         with self._lock:
             self._consecutive += 1
-            if self._state == "half_open" or \
-                    self._consecutive >= self._threshold:
-                self.force_open("failures")
+            trip = (self._state == "half_open"
+                    or self._consecutive >= self._threshold)
+        if trip:
+            # force_open runs OUTSIDE this frame's lock hold so its
+            # flight-recorder dump (file I/O, registry/ring locks) never
+            # happens under the breaker lock; the RLock makes the nested
+            # call safe but mfmsync S3 (blocking under lock) would not be
+            self.force_open("failures")
 
     def force_open(self, reason: str) -> None:
         with self._lock:
+            was_open = self._state == "open"
             self._consecutive = 0
             self._opened_at = self._clock()
             self.open_reason = reason
             # re-arm the cooldown even if already open (repeated force_open
             # keeps rejecting); only a transition tallies breaker_open_total
             self._to("open")
+        if not was_open:
+            # postmortem on the TRANSITION only (a breaker that stays
+            # open re-arms without re-dumping): the ring's newest
+            # trace-stamped event — the batch_error that tripped us —
+            # becomes the dump's triggering trace id
+            _frec.record_event("breaker_open", reason=reason)
+            _frec.trigger_dump("breaker_open", state={
+                "breaker": {"state": "open", "open_reason": reason}})
 
     def retry_after(self) -> float:
         with self._lock:
@@ -809,6 +824,11 @@ class QueryServer:
             res = engine.query(W, bench=bench)
         except Exception as e:   # noqa: BLE001 — any batch failure trips
             _trace.end_span(bsp, outcome="error")
+            # event BEFORE record_failure: if this failure trips the
+            # breaker, the dump's triggering trace id is this batch's
+            _frec.record_event("batch_error", trace_id=head.trace_id,
+                               kind_of="query", scenario=scen, n=len(grp),
+                               detail=str(e)[:200])
             self.breaker.record_failure()
             for r in grp:
                 _obs.record_query_outcome("error")
@@ -908,6 +928,9 @@ class QueryServer:
                                   res["diag"][i], True)
         except Exception as e:   # noqa: BLE001 — any batch failure trips
             _trace.end_span(bsp, outcome="error")
+            _frec.record_event("batch_error", trace_id=head.trace_id,
+                               kind_of="construct", scenario=scen,
+                               n=len(grp), detail=str(e)[:200])
             self.breaker.record_failure()
             for r in grp:
                 _obs.record_query_outcome("error")
@@ -1006,6 +1029,9 @@ class QueryServer:
                     results[id(r)] = (res.books[i], res.counts, res.sampler)
         except Exception as e:   # noqa: BLE001 — any batch failure trips
             _trace.end_span(bsp, outcome="error")
+            _frec.record_event("batch_error", trace_id=head.trace_id,
+                               kind_of="sweep", scenario=scen,
+                               n=len(grp), detail=str(e)[:200])
             self.breaker.record_failure()
             for r in grp:
                 _obs.record_query_outcome("error")
@@ -1088,6 +1114,12 @@ class QueryServer:
             if cache is not None and self.breaker.state == "closed":
                 resp, token = cache.lookup(line)
                 if resp is not None:
+                    if _trace.tracing_enabled():
+                        # a hit never opens a serve.request span — this
+                        # child marks the short-circuit on the timeline
+                        _trace.end_span(_trace.start_span(
+                            "cache.hit", trace_id=resp.get("trace_id"),
+                            request_id=resp.get("id")))
                     emit([(None, resp)])
                     continue
                 if token is not None:

@@ -58,6 +58,9 @@ CRASH_POINTS = (
                                    # not yet renamed (grad/report.py)
     "sweep_manifest.after_tmp",    # streaming sweep done, sweep_manifest
                                    # tmp not yet renamed (scenario/sweep.py)
+    "flightrec.after_tmp",         # flight-recorder dump: tmp durable, final
+                                   # flightrec.json not yet renamed
+                                   # (obs/flightrec.py)
 )
 
 
@@ -211,7 +214,7 @@ class FaultPlan:
                      # query_swap | query_steady | scenario_kill |
                      # scenario_poison | trace_kill | eigen_kill |
                      # shard_kill | grad_kill | fleet_kill |
-                     # fleet_kill_host | fleet_wedge
+                     # fleet_kill_host | fleet_wedge | flightrec_kill
     seed: int = 0
     params: tuple = ()   # ((key, value), ...) — hashable, printable
 
@@ -351,4 +354,10 @@ def plan_suite(seed: int = 0) -> tuple:
         # unbalancing the audit
         FaultPlan("fleet-wedge-worker", "fleet_wedge", s + 28,
                   (("replicas", 3), ("wedge", 1), ("n", 96))),
+        # flight recorder (PR 20): SIGKILL between the flightrec dump's
+        # tmp write and its rename — no torn flightrec.json (the prior
+        # dump, if any, stays intact), checkpoint bytes untouched, and a
+        # clean re-run's dump parses with the triggering trace id
+        FaultPlan("flightrec-kill-mid-dump", "flightrec_kill", s + 29,
+                  (("point", "flightrec.after_tmp"),)),
     )

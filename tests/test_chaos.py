@@ -217,7 +217,8 @@ def test_plan_suite_is_deterministic():
                                    "fleet_wedge", "cache_stale",
                                    "sweep_kill",
                                    "sync_schedule_coalescer",
-                                   "sync_schedule_cache"}
+                                   "sync_schedule_cache",
+                                   "flightrec_kill"}
     assert len({p.seed for p in a}) == len(a)
 
 

@@ -898,3 +898,358 @@ def test_http_metrics_merges_live_worker_shards():
         assert not h0["wedged"]
     finally:
         fe.stop()
+
+
+# -- distributed tracing over the fleet wire ----------------------------------
+
+def test_worker_trace_prologue_opens_child_spans_and_piggybacks():
+    """The structured batch prologue consumes no seq ordinal; the worker
+    opens worker.recv spans parented to the shipped frontend spans and a
+    worker.batch span under the dispatch span, then ships them all back
+    on the flushed reply — leaving its own ring empty and the response
+    bytes untouched."""
+    from mfm_tpu.obs import trace as _trace
+    from mfm_tpu.serve.server import _line_trace_id
+
+    _trace.reset_tracing()
+    try:
+        lines = _mixed_lines(2, seed=41)
+        parents = [["11" * 16, "22" * 8], ["33" * 16, "44" * 8]]
+        prologue = json.dumps({CONTROL_KEY: {"op": "batch", "trace": {
+            "dispatch": ["fd" * 16, "55" * 8], "parents": parents}}},
+            sort_keys=True)
+        flush = json.dumps({CONTROL_KEY: "flush"})
+        in_text = "\n".join([prologue] + lines + [flush]) + "\n"
+        out = io.StringIO()
+        run_worker(_server(batch_max=8), io.StringIO(in_text), out)
+        envs = [json.loads(ln) for ln in out.getvalue().splitlines()]
+        (sent,) = [e for e in envs if e.get(CONTROL_KEY) == "flushed"]
+        assert sent["n"] == 2              # the prologue took no ordinal
+        assert isinstance(sent["clock_us"], float)
+        shipped = sent["spans"]
+        recvs = [s for s in shipped if s["name"] == "worker.recv"]
+        assert [(s["trace_id"], s["parent_id"], s["attrs"]["seq"])
+                for s in recvs] == [("11" * 16, "22" * 8, 0),
+                                    ("33" * 16, "44" * 8, 1)]
+        (batch,) = [s for s in shipped if s["name"] == "worker.batch"]
+        assert batch["trace_id"] == "fd" * 16
+        assert batch["parent_id"] == "55" * 8
+        assert batch["attrs"]["n"] == 2
+        # the worker's own admission spans derive the SAME sha trace ids
+        # the frontend derives, so the processes join without a lookup
+        reqs = {s["trace_id"] for s in shipped
+                if s["name"] == "serve.request"}
+        assert reqs == {_line_trace_id(ln) for ln in lines}
+        # spans ship exactly once: the worker ring is drained
+        assert _trace.spans() == []
+        resps = {e["seq"]: e["resp"] for e in envs if CONTROL_KEY not in e}
+        ref = _sequential_by_id(lines, batch_max=8)
+        for i, ln in enumerate(lines):
+            rid = json.loads(ln)["id"]
+            assert json.dumps(resps[i], sort_keys=True) == ref[rid], \
+                f"traced response for {rid} diverges from sequential loop"
+    finally:
+        _trace.reset_tracing()
+
+
+def test_worker_ignores_unknown_structured_control_op():
+    """Forward compatibility: a structured control frame with an op this
+    worker does not know is skipped — no crash, no ordinal shift."""
+    lines = _mixed_lines(2, seed=43)
+    mystery = json.dumps({CONTROL_KEY: {"op": "hologram", "x": 1}},
+                         sort_keys=True)
+    flush = json.dumps({CONTROL_KEY: "flush"})
+    in_text = "\n".join([mystery] + lines + [flush]) + "\n"
+    out = io.StringIO()
+    run_worker(_server(batch_max=8), io.StringIO(in_text), out)
+    envs = [json.loads(ln) for ln in out.getvalue().splitlines()]
+    (sent,) = [e for e in envs if e.get(CONTROL_KEY) == "flushed"]
+    assert sent["n"] == 2
+    resps = {e["seq"]: e["resp"] for e in envs if CONTROL_KEY not in e}
+    assert set(resps) == {0, 1}
+    ref = _sequential_by_id(lines, batch_max=8)
+    for i, ln in enumerate(lines):
+        rid = json.loads(ln)["id"]
+        assert json.dumps(resps[i], sort_keys=True) == ref[rid]
+
+
+def test_piggyback_omits_spans_when_tracing_disabled():
+    from mfm_tpu.obs.trace import reset_tracing, set_tracing
+
+    set_tracing(False)
+    try:
+        lines = _mixed_lines(2, seed=47)
+        flush = json.dumps({CONTROL_KEY: "flush"})
+        out = io.StringIO()
+        run_worker(_server(batch_max=8),
+                   io.StringIO("\n".join(lines + [flush]) + "\n"), out)
+        envs = [json.loads(ln) for ln in out.getvalue().splitlines()]
+        (sent,) = [e for e in envs if e.get(CONTROL_KEY) == "flushed"]
+        assert "clock_us" in sent         # the clock probe always rides
+        assert "spans" not in sent        # the span payload never does
+    finally:
+        reset_tracing()
+        set_tracing(True)
+
+
+def test_replica_clock_estimate_tightens_and_ingests_spans():
+    """A loose batch-wall probe seeds the offset; a tight ping refines
+    it; a later LOOSER probe must not clobber the tight estimate; spans
+    shipped on a reply ingest shifted by the negated offset, stamped
+    with the worker ordinal."""
+    from mfm_tpu.obs import trace as _trace
+
+    _trace.reset_tracing()
+    try:
+        rep = Replica.__new__(Replica)
+        rep.idx = 5
+        rep._init_ledger()
+        rep._absorb_reply_telemetry({"clock_us": 2_000_000.0}, 1.0, 1.2)
+        assert rep.clock_offset_us == pytest.approx(900_000.0)
+        assert rep.clock_uncertainty_us == pytest.approx(100_000.0)
+        rep._absorb_reply_telemetry({"clock_us": 1_951_000.0}, 1.0, 1.002)
+        assert rep.clock_offset_us == pytest.approx(950_000.0)
+        assert rep.clock_uncertainty_us == pytest.approx(1_000.0)
+        rep._absorb_reply_telemetry({"clock_us": 3_000_000.0}, 1.0, 1.5)
+        assert rep.clock_uncertainty_us == pytest.approx(1_000.0)
+        rep._absorb_reply_telemetry(
+            {"clock_us": 1_951_000.0, "spans": [
+                {"name": "worker.batch", "trace_id": "ab" * 16,
+                 "span_id": "cd" * 8, "parent_id": None,
+                 "start_us": 1_951_000.0, "dur_us": 500.0,
+                 "wall_ts": 1.0, "tid": 1, "attrs": {}}]},
+            1.0, 1.002)
+        (sp,) = [s for s in _trace.spans() if s.name == "worker.batch"]
+        assert sp.start_us == pytest.approx(1_001_000.0)
+        assert sp.attrs["worker"] == 5
+        assert "clock_skew" not in sp.attrs
+    finally:
+        _trace.reset_tracing()
+
+
+def test_fleet_dispatch_spans_and_stub_replicas_get_plain_lines():
+    """The dispatcher opens a fleet.dispatch span per attempt, keyed by
+    the batch head's sha-derived trace id — and a replica without the
+    accepts_trace_frames capability (every duck-typed stub) receives the
+    batch WITHOUT a prologue, so its responses stay bitwise."""
+    from mfm_tpu.obs import trace as _trace
+    from mfm_tpu.serve.server import _line_trace_id
+
+    _trace.reset_tracing()
+    try:
+        ok = _StubReplica(0)
+        fleet, lines, got = _fleet_run([ok], n=6)
+        assert len(got) == len(lines)
+        dsp = [s for s in _trace.spans() if s.name == "fleet.dispatch"]
+        assert dsp, "dispatch opened no spans with tracing on"
+        tids = {_line_trace_id(ln) for ln in lines}
+        for s in dsp:
+            assert s.attrs["outcome"] == "ok"
+            assert s.attrs["replica"] == 0
+            assert s.trace_id in tids
+        ref = _sequential_by_id(lines, batch_max=4)
+        for i, ln in enumerate(lines):
+            rid = json.loads(ln)["id"]
+            assert json.dumps(got[i], sort_keys=True) == ref[rid]
+    finally:
+        _trace.reset_tracing()
+
+
+# -- flight recorder + SLO wiring through the fleet ---------------------------
+
+class _WedgeOnceStub(_StubReplica):
+    """Wedges (transport deadline) on its first batch, then is drained."""
+
+    def __init__(self, idx):
+        super().__init__(idx)
+        self.wedged = False
+
+    def run_batch(self, lines):
+        if not self.wedged:
+            self.wedged = True
+            self.quarantined = True
+            raise ReplicaWedgedError(f"replica {self.idx}: silent mid-batch")
+        return super().run_batch(lines)
+
+
+def test_wedge_quarantine_triggers_flightrec_dump(tmp_path):
+    """An armed recorder dumps on wedge quarantine: the postmortem
+    carries the triggering batch head's trace id, the dispatch history
+    and the live replica ledgers — and the survivors still answer
+    everything bitwise."""
+    from mfm_tpu.obs import flightrec as frec
+    from mfm_tpu.serve.server import _line_trace_id
+
+    frec.reset_flightrec()
+    path = str(tmp_path / "flightrec.json")
+    frec.arm(path)
+    try:
+        wedgy = _WedgeOnceStub(0)
+        ok = _StubReplica(1)
+        fleet, lines, got = _fleet_run([wedgy, ok])
+        assert len(got) == len(lines)
+        rec = frec.read_flightrec(path)
+        assert rec["trigger"] == "wedge_quarantine"
+        assert rec["trace_id"] in {_line_trace_id(ln) for ln in lines}
+        kinds = [e["kind"] for e in rec["events"]]
+        assert "wedge_quarantine" in kinds and "dispatch" in kinds
+        byidx = {r["replica"]: r for r in rec["state"]["replicas"]}
+        assert byidx[0]["wedged"] or byidx[0]["quarantined"]
+        ref = _sequential_by_id(lines, batch_max=4)
+        for i, ln in enumerate(lines):
+            rid = json.loads(ln)["id"]
+            assert json.dumps(got[i], sort_keys=True) == ref[rid]
+    finally:
+        frec.reset_flightrec()
+
+
+def test_fleet_manifest_carries_slo_and_flightrec_blocks(tmp_path):
+    from mfm_tpu.obs import flightrec as frec
+    from mfm_tpu.obs import slo as slo_mod
+
+    frec.reset_flightrec()
+    slo_mod.install(slo_mod.SloEngine())
+    try:
+        frec.arm(str(tmp_path / "flightrec.json"))
+        frec.record_event("dispatch", replica=0)
+        ok = _StubReplica(0)
+        fleet, lines, got = _fleet_run([ok], n=4)
+        fleet.close_replicas()
+        fm = build_fleet_manifest(_obs.serve_summary_from_registry(),
+                                  fleet, str(tmp_path))
+        assert fm["flightrec"]["armed"] is True
+        assert fm["flightrec"]["events"] >= 1
+        assert fm["slo"] is not None and fm["slo"]["schema"] == 1
+        assert fm["slo"]["worst_state"] in ("ok", "slow_burn", "fast_burn")
+    finally:
+        slo_mod.reset_slo()
+        frec.reset_flightrec()
+
+
+def test_doctor_serve_fails_on_fast_burning_slo(tmp_path, capsys):
+    """A fast-burn state persisted in the shutdown manifest is a missed
+    page: doctor --serve must FAIL, naming the burning objective."""
+    from mfm_tpu import cli
+    from mfm_tpu.data.artifacts import save_artifact
+    from mfm_tpu.obs.manifest import build_run_manifest, write_run_manifest
+    from mfm_tpu.serve.replica import FLEET_MANIFEST_NAME
+
+    d = str(tmp_path)
+    save_artifact(os.path.join(d, "x.npz"), {"a": np.zeros(2)})
+    ok = _StubReplica(0)
+    fleet, lines, got = _fleet_run([ok], n=4)
+    fleet.close_replicas()
+    fm = build_fleet_manifest({}, fleet, d)
+    slo_block = {"schema": 1, "window_fast_s": 300.0,
+                 "window_slow_s": 3600.0, "fast_burn_threshold": 14.4,
+                 "slow_burn_threshold": 3.0, "worst_state": "fast_burn",
+                 "slos": [{"name": "availability", "kind": "availability",
+                           "objective": 0.99, "budget": 0.01,
+                           "burn_fast": 50.0, "burn_slow": 5.0,
+                           "state": "fast_burn"}]}
+    serve_block = {"breaker_state": "closed", "breaker_open_total": 0,
+                   "shed_total": 0, "shed_rate": 0.0,
+                   "requests_total": fleet.accepted_total,
+                   "slo": slo_block}
+    write_run_manifest(
+        os.path.join(d, FLEET_MANIFEST_NAME),
+        build_run_manifest(backend="cpu",
+                           health={"status": "ok", "checks": {}},
+                           extra={"serve": serve_block, "fleet": fm,
+                                  "trace_id": "a" * 32}))
+    capsys.readouterr()
+    with pytest.raises(SystemExit) as exc:
+        cli.main(["doctor", d, "--serve"])
+    assert exc.value.code == 1
+    recs = {r["kind"]: r for r in
+            json.loads(capsys.readouterr().out)["records"]}
+    srec = recs["serve_manifest"]
+    assert srec["status"] == "unhealthy"
+    assert srec["slo_worst_state"] == "fast_burn"
+    assert any("FAST-BURNING" in p for p in srec["problems"])
+
+
+def test_doctor_surfaces_and_validates_flightrec_dumps(tmp_path, capsys):
+    """A parseable dump beside the artifacts is a warning (the run hit a
+    postmortem trigger); a torn one is a doctor FAILURE."""
+    from mfm_tpu import cli
+    from mfm_tpu.data.artifacts import save_artifact
+    from mfm_tpu.obs import flightrec as frec
+    from mfm_tpu.obs.manifest import build_run_manifest, write_run_manifest
+    from mfm_tpu.serve.replica import FLEET_MANIFEST_NAME
+
+    d = str(tmp_path)
+    save_artifact(os.path.join(d, "x.npz"), {"a": np.zeros(2)})
+    ok = _StubReplica(0)
+    fleet, lines, got = _fleet_run([ok], n=4)
+    fleet.close_replicas()
+    fm = build_fleet_manifest({}, fleet, d)
+    serve_block = {"breaker_state": "closed", "breaker_open_total": 0,
+                   "shed_total": 0, "shed_rate": 0.0,
+                   "requests_total": fleet.accepted_total}
+    write_run_manifest(
+        os.path.join(d, FLEET_MANIFEST_NAME),
+        build_run_manifest(backend="cpu",
+                           health={"status": "ok", "checks": {}},
+                           extra={"serve": serve_block, "fleet": fm,
+                                  "trace_id": "a" * 32}))
+    frec.reset_flightrec()
+    frec.record_event("breaker_open", trace_id="ab" * 16)
+    fr_path = os.path.join(d, frec.FLIGHTREC_NAME)
+    frec.dump_flightrec(fr_path, trigger="breaker_open")
+    frec.reset_flightrec()
+
+    def rc(args):
+        with pytest.raises(SystemExit) as exc:
+            cli.main(["doctor", *args])
+        return exc.value.code
+
+    capsys.readouterr()
+    assert rc([d, "--serve"]) == 0        # a valid dump only warns
+    recs = {r["kind"]: r for r in
+            json.loads(capsys.readouterr().out)["records"]}
+    frrec = recs["flightrec"]
+    assert frrec["trigger"] == "breaker_open"
+    assert frrec["trace_id"] == "ab" * 16
+    assert any("postmortem trigger" in w for w in frrec["warnings"])
+    with open(fr_path, encoding="utf-8") as fh:
+        text = fh.read()
+    with open(fr_path, "w", encoding="utf-8") as fh:
+        fh.write(text[: len(text) // 2])  # tear it
+    capsys.readouterr()
+    assert rc([d, "--serve"]) == 1
+    recs = {r["kind"]: r for r in
+            json.loads(capsys.readouterr().out)["records"]}
+    assert recs["flightrec"]["status"] == "corrupt"
+
+
+def test_metrics_diff_accepts_fleet_manifests(tmp_path, capsys):
+    """mfm-tpu metrics diff takes merged fleet manifests on either side
+    and reports per-replica shard deltas, not just merged totals."""
+    import copy
+
+    from mfm_tpu import cli
+
+    ok = _StubReplica(0)
+    fleet, lines, got = _fleet_run([ok], n=4)
+    fleet.close_replicas()
+    fm_a = build_fleet_manifest({}, fleet, str(tmp_path))
+    fm_b = copy.deepcopy(fm_a)
+    fm_b["accepted_total"] += 2
+    fm_b["replicas"][0]["outcomes"]["ok"] = \
+        fm_b["replicas"][0]["outcomes"].get("ok", 0) + 2
+    fm_b["replicas"][0]["outcomes_total"] += 2
+    a_path, b_path = str(tmp_path / "fa.json"), str(tmp_path / "fb.json")
+    for p, fmx in ((a_path, fm_a), (b_path, fm_b)):
+        with open(p, "w", encoding="utf-8") as fh:
+            json.dump(fmx, fh)
+    capsys.readouterr()
+    try:
+        cli.main(["metrics", "diff", a_path, b_path])
+    except SystemExit as exc:
+        assert exc.code in (0, None)
+    out = json.loads(capsys.readouterr().out)
+    series = out["series"]
+    assert series["fleet:accepted_total"]["delta"] == 2
+    assert series["r0:outcomes:ok"]["delta"] == 2
+    assert series["r0:outcomes_total"]["delta"] == 2

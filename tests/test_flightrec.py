@@ -1,0 +1,187 @@
+"""Flight recorder (mfm_tpu/obs/flightrec.py): the bounded event ring,
+arming + triggered dumps, the torn-file validator, the breaker-open
+integration (dump exactly once per open TRANSITION, stamped with the
+triggering request's trace id), and the SIGKILL-mid-dump atomicity drill
+(tier-1 runs the detection paths; the subprocess kill rides
+``chaos``/``slow`` like the manifest and trace drills)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from mfm_tpu.obs.flightrec import (
+    FLIGHTREC_NAME,
+    arm,
+    armed_path,
+    dump_flightrec,
+    events,
+    last_trace_id,
+    read_flightrec,
+    record_event,
+    reset_flightrec,
+    set_capacity,
+    trigger_dump,
+)
+from mfm_tpu.obs.trace import end_span, reset_tracing, start_span
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    reset_flightrec()
+    reset_tracing()
+    yield
+    reset_flightrec()
+    reset_tracing()
+
+
+# -- the event ring -----------------------------------------------------------
+
+def test_ring_keeps_newest_oldest_first():
+    set_capacity(3)
+    for i in range(5):
+        record_event("dispatch", replica=i)
+    got = events()
+    assert [ev["replica"] for ev in got] == [2, 3, 4]
+    assert all(ev["kind"] == "dispatch" and "wall_ts" in ev for ev in got)
+
+
+def test_set_capacity_validates_and_evicts_in_place():
+    record_event("a")
+    record_event("b")
+    set_capacity(1)
+    assert [ev["kind"] for ev in events()] == ["b"]
+    with pytest.raises(ValueError, match="capacity"):
+        set_capacity(0)
+
+
+def test_last_trace_id_is_the_newest_stamped_event():
+    assert last_trace_id() is None
+    record_event("batch_error", trace_id="aa" * 16)
+    record_event("breaker_open", reason="failures")   # no trace id
+    assert last_trace_id() == "aa" * 16
+
+
+# -- arming + dumps -----------------------------------------------------------
+
+def test_trigger_dump_unarmed_is_a_noop(tmp_path):
+    record_event("breaker_open")
+    assert armed_path() is None
+    assert trigger_dump("breaker_open") is None
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_dump_roundtrips_and_overwrites(tmp_path):
+    path = str(tmp_path / FLIGHTREC_NAME)
+    arm(path)
+    record_event("batch_error", trace_id="bb" * 16, detail="boom")
+    end_span(start_span("serve.request", outcome="error"))
+    assert trigger_dump("breaker_open",
+                        state={"breaker": {"state": "open"}}) == path
+    rec = read_flightrec(path)
+    assert rec["trigger"] == "breaker_open"
+    # the trace id defaults to the newest stamped event's — the
+    # triggering request
+    assert rec["trace_id"] == "bb" * 16
+    assert [ev["kind"] for ev in rec["events"]] == ["batch_error"]
+    assert [sp["name"] for sp in rec["spans"]] == ["serve.request"]
+    assert rec["state"]["breaker"]["state"] == "open"
+    assert isinstance(rec["metrics"], dict)
+    # a later trigger overwrites: the newest postmortem wins
+    record_event("wedge_quarantine", replica=1)
+    trigger_dump("wedge_quarantine")
+    rec2 = read_flightrec(path)
+    assert rec2["trigger"] == "wedge_quarantine"
+    assert len(rec2["events"]) == 2
+
+
+@pytest.mark.parametrize("mangle, msg", [
+    (lambda t: t[: len(t) // 2], "torn"),
+    (lambda t: "[1, 2]", "JSON object"),
+    (lambda t: json.dumps({"schema": 99}), "unsupported"),
+    (lambda t: json.dumps({"schema": 1, "trigger": "x", "events": [],
+                           "spans": [], "metrics": {}}), "missing 'state'"),
+    (lambda t: json.dumps({"schema": 1, "trigger": "x", "events": {},
+                           "spans": [], "metrics": {}, "state": {}}),
+     "must be lists"),
+])
+def test_read_flightrec_rejects_torn_and_malformed(tmp_path, mangle, msg):
+    path = str(tmp_path / FLIGHTREC_NAME)
+    dump_flightrec(path, trigger="sigterm")
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(mangle(text))
+    with pytest.raises(ValueError, match=msg):
+        read_flightrec(path)
+
+
+# -- breaker integration ------------------------------------------------------
+
+def test_breaker_open_transition_dumps_exactly_once(tmp_path):
+    """The dump fires on the closed->open TRANSITION, carrying the last
+    failing request's trace id; further failures while already open must
+    NOT rewrite the postmortem (the trigger context would be lost)."""
+    from mfm_tpu.serve import CircuitBreaker
+
+    path = str(tmp_path / FLIGHTREC_NAME)
+    arm(path)
+    br = CircuitBreaker(failures=2, cooldown_s=1e9)
+    record_event("batch_error", trace_id="cc" * 16, detail="first")
+    br.record_failure()
+    assert not os.path.exists(path)        # still closed: no postmortem
+    record_event("batch_error", trace_id="dd" * 16, detail="second")
+    br.record_failure()
+    assert br.state == "open"
+    rec = read_flightrec(path)
+    assert rec["trigger"] == "breaker_open"
+    assert rec["trace_id"] == "dd" * 16
+    assert rec["state"]["breaker"]["state"] == "open"
+    stamp = os.stat(path).st_mtime_ns, rec["taken_at_unix"]
+    record_event("batch_error", trace_id="ee" * 16, detail="while open")
+    br.record_failure()                     # already open: no re-dump
+    assert (os.stat(path).st_mtime_ns,
+            read_flightrec(path)["taken_at_unix"]) == stamp
+
+
+# -- crash atomicity ----------------------------------------------------------
+
+_DUMP_SCRIPT = """\
+import sys
+sys.path.insert(0, {repo!r})
+from mfm_tpu.obs import flightrec as fr
+fr.arm({path!r})
+fr.record_event("batch_error", trace_id="ab" * 16)
+fr.trigger_dump("breaker_open", state={{"breaker": {{"state": "open"}}}})
+"""
+
+
+def _dump_in_subprocess(path, kill=False):
+    env = dict(os.environ)
+    env.pop("MFM_CHAOS_KILL", None)
+    if kill:
+        env["MFM_CHAOS_KILL"] = "flightrec.after_tmp"
+    return subprocess.run(
+        [sys.executable, "-c",
+         _DUMP_SCRIPT.format(repo=REPO, path=path)],
+        env=env, capture_output=True, text=True, timeout=120)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_sigkill_mid_dump_leaves_no_torn_file(tmp_path):
+    path = str(tmp_path / FLIGHTREC_NAME)
+    proc = _dump_in_subprocess(path, kill=True)
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    # the crash fell between tmp write and rename: no half-written
+    # flightrec.json may exist for a postmortem reader to choke on
+    assert not os.path.exists(path)
+    assert _dump_in_subprocess(path).returncode == 0
+    rec = read_flightrec(path)
+    assert rec["trigger"] == "breaker_open"
+    assert rec["trace_id"] == "ab" * 16

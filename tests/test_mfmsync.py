@@ -328,6 +328,56 @@ def test_s3_os_handle_receiver_is_not_a_jax_edge(tmp_path):
     assert _found(res) == []
 
 
+def test_s3_local_handle_and_container_receivers_are_not_jax_edges(tmp_path):
+    """The LOCAL form of the typed-receiver barrier: ``fh.flush()`` on a
+    ``with open(...) as fh`` handle and ``ev.update(...)`` on a dict
+    literal must not alias package methods named flush/update that
+    genuinely dispatch jax (the atomic-writer and event-record idioms
+    would otherwise drag every locked caller into S3)."""
+    res = _sync(tmp_path, {"mod.py": """
+        import threading
+
+        import jax.numpy as jnp
+
+        LOCK = threading.Lock()
+
+        class Engine:
+            def update(self, x):
+                return jnp.sum(x)         # genuine jax toucher named update
+
+            def flush(self):
+                return jnp.zeros(2)       # ... and one named flush
+
+            def refit(self, x):
+                return jnp.dot(x, x)      # distinctive name (no generic-
+                                          # attr suppression in the way)
+
+        def record(**fields):
+            ev = {"kind": "x"}
+            ev.update(fields)             # dict literal, not Engine.update
+            return ev
+
+        def dump(path, text):
+            with open(path, "w") as fh:
+                fh.write(text)
+                fh.flush()                # OS handle, not Engine.flush
+            rows = list(text)
+            rows.append("eof")            # list(), not some package append
+
+        def locked_writer(path):
+            with LOCK:
+                record(a=1)               # must NOT be S3
+                dump(path, "x")           # must NOT be S3
+
+        def rebound(x):
+            ev = {}
+            ev = Engine()                 # rebind untracks the name
+            with LOCK:
+                return ev.refit(x)        # IS S3: a real Engine.refit
+    """})
+    assert set(_found(res)) == {("S3", "rebound")}
+
+
 def test_baseline_and_strict_stale(tmp_path):
     files = {"mod.py": """
         import threading

@@ -22,9 +22,12 @@ from mfm_tpu.obs.exporters import EVENT_REQUIRED_KEYS, route_events_to
 from mfm_tpu.obs.instrument import TRACE_DROPPED_TOTAL, TRACE_SPANS_TOTAL
 from mfm_tpu.obs.trace import (
     chrome_trace_events,
+    clock_offset_from_probe,
+    drain_spans,
     end_span,
     export_spans_to_events,
     current_trace_id,
+    ingest_foreign_spans,
     parse_chrome_trace,
     render_chrome_trace,
     reset_tracing,
@@ -206,6 +209,114 @@ def test_export_spans_to_jsonl_events(tmp_path):
     assert ev["event"] == "span" and ev["name"] == "run"
     assert ev["attr_cmd"] == "scenario"
     assert len(ev["trace_id"]) == 32 and ev["dur_s"] >= 0.0
+
+
+# -- fleet-wire span merge: clock-offset correction ---------------------------
+
+def _worker_wire_span(name, start_us, dur_us=1000.0, trace_id="ab" * 16,
+                      span_id="01" * 8, parent=None):
+    return {"name": name, "trace_id": trace_id, "span_id": span_id,
+            "parent_id": parent, "start_us": float(start_us),
+            "dur_us": float(dur_us), "wall_ts": 123.0, "tid": 7,
+            "attrs": {}}
+
+
+def test_drain_spans_is_destructive_and_json_safe():
+    end_span(start_span("worker.batch", n=3))
+    shipped = drain_spans()
+    assert spans() == []               # shipped spans leave the worker ring
+    assert len(shipped) == 1
+    d = shipped[0]
+    assert d["name"] == "worker.batch" and d["attrs"]["n"] == 3
+    json.dumps(d)                      # the piggyback payload must be JSON
+
+
+def test_clock_offset_probe_midpoint_and_uncertainty():
+    # peer stamped its clock somewhere inside a 2 ms round trip centered
+    # on local t=1.001 s; the peer runs 50 ms ahead
+    off, unc = clock_offset_from_probe(1.000, 1.002, 1_051_000.0)
+    assert off == pytest.approx(50_000.0)
+    assert unc == pytest.approx(1_000.0)
+
+
+@pytest.mark.parametrize("skew_ms", [50.0, -50.0])
+def test_injected_skew_corrects_onto_local_timeline(skew_ms):
+    """A worker clock +-50 ms off the frontend's: spans corrected by the
+    probe-estimated offset land inside the dispatch window, in the true
+    event order, stamped with the correction they received."""
+    skew_us = skew_ms * 1e3
+    # true (local-clock) worker activity: recv at 1.002 s, batch at 1.003 s,
+    # inside the local dispatch window [1.000 s, 1.010 s]
+    shipped = [
+        _worker_wire_span("worker.recv", 1_002_000 + skew_us,
+                          span_id="aa" * 8),
+        _worker_wire_span("worker.batch", 1_003_000 + skew_us,
+                          span_id="bb" * 8),
+    ]
+    # probe: peer stamped (midpoint + skew) inside a 2 ms RTT
+    off, unc = clock_offset_from_probe(1.000, 1.002,
+                                       1_001_000.0 + skew_us)
+    assert off == pytest.approx(skew_us, abs=1.0)
+    got = ingest_foreign_spans(shipped, offset_us=-off, uncertainty_us=unc,
+                               window_us=(1_000_000.0, 1_010_000.0),
+                               worker=3)
+    assert [s.name for s in got] == ["worker.recv", "worker.batch"]
+    assert got[0].start_us == pytest.approx(1_002_000.0, abs=unc)
+    assert got[1].start_us == pytest.approx(1_003_000.0, abs=unc)
+    assert got[0].start_us < got[1].start_us   # true order survives
+    for s in got:
+        assert s.attrs["clock_offset_us"] == pytest.approx(-off)
+        assert s.attrs["clock_uncertainty_us"] == pytest.approx(unc)
+        assert s.attrs["worker"] == 3
+        assert "clock_skew" not in s.attrs
+    # the merged ring holds them for the Chrome export
+    assert [s.span_id for s in spans()] == ["aa" * 8, "bb" * 8]
+
+
+def test_uncorrectable_skew_flagged_never_reordered_or_clamped():
+    """No usable offset estimate: a span whose corrected extent falls
+    outside the dispatch window beyond the uncertainty is FLAGGED — its
+    timestamps are neither clamped into the window nor reordered."""
+    from mfm_tpu.obs.instrument import TRACE_SKEW_UNCORRECTABLE_TOTAL
+    before = TRACE_SKEW_UNCORRECTABLE_TOTAL.value()
+    shipped = [_worker_wire_span("worker.batch", 1_052_000.0,
+                                 span_id="cc" * 8),
+               _worker_wire_span("worker.recv", 1_051_000.0,
+                                 span_id="dd" * 8)]
+    got = ingest_foreign_spans(shipped, offset_us=0.0, uncertainty_us=500.0,
+                               window_us=(1_000_000.0, 1_010_000.0),
+                               worker=1)
+    assert [s.attrs.get("clock_skew") for s in got] == \
+        ["uncorrectable", "uncorrectable"]
+    # not clamped: the raw (offset-applied) timestamps survive
+    assert got[0].start_us == 1_052_000.0
+    assert got[1].start_us == 1_051_000.0
+    # not reordered: ring order is ship order, even though start_us isn't
+    assert [s.span_id for s in spans()] == ["cc" * 8, "dd" * 8]
+    assert TRACE_SKEW_UNCORRECTABLE_TOTAL.value() == before + 2
+
+
+def test_merged_spans_render_one_timeline_per_trace():
+    tid = "fe" * 16
+    sp = start_span("fleet.dispatch", trace_id=tid, replica=0)
+    end_span(sp)
+    ingest_foreign_spans(
+        [_worker_wire_span("worker.batch", 2_000.0, trace_id=tid,
+                           parent=sp.span_id)],
+        offset_us=0.0, uncertainty_us=10.0, worker=0)
+    events = parse_chrome_trace(render_chrome_trace())
+    by_name = {e["name"]: e for e in events}
+    assert by_name["fleet.dispatch"]["args"]["trace_id"] == tid
+    assert by_name["worker.batch"]["args"]["trace_id"] == tid
+    assert by_name["worker.batch"]["args"]["parent_id"] == sp.span_id
+    assert by_name["worker.batch"]["args"]["clock_offset_us"] == 0.0
+
+
+def test_ingest_disabled_tracing_is_a_noop():
+    set_tracing(False)
+    got = ingest_foreign_spans([_worker_wire_span("worker.batch", 1.0)],
+                               offset_us=0.0)
+    assert got == [] and spans() == []
 
 
 # -- crash atomicity ----------------------------------------------------------

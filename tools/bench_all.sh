@@ -134,9 +134,12 @@ done
 # third worker sits SIGSTOPped (wedged, not dead) — heartbeats must
 # quarantine the silent worker, survivors answer everything bitwise
 # by id, and the merged manifest's transport counters stay audit-
-# consistent (config 12's evidence)
+# consistent (config 12's evidence), and the flight recorder: SIGKILL
+# between the postmortem dump's tmp write and its rename — no torn
+# flightrec.json, checkpoint bytes untouched, a clean re-trigger parses
+# with the staged breaker trigger + trace id, doctor stays green
 python tools/faultinject.py --plans \
-  query-kill-mid-batch,query-poison-slab,query-overflow-storm,query-ckpt-swap,query-steady-state,scenario-kill-mid-batch,scenario-poison-spec,trace-kill-mid-flush,eigen-kill-mid-update,shard-kill-mid-append,grad-kill-mid-solve,fleet-kill-replica,fleet-kill-host,fleet-wedge-worker,cache-stale-generation,sweep-kill-mid-stream,sync-schedule-coalescer,sync-schedule-cache \
+  query-kill-mid-batch,query-poison-slab,query-overflow-storm,query-ckpt-swap,query-steady-state,scenario-kill-mid-batch,scenario-poison-spec,trace-kill-mid-flush,eigen-kill-mid-update,shard-kill-mid-append,grad-kill-mid-solve,fleet-kill-replica,fleet-kill-host,fleet-wedge-worker,cache-stale-generation,sweep-kill-mid-stream,sync-schedule-coalescer,sync-schedule-cache,flightrec-kill-mid-dump \
   || { echo "query/scenario/trace/grad/fleet/cache/sweep/schedule chaos plans failed — config6/7/8/9/10/11 numbers are not evidence" >&2
        exit 1; }
 

@@ -611,6 +611,118 @@ def run_trace_kill(plan, base: Baseline, root: str) -> dict:
             "manifest_trace_id": man["trace_id"]}
 
 
+_FLIGHTREC_DRIVER = """\
+import sys
+from mfm_tpu.obs import flightrec as fr
+fr.arm(sys.argv[1])
+fr.record_event("batch_error", trace_id="df" * 16, kind_of="query",
+                scenario="base", n=4, detail="staged batch failure")
+fr.record_event("breaker_open", reason="failures")
+out = fr.trigger_dump("breaker_open",
+                      state={"breaker": {"state": "open",
+                                         "open_reason": "failures"}})
+print(out, flush=True)
+"""
+
+
+def run_flightrec_kill(plan, base: Baseline, root: str) -> dict:
+    """flightrec-kill-mid-dump: SIGKILL between the flight recorder's tmp
+    write and its rename.  The postmortem writer runs INSIDE the serving
+    process next to the checkpoint, so the drill must prove a crash
+    mid-dump leaves no torn ``flightrec.json``, does not touch the
+    checkpoint bytes, and that a clean re-trigger writes a dump
+    :func:`read_flightrec` accepts (carrying the staged breaker trigger
+    and the triggering request's trace id) with the directory still
+    doctor-green."""
+    import hashlib
+
+    from mfm_tpu.data.artifacts import load_risk_state
+    from mfm_tpu.obs.flightrec import FLIGHTREC_NAME, read_flightrec
+
+    point = plan.param("point")
+    d = _fresh_workdir(root, plan.name, base.snaps[0])
+    path = os.path.join(d, "state.npz")
+    frec_path = os.path.join(d, FLIGHTREC_NAME)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo_root}
+
+    def _ckpt_hash():
+        with open(path, "rb") as fh:
+            return hashlib.sha256(fh.read()).hexdigest()
+
+    before = _ckpt_hash()
+    driver = os.path.join(d, "frec_driver.py")
+    with open(driver, "w", encoding="utf-8") as fh:
+        fh.write(_FLIGHTREC_DRIVER)
+    cmd = [sys.executable, driver, frec_path]
+    proc = subprocess.run(cmd, env={**env, "MFM_CHAOS_KILL": point},
+                          capture_output=True, text=True, timeout=600)
+    if proc.returncode != -signal.SIGKILL:
+        raise AssertionError(
+            f"{plan.name}: expected the dump driver to die by SIGKILL at "
+            f"{point}, got rc={proc.returncode}\n{proc.stderr[-2000:]}")
+    if os.path.exists(frec_path):
+        raise AssertionError(f"{plan.name}: a flightrec.json exists despite "
+                             "the kill before its rename — the dump is not "
+                             "tmp-then-rename atomic")
+    if _ckpt_hash() != before:
+        raise AssertionError(f"{plan.name}: the flightrec dump touched the "
+                             "checkpoint bytes")
+    # a clean re-trigger must land a parseable postmortem stamped with the
+    # staged trigger and the triggering request's trace id
+    proc2 = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                           timeout=600)
+    if proc2.returncode != 0:
+        raise AssertionError(f"{plan.name}: post-crash dump failed "
+                            f"rc={proc2.returncode}\n{proc2.stderr[-2000:]}")
+    try:
+        rec = read_flightrec(frec_path)
+    except ValueError as err:
+        raise AssertionError(f"{plan.name}: recovered flightrec.json fails "
+                             f"the schema check: {err}")
+    if rec["trigger"] != "breaker_open":
+        raise AssertionError(f"{plan.name}: dump trigger is "
+                             f"{rec['trigger']!r}, wanted 'breaker_open'")
+    if rec.get("trace_id") != "df" * 16:
+        raise AssertionError(f"{plan.name}: dump lost the triggering "
+                             f"request's trace id ({rec.get('trace_id')!r})")
+    if len(rec["events"]) < 2:
+        raise AssertionError(f"{plan.name}: dump carries "
+                             f"{len(rec['events'])} events, wanted >= 2")
+    # the checkpoint the recorder dumped beside must still be fully usable:
+    # the CLI appends a slab, the carries and next slab replay bitwise
+    slab_csv = os.path.join(d, "slab0.csv")
+    base.slabs[0].to_csv(slab_csv, index=False)
+    upd = subprocess.run(
+        [sys.executable, "-m", "mfm_tpu.cli", "risk",
+         "--barra", slab_csv, "--update", path, "--quarantine",
+         "--eigen-sims", str(EIGEN_SIMS),
+         "--eigen-sim-length", str(T_TOTAL),
+         "--out", os.path.join(d, "tables")],
+        env=env, capture_output=True, text=True, timeout=600)
+    if upd.returncode != 0:
+        raise AssertionError(f"{plan.name}: post-crash update failed "
+                             f"rc={upd.returncode}\n{upd.stderr[-2000:]}")
+    state, meta = load_risk_state(path)
+    if meta["last_date"] != base.slab_dates[0][-1]:
+        raise AssertionError(f"{plan.name}: checkpoint does not carry the "
+                             "appended dates after the crash drill")
+    _assert_carries_equal(_carries(state), base.carries[0],
+                          f"{plan.name} (subprocess checkpoint)")
+    res = _append(path, base.slabs[1], base.cfg)
+    _assert_outputs_equal(_outputs_by_date(res), base.outputs[1],
+                          base.slab_dates[1], plan.name)
+    doc = subprocess.run([sys.executable, "-m", "mfm_tpu.cli", "doctor", d],
+                         env=env, capture_output=True, text=True, timeout=600)
+    if doc.returncode != 0:
+        raise AssertionError(f"{plan.name}: doctor rejects the post-crash "
+                             f"state\n{doc.stdout[-2000:]}")
+    return {"killed_at": point, "flightrec_after_crash": "absent",
+            "recovered_trigger": rec["trigger"],
+            "recovered_events": len(rec["events"]),
+            "recovered_trace_id": rec["trace_id"]}
+
+
 _POISON_OK_REASONS = {
     # NaN returns are dropped by the frame->arrays conversion, so a
     # NaN-poisoned CSV date manifests as universe collapse downstream of
@@ -2202,6 +2314,7 @@ RUNNERS = {"truncate": run_byte_fault, "corrupt": run_byte_fault,
            "scenario_poison": run_scenario_poison,
            "sweep_kill": run_sweep_kill,
            "trace_kill": run_trace_kill, "eigen_kill": run_eigen_kill,
+           "flightrec_kill": run_flightrec_kill,
            "shard_kill": run_shard_kill, "grad_kill": run_grad_kill,
            "fleet_kill": run_fleet_kill,
            "fleet_kill_host": run_fleet_kill_host,
